@@ -1,0 +1,24 @@
+"""kube_batch_tpu — a TPU-native batch/gang scheduling framework.
+
+A ground-up rebuild of the capabilities of kube-batch v0.4.2 (the scheduler
+that became Volcano; reference surveyed in SURVEY.md) where every hot loop —
+per-task×per-node predicates, node scoring, DRF shares, proportion fair-share,
+and the gang-constrained allocate — is a compiled XLA program over
+device-resident snapshot tensors instead of a Go loop over object graphs.
+
+Layer map (mirrors SURVEY.md §1):
+  scheduler.py        — L1 scheduler loop (reference pkg/scheduler/scheduler.go)
+  framework/          — L2 session runtime, tiers, statement (pkg/scheduler/framework)
+  actions/            — L3 enqueue/reclaim/allocate/backfill/preempt
+  plugins/            — L4 gang/drf/proportion/priority/predicates/nodeorder/
+                         conformance/binpack policies
+  cache/              — L5 cluster cache, event ingest, binder/evictor seams
+  utils/              — L6 priority queue, helpers
+  api/                — L7 data model (Resource, TaskInfo, JobInfo, NodeInfo,
+                         QueueInfo, device snapshot)
+  ops/                — the TPU compute path: feasibility masks, score rows,
+                         fairness tensors, gang-constrained assignment solve
+  parallel/           — device mesh / sharding of the node axis over ICI
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+from kube_batch_tpu.api.resources import Resource, ResourceSpec, DEFAULT_SPEC
+from kube_batch_tpu.api.types import (
+    TaskStatus,
+    ALLOCATED_STATUSES,
+    PodGroupPhase,
+    PodGroupConditionType,
+    pod_phase_to_status,
+)
+from kube_batch_tpu.api.pod import Pod, PodGroup, Queue, Toleration, Taint, GROUP_NAME_ANNOTATION
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.job_info import JobInfo, FitError, FitErrors
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+
+__all__ = [
+    "Resource",
+    "ResourceSpec",
+    "DEFAULT_SPEC",
+    "TaskStatus",
+    "ALLOCATED_STATUSES",
+    "PodGroupPhase",
+    "PodGroupConditionType",
+    "pod_phase_to_status",
+    "Pod",
+    "PodGroup",
+    "Queue",
+    "Toleration",
+    "Taint",
+    "GROUP_NAME_ANNOTATION",
+    "TaskInfo",
+    "JobInfo",
+    "FitError",
+    "FitErrors",
+    "NodeInfo",
+    "QueueInfo",
+    "ClusterInfo",
+]

@@ -1,0 +1,191 @@
+"""JobInfo — a gang (PodGroup) of tasks with status-indexed accounting.
+
+Mirrors pkg/scheduler/api/job_info.go:127-418 and unschedule_info.go:22-112:
+the per-status task index, allocated/total-request aggregates, MinAvailable
+gang threshold, Ready()/Pipelined() predicates, and fit-error bookkeeping.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Optional
+
+from kube_batch_tpu.api.pod import PodGroup, PodGroupCondition
+from kube_batch_tpu.api.resources import Resource, ResourceSpec
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import TaskStatus, is_allocated
+from kube_batch_tpu.utils.assertions import graft_assert
+
+
+class FitError:
+    """Why one task failed on one node (unschedule_info.go:40-71)."""
+
+    def __init__(self, task: TaskInfo, node_name: str, reasons: list[str]):
+        self.task_namespace = task.namespace
+        self.task_name = task.name
+        self.node_name = node_name
+        self.reasons = reasons
+
+    def error(self) -> str:
+        return f"task {self.task_namespace}/{self.task_name} on node {self.node_name} fit failed: {', '.join(self.reasons)}"
+
+
+class FitErrors:
+    """Per-task node→FitError map with a reason histogram rendering
+    (unschedule_info.go:74-112)."""
+
+    def __init__(self):
+        self.nodes: Dict[str, FitError] = {}
+
+    def set_node_error(self, node_name: str, err: FitError) -> None:
+        self.nodes[node_name] = err
+
+    def error(self) -> str:
+        hist: Dict[str, int] = defaultdict(int)
+        for fe in self.nodes.values():
+            for r in fe.reasons:
+                hist[r] += 1
+        reasons = "; ".join(f"{n} {r}" for r, n in sorted(hist.items(), key=lambda kv: kv[0]))
+        return f"0/{len(self.nodes)} nodes are available, {reasons}." if self.nodes else ""
+
+
+class JobInfo:
+    def __init__(self, uid: str, spec: ResourceSpec, pod_group: Optional[PodGroup] = None):
+        self.uid = uid
+        self.spec = spec
+        self.name = ""
+        self.namespace = ""
+        self.queue: str = ""
+        self.priority: int = 0
+        self.min_available: int = 0
+        self.tasks: Dict[str, TaskInfo] = {}
+        # TaskStatusIndex (job_info.go:141): status → {taskKey: task}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
+        self.allocated: Resource = spec.empty()
+        self.total_request: Resource = spec.empty()
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.nodes_fit_errors: Dict[str, FitErrors] = {}  # taskUID → FitErrors
+        self.job_fit_errors: str = ""
+        self.pod_group: Optional[PodGroup] = None
+        self.creation_index: int = 0
+        if pod_group is not None:
+            self.set_pod_group(pod_group)
+
+    # -- podgroup wiring (job_info.go:171-208) ----------------------------
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.min_available = pg.min_member
+        self.queue = pg.queue
+        self.creation_index = pg.creation_index
+        self.pod_group = pg
+
+    # -- task bookkeeping (job_info.go:211-263) ---------------------------
+    def _index_add(self, task: TaskInfo) -> None:
+        self.task_status_index[task.status][task.key()] = task
+
+    def _index_remove(self, task: TaskInfo) -> None:
+        bucket = self.task_status_index.get(task.status)
+        if bucket is not None:
+            bucket.pop(task.key(), None)
+            if not bucket:
+                del self.task_status_index[task.status]
+
+    def add_task(self, task: TaskInfo) -> None:
+        key = task.key()
+        graft_assert(key not in self.tasks, f"duplicate task {key} in job {self.uid}")
+        self.tasks[key] = task
+        self._index_add(task)
+        if is_allocated(task.status):
+            self.allocated.add_(task.resreq)
+        self.total_request.add_(task.resreq)
+
+    def delete_task(self, task: TaskInfo) -> None:
+        key = task.key()
+        existing = self.tasks.get(key)
+        graft_assert(existing is not None, f"task {key} not in job {self.uid}")
+        if existing is None:
+            return
+        if is_allocated(existing.status):
+            self.allocated.sub_(existing.resreq)
+        self.total_request.sub_(existing.resreq)
+        self._index_remove(existing)
+        del self.tasks[key]
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """delete + re-add under the new status so indices and aggregates stay
+        consistent (job_info.go:250-263)."""
+        key = task.key()
+        if key in self.tasks:
+            self.delete_task(task)
+        task.status = status
+        self.add_task(task)
+
+    # -- gang predicates (job_info.go:367-418) ----------------------------
+    def task_num(self, *statuses: TaskStatus) -> int:
+        return sum(len(self.task_status_index.get(s, {})) for s in statuses)
+
+    @property
+    def ready_task_num(self) -> int:
+        """Tasks counting toward gang readiness (job_info.go:367-380
+        ReadyTaskNum): AllocatedStatus (Bound+Binding+Running+Allocated) plus
+        Succeeded."""
+        return self.task_num(
+            TaskStatus.BOUND,
+            TaskStatus.BINDING,
+            TaskStatus.RUNNING,
+            TaskStatus.ALLOCATED,
+            TaskStatus.SUCCEEDED,
+        )
+
+    @property
+    def waiting_task_num(self) -> int:
+        """Pipelined tasks (job_info.go:383-391)."""
+        return self.task_num(TaskStatus.PIPELINED)
+
+    @property
+    def valid_task_num(self) -> int:
+        """Tasks that can count toward the gang (job_info.go:394-409
+        ValidTaskNum): AllocatedStatus + Succeeded + Pipelined + Pending.
+        Releasing/Failed/Unknown tasks are not valid gang members."""
+        return self.task_num(
+            TaskStatus.PENDING,
+            TaskStatus.ALLOCATED,
+            TaskStatus.PIPELINED,
+            TaskStatus.BINDING,
+            TaskStatus.BOUND,
+            TaskStatus.RUNNING,
+            TaskStatus.SUCCEEDED,
+        )
+
+    def ready(self) -> bool:
+        return self.ready_task_num >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.ready_task_num + self.waiting_task_num >= self.min_available
+
+    # -- diagnostics ------------------------------------------------------
+    def fit_error(self) -> str:
+        """Histogram of task statuses (job_info.go:347-364)."""
+        counts = {s.name: len(m) for s, m in sorted(self.task_status_index.items())}
+        body = ", ".join(f"{n} {s}" for s, n in counts.items())
+        return f"job is not ready, {body}"
+
+    def clone(self) -> "JobInfo":
+        j = JobInfo(self.uid, self.spec)
+        j.name = self.name
+        j.namespace = self.namespace
+        j.queue = self.queue
+        j.priority = self.priority
+        j.min_available = self.min_available
+        j.creation_index = self.creation_index
+        j.pod_group = self.pod_group.clone() if self.pod_group else None
+        for t in self.tasks.values():
+            j.add_task(t.clone())
+        return j
+
+    def __repr__(self) -> str:
+        return (
+            f"JobInfo({self.uid} queue={self.queue} min={self.min_available} "
+            f"tasks={len(self.tasks)} ready={self.ready_task_num})"
+        )

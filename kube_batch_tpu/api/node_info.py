@@ -1,0 +1,135 @@
+"""NodeInfo — per-node resource accounting with the three-way status algebra.
+
+Mirrors pkg/scheduler/api/node_info.go:28-222. The critical piece is the
+AddTask/RemoveTask algebra (node_info.go:165-222): a task's effect on the
+node's (Idle, Used, Releasing) triple depends on its status —
+
+    Releasing task:  Releasing += r ; Idle -= r ; Used += r
+    Pipelined task:  Releasing -= r            ; Used += r
+    other allocated: Idle -= r                 ; Used += r
+
+so that "fits in Releasing" (allocate.go:176-184) means: the request fits in
+resources that are on their way back. The same algebra is replicated
+tensor-side in ops/assignment.py; this host copy is authoritative for ingest
+and for the host-path actions (preempt/reclaim/backfill).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kube_batch_tpu.api.pod import Node
+from kube_batch_tpu.api.resources import Resource, ResourceSpec, PODS
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import TaskStatus, is_allocated
+from kube_batch_tpu.utils.assertions import graft_assert
+
+
+def _node_resource(node: Node, spec: ResourceSpec, which: str) -> Resource:
+    src = node.allocatable if which == "allocatable" else node.capacity
+    r = spec.empty()
+    for name, v in src.items():
+        if name in spec:
+            r.vec[spec.index(name)] = float(v)
+    return r
+
+
+class NodeInfo:
+    def __init__(self, node: Optional[Node], spec: ResourceSpec):
+        self.spec = spec
+        self.name: str = node.name if node else ""
+        self.node: Optional[Node] = node
+        self.tasks: Dict[str, TaskInfo] = {}
+        if node is not None:
+            self.allocatable = _node_resource(node, spec, "allocatable")
+            self.capability = _node_resource(node, spec, "capacity")
+        else:
+            self.allocatable = spec.empty()
+            self.capability = spec.empty()
+        self.idle = self.allocatable.clone()
+        self.used = spec.empty()
+        self.releasing = spec.empty()
+
+    # -- state machine (node_info.go:110-134) -----------------------------
+    @property
+    def ready(self) -> bool:
+        return self.node is not None and self.node.ready
+
+    def set_node(self, node: Node) -> None:
+        """Update the node object, rebuilding (Idle, Used, Releasing) from the
+        new allocatable and replaying every resident task's status algebra
+        (node_info.go:137-162 SetNode). The replay matters when pods were
+        ingested before their node: their add_task skipped accounting because
+        node was None."""
+        self.name = node.name
+        self.node = node
+        self.allocatable = _node_resource(node, self.spec, "allocatable")
+        self.capability = _node_resource(node, self.spec, "capacity")
+        self.idle = self.allocatable.clone()
+        self.used = self.spec.empty()
+        self.releasing = self.spec.empty()
+        tasks, self.tasks = self.tasks, {}
+        for t in tasks.values():
+            self.add_task(t, _cloned=True)
+
+    # -- task algebra (node_info.go:165-222) ------------------------------
+    def add_task(self, task: TaskInfo, _cloned: bool = False) -> None:
+        """The node holds its own *copy* of the task (node_info.go:165-168:
+        "Node will hold a copy of task to make sure the status change will
+        not impact resource in node") so a later in-place status mutation on
+        the caller's object can't desynchronize remove_task's reversal."""
+        key = task.key()
+        graft_assert(key not in self.tasks, f"duplicate task {key} on node {self.name}")
+        if not _cloned:
+            task = task.clone()
+        if self.node is not None:
+            r = task.resreq
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.add_(r)
+                self.idle.sub_(r)
+                self.used.add_(r)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.sub_(r)
+                self.used.add_(r)
+            elif is_allocated(task.status):
+                self.idle.sub_(r)
+                self.used.add_(r)
+            # terminal/pending statuses don't touch accounting
+        task.node_name = self.name
+        self.tasks[key] = task
+
+    def remove_task(self, task: TaskInfo) -> None:
+        key = task.key()
+        existing = self.tasks.get(key)
+        graft_assert(existing is not None, f"task {key} not on node {self.name}")
+        if self.node is not None and existing is not None:
+            r = existing.resreq
+            if existing.status == TaskStatus.RELEASING:
+                self.releasing.sub_(r)
+                self.idle.add_(r)
+                self.used.sub_(r)
+            elif existing.status == TaskStatus.PIPELINED:
+                self.releasing.add_(r)
+                self.used.sub_(r)
+            elif is_allocated(existing.status):
+                self.idle.add_(r)
+                self.used.sub_(r)
+        self.tasks.pop(key, None)
+
+    def update_task(self, task: TaskInfo) -> None:
+        """delete + add (node_info.go:225-233)."""
+        self.remove_task(task)
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo(self.node, self.spec)
+        for t in self.tasks.values():
+            n.add_task(t.clone(), _cloned=True)
+        return n
+
+    @property
+    def pod_count(self) -> int:
+        return len(self.tasks)
+
+    def __repr__(self) -> str:
+        return f"NodeInfo({self.name} idle={self.idle} used={self.used} releasing={self.releasing})"

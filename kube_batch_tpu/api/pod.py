@@ -1,0 +1,189 @@
+"""The framework's ingest object model: Pod, PodGroup, Queue, Node specs.
+
+The reference consumes Kubernetes API objects (v1.Pod, v1.Node, the PodGroup
+and Queue CRDs in pkg/apis/scheduling/v1alpha1/types.go:93-223). This
+framework is standalone — there is no apiserver in the loop — so these are
+lightweight first-class dataclasses with exactly the fields the scheduler
+reads. A k8s front-end (or any other cluster manager) adapts its objects into
+these before feeding the cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+
+# Annotation linking a Pod to its PodGroup (apis/scheduling/v1alpha1/labels.go:21).
+GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
+
+_uid_counter = itertools.count()
+
+
+def _auto_uid(prefix: str) -> str:
+    return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclasses.dataclass
+class Toleration:
+    """Pod toleration (subset of v1.Toleration the predicates read)."""
+
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclasses.dataclass
+class Taint:
+    """Node taint (v1.Taint subset; effects NoSchedule/PreferNoSchedule/NoExecute)."""
+
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclasses.dataclass
+class Affinity:
+    """Required-node-affinity as match-expression terms.
+
+    Each term is a list of (key, operator, values) requirements; terms are
+    OR'd, requirements within a term are AND'd — the same shape as
+    v1.NodeSelectorTerms consumed by the vendored MatchNodeSelector predicate
+    (predicates.go:194-205).
+    """
+
+    node_terms: List[List[Tuple[str, str, Tuple[str, ...]]]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass
+class Pod:
+    """The scheduler-visible slice of a pod spec + status."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    # resource requests: sum over app containers; init-containers folded into
+    # InitResreq by TaskInfo (pod_info.go:53-73)
+    requests: Dict[str, float] = dataclasses.field(default_factory=dict)
+    init_requests: Dict[str, float] = dataclasses.field(default_factory=dict)
+    node_name: Optional[str] = None
+    phase: PodPhase = PodPhase.PENDING
+    deleting: bool = False  # DeletionTimestamp set
+    priority: int = 0
+    priority_class: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: List[Toleration] = dataclasses.field(default_factory=list)
+    affinity: Optional[Affinity] = None
+    host_ports: Tuple[int, ...] = ()
+    scheduler_name: str = "volcano"
+    creation_index: int = 0  # monotone stand-in for CreationTimestamp
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _auto_uid(f"pod-{self.namespace}-{self.name}")
+
+    @property
+    def group_name(self) -> Optional[str]:
+        return self.annotations.get(GROUP_NAME_ANNOTATION)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class PodGroup:
+    """PodGroup CRD (apis/scheduling/v1alpha1/types.go:93-171)."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    min_member: int = 1
+    queue: str = ""
+    priority_class: str = ""
+    min_resources: Optional[Dict[str, float]] = None
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: List["PodGroupCondition"] = dataclasses.field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    creation_index: int = 0
+    shadow: bool = False  # synthesized for a plain pod (cache/util.go:42-60)
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _auto_uid(f"pg-{self.namespace}-{self.name}")
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "PodGroup":
+        pg = dataclasses.replace(self)
+        pg.conditions = [dataclasses.replace(c) for c in self.conditions]
+        pg.min_resources = dict(self.min_resources) if self.min_resources else None
+        return pg
+
+
+@dataclasses.dataclass
+class PodGroupCondition:
+    """(types.go:55-73)"""
+
+    type: str
+    status: str = "True"
+    transition_id: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclasses.dataclass
+class Queue:
+    """Queue CRD (types.go:178-223): weighted share + optional capability cap."""
+
+    name: str
+    uid: str = ""
+    weight: int = 1
+    capability: Optional[Dict[str, float]] = None
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _auto_uid(f"queue-{self.name}")
+
+
+@dataclasses.dataclass
+class Node:
+    """The scheduler-visible slice of a v1.Node."""
+
+    name: str
+    allocatable: Dict[str, float] = dataclasses.field(default_factory=dict)
+    capacity: Dict[str, float] = dataclasses.field(default_factory=dict)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    taints: List[Taint] = dataclasses.field(default_factory=list)
+    ready: bool = True
+    unschedulable: bool = False
+    conditions: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    # conditions: e.g. {"MemoryPressure": True}; consumed by the optional
+    # pressure predicates (predicates.go:233-276)
+
+    def __post_init__(self):
+        if not self.capacity:
+            self.capacity = dict(self.allocatable)
+
+
+@dataclasses.dataclass
+class PriorityClass:
+    name: str
+    value: int
+    global_default: bool = False

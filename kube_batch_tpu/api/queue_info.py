@@ -1,0 +1,21 @@
+"""QueueInfo (pkg/scheduler/api/queue_info.go:29-48): UID=name, Weight, and a
+backref to the Queue object (whose Capability caps the queue in proportion's
+JobEnqueueable check, proportion.go:211-233)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.pod import Queue
+
+
+class QueueInfo:
+    def __init__(self, queue: Queue):
+        self.uid: str = queue.name
+        self.name: str = queue.name
+        self.weight: int = max(int(queue.weight), 1)
+        self.queue: Queue = queue
+
+    def clone(self) -> "QueueInfo":
+        return QueueInfo(self.queue)
+
+    def __repr__(self) -> str:
+        return f"QueueInfo({self.name} weight={self.weight})"

@@ -1,0 +1,285 @@
+"""Vectorized multi-resource arithmetic with the reference's epsilon semantics.
+
+Mirrors pkg/scheduler/api/resource_info.go. The reference models a resource
+amount as {MilliCPU float64, Memory float64, ScalarResources map[name]float64,
+MaxTaskNum int} with minimum comparison quanta of 10 milliCPU / 10 MiB /
+10 milli-scalar (resource_info.go:70-72) so that sub-quantum residues never
+flip a fit decision.
+
+The TPU-native design replaces the struct+map with a dense float64 vector over
+a fixed, cluster-wide ``ResourceSpec`` axis so that a whole cluster snapshot
+becomes a [N, R] array that kernels can consume directly. Two deliberate
+deviations, both documented where they matter:
+
+- "pods" (the reference's separate ``MaxTaskNum``, resource_info.go:36) is an
+  ordinary dimension here with a per-task request of 1, so the max-pods
+  predicate (predicates.go:162-166) falls out of the same resource-fit kernel.
+- scalar resources (nvidia.com/gpu etc.) are stored in *milli* units just like
+  the reference (resource_info.go:111 value.MilliValue()).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.utils.assertions import graft_assert
+
+# Minimum comparison quanta, resource_info.go:66-72.
+MIN_MILLI_CPU = 10.0
+MIN_MEMORY = 10.0 * 1024 * 1024  # 10 MiB
+MIN_MILLI_SCALAR = 10.0
+MIN_PODS = 0.1  # pods are integral; anything below one pod is "empty"
+
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+GPU = "nvidia.com/gpu"
+
+
+class ResourceSpec:
+    """The fixed resource axis of a cluster: (cpu, memory, pods, *scalars).
+
+    All Resource vectors, snapshot tensors, and kernels in one cluster share a
+    single spec so that dimension k always means the same resource. The
+    reference gets this implicitly from its struct fields + scalar map; we need
+    it explicit to build dense [T, R] / [N, R] arrays.
+    """
+
+    def __init__(self, scalar_names: Sequence[str] = (GPU,)):
+        names = [CPU, MEMORY, PODS]
+        for s in scalar_names:
+            if s in names:
+                raise ValueError(f"duplicate resource name {s!r}")
+            names.append(s)
+        self.names: Tuple[str, ...] = tuple(names)
+        self._index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        quanta = [MIN_MILLI_CPU, MIN_MEMORY, MIN_PODS]
+        quanta += [MIN_MILLI_SCALAR] * len(scalar_names)
+        self.quanta: np.ndarray = np.asarray(quanta, dtype=np.float64)
+        # "pods" is a capacity-only dimension we add on top of the reference's
+        # model (its MaxTaskNum field); it participates in fit arithmetic
+        # (add/sub/less_equal) but not in the semantic comparisons the
+        # reference defines over {cpu, memory, scalars} (Less / IsEmpty /
+        # Share), where an always-equal dimension would change the answer.
+        self.semantic_mask: np.ndarray = np.ones(len(names), dtype=bool)
+        self.semantic_mask[2] = False
+
+    @property
+    def n(self) -> int:
+        return len(self.names)
+
+    def index(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ResourceSpec) and self.names == other.names
+
+    def __hash__(self) -> int:
+        return hash(self.names)
+
+    def __repr__(self) -> str:
+        return f"ResourceSpec({self.names})"
+
+    # -- constructors -----------------------------------------------------
+    def empty(self) -> "Resource":
+        return Resource(np.zeros(self.n), self)
+
+    def build(
+        self,
+        cpu_milli: float = 0.0,
+        memory: float = 0.0,
+        pods: float = 0.0,
+        scalars: Optional[Mapping[str, float]] = None,
+    ) -> "Resource":
+        """Build a Resource (the NewResource analog, resource_info.go:99-127).
+
+        ``cpu_milli`` in milli-cores, ``memory`` in bytes, scalars in milli
+        units keyed by spec name.
+        """
+        vec = np.zeros(self.n)
+        vec[0] = float(cpu_milli)
+        vec[1] = float(memory)
+        vec[2] = float(pods)
+        if scalars:
+            for name, v in scalars.items():
+                if name not in self._index:
+                    raise KeyError(
+                        f"scalar resource {name!r} not in cluster ResourceSpec {self.names}"
+                    )
+                vec[self._index[name]] = float(v)
+        return Resource(vec, self)
+
+    def from_vec(self, vec: np.ndarray) -> "Resource":
+        return Resource(np.asarray(vec, dtype=np.float64).copy(), self)
+
+
+DEFAULT_SPEC = ResourceSpec()
+
+
+class Resource:
+    """A point on the resource-spec axis; arithmetic mirrors resource_info.go.
+
+    Immutable-by-convention: operators return new Resources; the in-place
+    mutators (add_, sub_, set_max_) are explicit and used only by the
+    accounting algebra in NodeInfo/JobInfo, like the reference's pointer
+    receivers.
+    """
+
+    __slots__ = ("vec", "spec")
+
+    def __init__(self, vec: np.ndarray, spec: ResourceSpec):
+        self.vec = np.asarray(vec, dtype=np.float64)
+        self.spec = spec
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def milli_cpu(self) -> float:
+        return float(self.vec[0])
+
+    @property
+    def memory(self) -> float:
+        return float(self.vec[1])
+
+    @property
+    def pods(self) -> float:
+        return float(self.vec[2])
+
+    def get(self, name: str) -> float:
+        return float(self.vec[self.spec.index(name)])
+
+    def clone(self) -> "Resource":
+        return Resource(self.vec.copy(), self.spec)
+
+    # -- predicates (resource_info.go:134-160) ----------------------------
+    def is_empty(self) -> bool:
+        """True iff every semantic dimension (cpu/mem/scalars, not pods) is
+        below its minimum quantum (resource_info.go:134-148)."""
+        m = self.spec.semantic_mask
+        return bool(np.all(self.vec[m] < self.spec.quanta[m]))
+
+    def is_zero(self, name: str) -> bool:
+        """True iff the named dimension is below its quantum
+        (resource_info.go:151-160)."""
+        i = self.spec.index(name)
+        return bool(self.vec[i] < self.spec.quanta[i])
+
+    # -- arithmetic -------------------------------------------------------
+    def _check(self, other: "Resource") -> None:
+        graft_assert(self.spec == other.spec, "resource spec mismatch")
+
+    def add(self, other: "Resource") -> "Resource":
+        self._check(other)
+        return Resource(self.vec + other.vec, self.spec)
+
+    def add_(self, other: "Resource") -> "Resource":
+        self._check(other)
+        self.vec = self.vec + other.vec
+        return self
+
+    def sub(self, other: "Resource") -> "Resource":
+        """Subtract, asserting no dimension underflows (resource_info.go:180-190:
+        Sub panics via assert when left < right)."""
+        self._check(other)
+        graft_assert(
+            other.less_equal(self),
+            f"resource underflow: {other} not <= {self}",
+        )
+        return Resource(np.maximum(self.vec - other.vec, 0.0), self.spec)
+
+    def sub_(self, other: "Resource") -> "Resource":
+        r = self.sub(other)
+        self.vec = r.vec
+        return self
+
+    def multi(self, ratio: float) -> "Resource":
+        """Scale every dimension (resource_info.go:193-202)."""
+        return Resource(self.vec * ratio, self.spec)
+
+    def set_max_(self, other: "Resource") -> "Resource":
+        """Elementwise max, in place (resource_info.go:205-221 SetMaxResource)."""
+        self._check(other)
+        self.vec = np.maximum(self.vec, other.vec)
+        return self
+
+    def min(self, other: "Resource") -> "Resource":
+        """Elementwise min (resource_info.go:330-341 MinDimensionResource-ish)."""
+        self._check(other)
+        return Resource(np.minimum(self.vec, other.vec), self.spec)
+
+    def fit_delta(self, other: "Resource") -> "Resource":
+        """Per-dimension shortfall of self (request) vs other (idle), used for
+        NodesFitDelta diagnostics (resource_info.go:224-250 FitDelta): for each
+        requested dimension that doesn't fit, record request − idle + quantum."""
+        self._check(other)
+        short = np.where(
+            (self.vec > 0) & (self.vec > other.vec),
+            self.vec - other.vec + self.spec.quanta,
+            0.0,
+        )
+        return Resource(short, self.spec)
+
+    def diff(self, other: "Resource") -> Tuple["Resource", "Resource"]:
+        """(increased, decreased) per dimension (resource_info.go:300-327)."""
+        self._check(other)
+        d = self.vec - other.vec
+        return (
+            Resource(np.maximum(d, 0.0), self.spec),
+            Resource(np.maximum(-d, 0.0), self.spec),
+        )
+
+    # -- comparisons (epsilon-tolerant, resource_info.go:253-297) ---------
+    def less(self, other: "Resource") -> bool:
+        """Strictly less in every semantic dimension (resource_info.go:253-266
+        Less). cpu/mem always compare; a scalar dim participates only when the
+        left side actually has some (the reference iterates the left's scalar
+        map, so absent scalars are skipped — a dense vector can't distinguish
+        absent from zero, and zero-vs-zero must not fail the comparison).
+        pods is excluded entirely (ResourceSpec.semantic_mask)."""
+        self._check(other)
+        m = self.spec.semantic_mask.copy()
+        m[3:] &= self.vec[3:] > 0
+        return bool(np.all(self.vec[m] < other.vec[m]))
+
+    def less_equal(self, other: "Resource") -> bool:
+        """<= in every dimension, tolerating sub-quantum excess
+        (resource_info.go:269-284 LessEqual: a dim passes if value <= other's
+        or the difference is below the minimum quantum)."""
+        self._check(other)
+        return bool(np.all((self.vec <= other.vec) | (self.vec - other.vec < self.spec.quanta)))
+
+    def less_equal_strict(self, other: "Resource") -> bool:
+        self._check(other)
+        return bool(np.all(self.vec <= other.vec))
+
+    def share(self, total: "Resource") -> float:
+        """Dominant share: max over dimensions of self/total, ignoring empty
+        totals (helpers/helpers.go:28-60 GetShare + drf.go:161-171)."""
+        self._check(total)
+        m = self.spec.semantic_mask
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratios = np.where(total.vec[m] > 0, self.vec[m] / total.vec[m], 0.0)
+        return float(np.max(ratios)) if ratios.size else 0.0
+
+    # -- dunder sugar -----------------------------------------------------
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Resource)
+            and self.spec == other.spec
+            and bool(np.all(np.abs(self.vec - other.vec) < 1e-9))
+        )
+
+    def __hash__(self):
+        raise TypeError("Resource is not hashable")
+
+    def __repr__(self) -> str:
+        parts = [
+            f"{n}={self.vec[i]:g}"
+            for i, n in enumerate(self.spec.names)
+            if self.vec[i] != 0
+        ]
+        return f"Resource({', '.join(parts) or 'empty'})"

@@ -1,0 +1,116 @@
+"""TaskInfo — the scheduler's view of one pod.
+
+Mirrors pkg/scheduler/api/job_info.go:36-124: UID, owning Job, Resreq (sum of
+container requests), InitResreq (max of that sum with each init container,
+pod_info.go:53-73), NodeName, Status, Priority, and a backref to the ingested
+Pod object.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Set, Tuple
+
+from kube_batch_tpu.api.pod import Pod, GROUP_NAME_ANNOTATION
+from kube_batch_tpu.api.resources import Resource, ResourceSpec, PODS
+from kube_batch_tpu.api.types import TaskStatus, pod_phase_to_status
+
+logger = logging.getLogger("kube_batch_tpu")
+_warned_unknown_scalars: Set[Tuple[Tuple[str, ...], str]] = set()
+
+
+def job_id_for_pod(pod: Pod) -> str:
+    """JobID for a pod (job_info.go:56-66): namespace/group-name if the
+    group annotation is present, else the pod's own namespace/name (a shadow
+    single-task job will be synthesized by the cache)."""
+    group = pod.group_name
+    if group:
+        return f"{pod.namespace}/{group}"
+    return f"{pod.namespace}/{pod.name}"
+
+
+def _requests_to_resource(requests: Dict[str, float], spec: ResourceSpec) -> Resource:
+    vec = spec.empty()
+    for name, v in requests.items():
+        if name in spec:
+            vec.vec[spec.index(name)] = float(v)
+        else:
+            # The reference models every scalar it sees (resource_info.go:99-127);
+            # our dense axis is fixed at cache construction, so an unmodeled
+            # scalar can't gate placement — warn once so misconfigured specs
+            # don't silently overcommit that resource.
+            key = (spec.names, name)
+            if key not in _warned_unknown_scalars:
+                _warned_unknown_scalars.add(key)
+                logger.warning(
+                    "dropping request for resource %r not in cluster ResourceSpec %s",
+                    name,
+                    spec.names,
+                )
+    vec.vec[spec.index(PODS)] = 1.0  # every task occupies one pod slot
+    return vec
+
+
+class TaskInfo:
+    __slots__ = (
+        "uid",
+        "job",
+        "name",
+        "namespace",
+        "resreq",
+        "init_resreq",
+        "node_name",
+        "status",
+        "priority",
+        "volume_ready",
+        "pod",
+    )
+
+    def __init__(self, pod: Pod, spec: ResourceSpec):
+        self.uid: str = pod.uid
+        self.job: str = job_id_for_pod(pod)
+        self.name: str = pod.name
+        self.namespace: str = pod.namespace
+        # Resreq = sum of app-container requests (job_info.go:73-80)
+        self.resreq: Resource = _requests_to_resource(pod.requests, spec)
+        # InitResreq = max(Resreq, each init container) (pod_info.go:53-73);
+        # ingest supplies the already-maxed init_requests map.
+        self.init_resreq: Resource = self.resreq.clone()
+        if pod.init_requests:
+            self.init_resreq.set_max_(_requests_to_resource(pod.init_requests, spec))
+        self.node_name: Optional[str] = pod.node_name
+        self.status: TaskStatus = pod_phase_to_status(pod.phase, pod.node_name, pod.deleting)
+        self.priority: int = pod.priority
+        self.volume_ready: bool = False
+        self.pod: Pod = pod
+
+    @property
+    def best_effort(self) -> bool:
+        """BestEffort = empty InitResreq (is_empty already ignores the pods
+        dimension) — these are skipped by allocate (allocate.go:126-129) and
+        placed by backfill (backfill.go:55-89)."""
+        return self.init_resreq.is_empty()
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.volume_ready = self.volume_ready
+        t.pod = self.pod
+        return t
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def __repr__(self) -> str:
+        return (
+            f"TaskInfo({self.namespace}/{self.name} job={self.job} "
+            f"status={self.status.name} node={self.node_name} req={self.resreq})"
+        )

@@ -1,0 +1,87 @@
+"""Status enums and phase mappings.
+
+Mirrors pkg/scheduler/api/types.go:24-58 (TaskStatus), helpers.go:35-71
+(pod-phase mapping and AllocatedStatus), and
+pkg/apis/scheduling/v1alpha1/types.go:28-73 (PodGroup phases/conditions).
+
+TaskStatus values are stable small ints on purpose: they are embedded directly
+into the device snapshot's ``task_status`` int8 array, and the assignment
+kernel's status algebra (ops/assignment.py) branches on them numerically.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskStatus(enum.IntEnum):
+    """Task lifecycle states (types.go:24-58)."""
+
+    PENDING = 0      # not scheduled
+    ALLOCATED = 1    # resources assigned this session, not yet dispatched
+    PIPELINED = 2    # assigned onto resources that are still being released
+    BINDING = 3      # bind RPC in flight
+    BOUND = 4        # bind acknowledged
+    RUNNING = 5
+    RELEASING = 6    # eviction/deletion in flight
+    SUCCEEDED = 7
+    FAILED = 8
+    UNKNOWN = 9
+
+
+# Statuses that occupy real (not future) node resources, helpers.go:63-71.
+ALLOCATED_STATUSES = frozenset(
+    {TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING, TaskStatus.ALLOCATED}
+)
+
+
+def is_allocated(status: TaskStatus) -> bool:
+    return status in ALLOCATED_STATUSES
+
+
+class PodPhase(str, enum.Enum):
+    """The subset of pod phases the cache consumes (helpers.go:35-61)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+def pod_phase_to_status(phase: "PodPhase", node_name: str | None, deleting: bool = False) -> TaskStatus:
+    """Map an ingested pod's phase+nodeName+DeletionTimestamp to a TaskStatus
+    (helpers.go:35-61 getTaskStatus): the deletion override applies only to
+    Running and Pending pods; Succeeded/Failed keep their terminal status."""
+    if phase == PodPhase.RUNNING:
+        return TaskStatus.RELEASING if deleting else TaskStatus.RUNNING
+    if phase == PodPhase.PENDING:
+        if deleting:
+            return TaskStatus.RELEASING
+        return TaskStatus.BOUND if node_name else TaskStatus.PENDING
+    if phase == PodPhase.SUCCEEDED:
+        return TaskStatus.SUCCEEDED
+    if phase == PodPhase.FAILED:
+        return TaskStatus.FAILED
+    return TaskStatus.UNKNOWN
+
+
+class PodGroupPhase(str, enum.Enum):
+    """PodGroup lifecycle (apis/scheduling/v1alpha1/types.go:28-43)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    INQUEUE = "Inqueue"
+
+
+class PodGroupConditionType(str, enum.Enum):
+    """(types.go:45-52)"""
+
+    UNSCHEDULABLE = "Unschedulable"
+
+
+# Canonical unschedulable-event reasons (unschedule_info.go:11-19).
+NODE_POD_NUMBER_EXCEEDED = "node(s) pod number exceeded"
+NODE_RESOURCE_FIT_FAILED = "node(s) resource fit failed"
+ALL_NODES_UNAVAILABLE = "all nodes are unavailable"

@@ -1,0 +1,4 @@
+from kube_batch_tpu.utils.assertions import graft_assert
+from kube_batch_tpu.utils.priority_queue import PriorityQueue
+
+__all__ = ["graft_assert", "PriorityQueue"]

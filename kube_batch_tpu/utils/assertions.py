@@ -1,0 +1,38 @@
+"""Invariant assertions with an opt-out env switch.
+
+Mirrors the reference's pkg/scheduler/util/assert/assert.go:11-36: invariant
+violations panic by default, but setting PANIC_ON_ERROR=false downgrades them
+to logged warnings so a production loop can limp along and self-correct on the
+next scheduling cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import traceback
+
+logger = logging.getLogger("kube_batch_tpu")
+
+_ENV_KEY = "PANIC_ON_ERROR"
+
+
+def _panic_enabled() -> bool:
+    return os.environ.get(_ENV_KEY, "true").lower() != "false"
+
+
+class InvariantError(AssertionError):
+    """Raised when a scheduler invariant (e.g. resource underflow) is broken."""
+
+
+def graft_assert(condition: bool, message: str = "invariant violated") -> None:
+    """Assert a scheduler invariant (assert.go:25-36).
+
+    Raises InvariantError unless env PANIC_ON_ERROR=false, in which case the
+    violation (with stack) is logged and execution continues.
+    """
+    if condition:
+        return
+    if _panic_enabled():
+        raise InvariantError(message)
+    logger.error("invariant violated: %s\n%s", message, "".join(traceback.format_stack()))

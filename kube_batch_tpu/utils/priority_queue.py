@@ -1,0 +1,62 @@
+"""A less-fn parameterized priority queue.
+
+Mirrors the reference's pkg/scheduler/util/priority_queue.go:26-94 (a
+container/heap over an api.LessFn). Used by the host-side portions of the
+actions (queue/job ordering) exactly like the reference's actions use it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Iterable, Optional
+
+
+class PriorityQueue:
+    """Heap ordered by a caller-supplied ``less(a, b)`` function.
+
+    ``less(a, b) == True`` means ``a`` pops before ``b``. Ties break by
+    insertion order (stable), matching the deterministic behavior tests rely
+    on in the reference's priority_queue_test.go.
+    """
+
+    def __init__(self, less: Callable[[Any, Any], bool], items: Iterable[Any] = ()):
+        self._less = less
+        self._counter = itertools.count()
+        self._heap: list = []
+        for it in items:
+            self.push(it)
+
+    def push(self, item: Any) -> None:
+        heapq.heappush(self._heap, _Entry(item, next(self._counter), self._less))
+
+    def pop(self) -> Any:
+        return heapq.heappop(self._heap).item
+
+    def peek(self) -> Any:
+        return self._heap[0].item
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:  # truthiness = "has items", like Empty() inverted
+        return bool(self._heap)
+
+
+class _Entry:
+    __slots__ = ("item", "seq", "less")
+
+    def __init__(self, item: Any, seq: int, less: Callable[[Any, Any], bool]):
+        self.item = item
+        self.seq = seq
+        self.less = less
+
+    def __lt__(self, other: "_Entry") -> bool:
+        if self.less(self.item, other.item):
+            return True
+        if self.less(other.item, self.item):
+            return False
+        return self.seq < other.seq

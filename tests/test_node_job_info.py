@@ -1,0 +1,196 @@
+"""Status-algebra tests for NodeInfo/JobInfo — analog of
+api/node_info_test.go and api/job_info_test.go (AddTask/RemoveTask deltas,
+status index consistency, gang predicates)."""
+
+import pytest
+
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.pod import Node, Pod, PodGroup
+from kube_batch_tpu.api.resources import DEFAULT_SPEC
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import PodPhase, TaskStatus
+
+
+def make_node(cpu=4000.0, mem=8 * 2**30, pods=110):
+    return NodeInfo(
+        Node(name="n1", allocatable={"cpu": cpu, "memory": mem, "pods": pods}), DEFAULT_SPEC
+    )
+
+
+def make_task(name="p1", cpu=1000.0, mem=2**30, phase=PodPhase.RUNNING, node="n1"):
+    pod = Pod(name=name, requests={"cpu": cpu, "memory": mem}, phase=phase, node_name=node)
+    return TaskInfo(pod, DEFAULT_SPEC)
+
+
+class TestNodeAlgebra:
+    def test_running_task_consumes_idle(self):
+        n = make_node()
+        t = make_task()
+        n.add_task(t)
+        assert n.idle.milli_cpu == 3000
+        assert n.used.milli_cpu == 1000
+        assert n.idle.pods == 109
+        n.remove_task(t)
+        assert n.idle.milli_cpu == 4000
+        assert n.used.milli_cpu == 0
+
+    def test_releasing_task_moves_to_releasing(self):
+        # Releasing: Releasing += r; Idle -= r; Used += r (node_info.go:165-193)
+        n = make_node()
+        t = make_task()
+        t.status = TaskStatus.RELEASING
+        n.add_task(t)
+        assert n.releasing.milli_cpu == 1000
+        assert n.idle.milli_cpu == 3000
+        assert n.used.milli_cpu == 1000
+
+    def test_pipelined_task_consumes_releasing(self):
+        n = make_node()
+        victim = make_task("victim")
+        victim.status = TaskStatus.RELEASING
+        n.add_task(victim)
+        incoming = make_task("incoming")
+        incoming.status = TaskStatus.PIPELINED
+        n.add_task(incoming)
+        # pipelined eats the future resources, not idle
+        assert n.releasing.milli_cpu == 0
+        assert n.idle.milli_cpu == 3000
+        assert n.used.milli_cpu == 2000
+
+    def test_update_task_status_via_node(self):
+        n = make_node()
+        t = make_task()
+        n.add_task(t)
+        t2 = t.clone()
+        t2.status = TaskStatus.RELEASING
+        n.update_task(t2)
+        assert n.releasing.milli_cpu == 1000
+        assert n.used.milli_cpu == 1000
+
+    def test_pending_task_no_accounting(self):
+        n = make_node()
+        t = make_task(phase=PodPhase.PENDING, node=None)
+        n.add_task(t)
+        assert n.idle.milli_cpu == 4000 and n.used.milli_cpu == 0
+
+
+class TestJobInfo:
+    def make_job(self, min_member=2):
+        pg = PodGroup(name="pg1", min_member=min_member, queue="default")
+        return JobInfo("default/pg1", DEFAULT_SPEC, pg)
+
+    def test_status_index_and_aggregates(self):
+        j = self.make_job()
+        t1 = make_task("p1", phase=PodPhase.RUNNING)
+        t2 = make_task("p2", phase=PodPhase.PENDING, node=None)
+        j.add_task(t1)
+        j.add_task(t2)
+        assert j.ready_task_num == 1
+        assert j.allocated.milli_cpu == 1000
+        assert j.total_request.milli_cpu == 2000
+        j.update_task_status(t2, TaskStatus.ALLOCATED)
+        assert j.ready_task_num == 2
+        assert j.allocated.milli_cpu == 2000
+        assert j.ready()
+
+    def test_gang_predicates(self):
+        j = self.make_job(min_member=2)
+        t1 = make_task("p1", phase=PodPhase.RUNNING)
+        j.add_task(t1)
+        assert not j.ready()
+        t2 = make_task("p2", phase=PodPhase.PENDING, node=None)
+        j.add_task(t2)
+        j.update_task_status(t2, TaskStatus.PIPELINED)
+        assert not j.ready()
+        assert j.pipelined()  # ready + waiting >= minAvailable (job_info.go:383-418)
+
+    def test_delete_task(self):
+        j = self.make_job()
+        t1 = make_task("p1", phase=PodPhase.RUNNING)
+        j.add_task(t1)
+        j.delete_task(t1)
+        assert j.ready_task_num == 0
+        assert j.total_request.milli_cpu == 0
+        assert len(j.tasks) == 0
+
+    def test_clone_is_deep(self):
+        j = self.make_job()
+        t1 = make_task("p1", phase=PodPhase.RUNNING)
+        j.add_task(t1)
+        c = j.clone()
+        c.update_task_status(list(c.tasks.values())[0], TaskStatus.RELEASING)
+        assert j.ready_task_num == 1  # original untouched
+        assert c.ready_task_num == 0
+
+    def test_best_effort_task(self):
+        t = TaskInfo(Pod(name="be", requests={}), DEFAULT_SPEC)
+        assert t.best_effort
+        assert not make_task().best_effort
+
+    def test_init_resreq_max(self):
+        pod = Pod(
+            name="p", requests={"cpu": 500}, init_requests={"cpu": 2000, "memory": 100}
+        )
+        t = TaskInfo(pod, DEFAULT_SPEC)
+        assert t.resreq.milli_cpu == 500
+        assert t.init_resreq.milli_cpu == 2000
+        assert t.init_resreq.memory == 100
+
+
+class TestReviewRegressions:
+    """Fidelity fixes found in review against the reference sources."""
+
+    def test_succeeded_counts_toward_ready(self):
+        # job_info.go ReadyTaskNum counts AllocatedStatus + Succeeded
+        pg = PodGroup(name="pg2", min_member=3, queue="default")
+        j = JobInfo("default/pg2", DEFAULT_SPEC, pg)
+        for i, phase in enumerate([PodPhase.RUNNING, PodPhase.RUNNING, PodPhase.SUCCEEDED]):
+            j.add_task(make_task(f"t{i}", phase=phase))
+        assert j.ready_task_num == 3
+        assert j.ready()
+
+    def test_valid_task_num_excludes_releasing(self):
+        # job_info.go ValidTaskNum: AllocatedStatus+Succeeded+Pipelined+Pending
+        pg = PodGroup(name="pg3", min_member=2, queue="default")
+        j = JobInfo("default/pg3", DEFAULT_SPEC, pg)
+        t1 = make_task("a", phase=PodPhase.RUNNING)
+        t2 = make_task("b", phase=PodPhase.SUCCEEDED)
+        t3 = make_task("c", phase=PodPhase.RUNNING)
+        j.add_task(t1)
+        j.add_task(t2)
+        j.add_task(t3)
+        j.update_task_status(t3, TaskStatus.RELEASING)
+        assert j.valid_task_num == 2
+
+    def test_set_node_replays_tasks(self):
+        # node_info.go SetNode: pods ingested before their node must be
+        # re-accounted once the node arrives
+        n = NodeInfo(None, DEFAULT_SPEC)
+        t = make_task("early", phase=PodPhase.RUNNING)
+        n.add_task(t)
+        assert n.used.milli_cpu == 0  # no node yet, no accounting
+        n.set_node(Node(name="n1", allocatable={"cpu": 4000, "memory": 8 * 2**30, "pods": 110}))
+        assert n.used.milli_cpu == 1000
+        assert n.idle.milli_cpu == 3000
+
+    def test_node_holds_task_copy(self):
+        # node_info.go:165-168: caller-side status mutation must not
+        # desynchronize the node's reversal algebra
+        n = make_node()
+        t = make_task()
+        n.add_task(t)
+        t.status = TaskStatus.RELEASING  # mutate caller's object
+        n.remove_task(t)  # reverses under the stored (RUNNING) status
+        assert n.idle.milli_cpu == 4000
+        assert n.used.milli_cpu == 0
+        assert n.releasing.milli_cpu == 0
+
+    def test_deleting_terminal_pod_keeps_status(self):
+        # helpers.go getTaskStatus: deletion override only for Running/Pending
+        pod = Pod(name="done", requests={"cpu": 100}, phase=PodPhase.SUCCEEDED,
+                  node_name="n1", deleting=True)
+        assert TaskInfo(pod, DEFAULT_SPEC).status == TaskStatus.SUCCEEDED
+        pod2 = Pod(name="dying", requests={"cpu": 100}, phase=PodPhase.RUNNING,
+                   node_name="n1", deleting=True)
+        assert TaskInfo(pod2, DEFAULT_SPEC).status == TaskStatus.RELEASING

@@ -1,0 +1,22 @@
+"""Heap ordering tests — analog of util/priority_queue_test.go."""
+
+from kube_batch_tpu.utils.priority_queue import PriorityQueue
+
+
+def test_orders_by_less_fn():
+    pq = PriorityQueue(less=lambda a, b: a < b, items=[5, 1, 4, 2, 3])
+    assert [pq.pop() for _ in range(len(pq))] == [1, 2, 3, 4, 5]
+
+
+def test_ties_are_fifo():
+    pq = PriorityQueue(less=lambda a, b: a[0] < b[0])
+    for item in [(1, "a"), (1, "b"), (0, "c"), (1, "d")]:
+        pq.push(item)
+    assert [pq.pop() for _ in range(len(pq))] == [(0, "c"), (1, "a"), (1, "b"), (1, "d")]
+
+
+def test_empty_and_len():
+    pq = PriorityQueue(less=lambda a, b: a < b)
+    assert pq.empty() and not pq
+    pq.push(1)
+    assert not pq.empty() and len(pq) == 1 and pq.peek() == 1

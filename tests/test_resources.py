@@ -1,0 +1,109 @@
+"""Table-driven tests of Resource arithmetic — the rebuild's analog of
+pkg/scheduler/api/resource_info_test.go (epsilon semantics, add/sub/setmax,
+fit comparisons)."""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.resources import (
+    DEFAULT_SPEC,
+    GPU,
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    Resource,
+    ResourceSpec,
+)
+from kube_batch_tpu.utils.assertions import InvariantError
+
+
+def R(cpu=0.0, mem=0.0, pods=0.0, gpu=None):
+    return DEFAULT_SPEC.build(
+        cpu_milli=cpu, memory=mem, pods=pods, scalars={GPU: gpu} if gpu is not None else None
+    )
+
+
+class TestIsEmpty:
+    def test_zero_is_empty(self):
+        assert R().is_empty()
+
+    def test_below_quantum_is_empty(self):
+        assert R(cpu=MIN_MILLI_CPU - 1, mem=MIN_MEMORY - 1).is_empty()
+
+    def test_at_quantum_not_empty(self):
+        assert not R(cpu=MIN_MILLI_CPU).is_empty()
+
+    def test_is_zero_per_dim(self):
+        r = R(cpu=100)
+        assert not r.is_zero("cpu")
+        assert r.is_zero("memory")
+
+
+class TestArithmetic:
+    def test_add(self):
+        assert R(cpu=100, mem=10).add(R(cpu=50, mem=5)) == R(cpu=150, mem=15)
+
+    def test_sub(self):
+        assert R(cpu=100, mem=10).sub(R(cpu=40, mem=10)) == R(cpu=60, mem=0)
+
+    def test_sub_underflow_asserts(self):
+        with pytest.raises(InvariantError):
+            R(cpu=100).sub(R(cpu=200))
+
+    def test_sub_tolerates_subquantum_excess(self):
+        # LessEqual tolerance (resource_info.go:269-284): excess below the
+        # quantum doesn't count as underflow, and the result clamps at 0.
+        out = R(cpu=100).sub(R(cpu=100 + MIN_MILLI_CPU / 2))
+        assert out.milli_cpu == 0.0
+
+    def test_multi(self):
+        assert R(cpu=100, mem=10).multi(1.2) == R(cpu=120, mem=12)
+
+    def test_set_max(self):
+        r = R(cpu=100, mem=5)
+        r.set_max_(R(cpu=50, mem=10))
+        assert r == R(cpu=100, mem=10)
+
+    def test_min(self):
+        assert R(cpu=100, mem=5).min(R(cpu=50, mem=10)) == R(cpu=50, mem=5)
+
+    def test_diff(self):
+        inc, dec = R(cpu=100, mem=5).diff(R(cpu=40, mem=8))
+        assert inc == R(cpu=60)
+        assert dec == R(mem=3)
+
+
+class TestComparisons:
+    def test_less(self):
+        assert R(cpu=1, mem=1).less(R(cpu=2, mem=2))
+        assert not R(cpu=1, mem=3).less(R(cpu=2, mem=2))
+
+    def test_less_equal_tolerant(self):
+        assert R(cpu=100).less_equal(R(cpu=100))
+        assert R(cpu=100 + MIN_MILLI_CPU - 1).less_equal(R(cpu=100))
+        assert not R(cpu=100 + MIN_MILLI_CPU).less_equal(R(cpu=100))
+
+    def test_fit_delta(self):
+        short = R(cpu=100, mem=0).fit_delta(R(cpu=40, mem=50))
+        assert short.milli_cpu == 100 - 40 + MIN_MILLI_CPU
+        assert short.memory == 0  # nothing requested → no shortfall
+
+    def test_share(self):
+        total = R(cpu=1000, mem=1000)
+        assert R(cpu=500, mem=250).share(total) == pytest.approx(0.5)
+        assert R().share(total) == 0.0
+
+
+class TestSpec:
+    def test_unknown_scalar_rejected(self):
+        with pytest.raises(KeyError):
+            DEFAULT_SPEC.build(scalars={"example.com/fpga": 1})
+
+    def test_custom_spec(self):
+        spec = ResourceSpec(scalar_names=("nvidia.com/gpu", "cloud.com/npu"))
+        r = spec.build(scalars={"cloud.com/npu": 4000})
+        assert r.get("cloud.com/npu") == 4000
+
+    def test_spec_mismatch_asserts(self):
+        other = ResourceSpec(scalar_names=())
+        with pytest.raises(InvariantError):
+            R(cpu=1).add(other.build(cpu_milli=1))
