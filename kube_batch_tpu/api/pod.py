@@ -115,7 +115,11 @@ class PodGroup:
     queue: str = ""
     priority_class: str = ""
     min_resources: Optional[Dict[str, float]] = None
-    phase: PodGroupPhase = PodGroupPhase.PENDING
+    # None = zero-value phase: a PodGroup created without status passes the
+    # allocate action's Pending-phase gate (allocate.go:50-52 only skips an
+    # explicit PodGroupPending; the enqueue action only promotes explicit
+    # Pending to Inqueue, enqueue.go:66,115)
+    phase: Optional[PodGroupPhase] = None
     conditions: List["PodGroupCondition"] = dataclasses.field(default_factory=list)
     running: int = 0
     succeeded: int = 0

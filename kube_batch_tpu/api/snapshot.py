@@ -1,0 +1,353 @@
+"""DeviceSnapshot — the cluster state as device-resident SoA tensors.
+
+This is the TPU-native replacement for the reference's per-session object
+snapshot (cache.go:584-654 Snapshot + cluster_info.go). Instead of deep-cloned
+Go object graphs walked by 16-worker loops, one scheduling cycle ships a
+structure-of-arrays image of (tasks × R, nodes × R, jobs, queues) to the
+device once, runs the compiled feasibility/score/fairness/assignment programs
+on it, and ships one assignment vector back (SURVEY.md §7.1).
+
+Label/selector/taint matching is pre-compiled host-side into bitsets
+(SURVEY.md §7.3 "string/label matching on device"): every distinct (key,value)
+label pair carried by any node gets a bit; a task's node-selector becomes a
+required-bits mask; every distinct node taint gets a bit and a task's
+tolerations become a tolerated-bits mask. The device then evaluates
+selector/taint predicates as pure bitwise ops.
+
+All axes are padded to power-of-two buckets so jit specializes on a small set
+of shapes (SURVEY.md §7.3 "dynamic shapes").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.resources import ResourceSpec
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+
+BITS = 32
+# Effects that hard-exclude a node (PreferNoSchedule is a soft preference the
+# reference handles in scoring, not predicates).
+HARD_TAINT_EFFECTS = ("NoSchedule", "NoExecute")
+# Capability value meaning "unbounded" (queue without a Capability cap).
+UNBOUNDED = np.float32(3.4e38)
+
+
+def bucket(n: int, floor: int = 8) -> int:
+    """Next power-of-two bucket ≥ max(n, floor) — bounds jit recompiles."""
+    return max(floor, 1 << max(0, math.ceil(math.log2(max(n, 1)))))
+
+
+class DeviceSnapshot(NamedTuple):
+    """The per-cycle tensor image. All arrays live on device; rows beyond the
+    live count are padding with their `*_valid` bit off."""
+
+    # tasks [T, ...]
+    task_req: "np.ndarray"          # [T, R] f32 — InitResreq (allocate fits on this)
+    task_resreq: "np.ndarray"       # [T, R] f32 — Resreq (node accounting uses this)
+    task_job: "np.ndarray"          # [T] i32 — index into job axis (0 for padding)
+    task_prio: "np.ndarray"         # [T] i32
+    task_creation: "np.ndarray"     # [T] i32
+    task_status: "np.ndarray"       # [T] i32 — TaskStatus values
+    task_valid: "np.ndarray"        # [T] bool
+    task_pending: "np.ndarray"      # [T] bool — Pending and not BestEffort
+    task_best_effort: "np.ndarray"  # [T] bool
+    task_sel_bits: "np.ndarray"     # [T, W] u32 — required label bits
+    task_sel_impossible: "np.ndarray"  # [T] bool — selector wants a pair no node has
+    task_tol_bits: "np.ndarray"     # [T, Wt] u32 — tolerated taint bits
+    # nodes [N, ...]
+    node_idle: "np.ndarray"         # [N, R] f32
+    node_releasing: "np.ndarray"    # [N, R] f32
+    node_used: "np.ndarray"         # [N, R] f32
+    node_alloc: "np.ndarray"        # [N, R] f32 — allocatable
+    node_valid: "np.ndarray"        # [N] bool — Ready (node_info.go:110-134)
+    node_sched: "np.ndarray"        # [N] bool — not Unschedulable (predicates.go:181-192)
+    node_label_bits: "np.ndarray"   # [N, W] u32
+    node_taint_bits: "np.ndarray"   # [N, Wt] u32 — hard-effect taints present
+    # jobs [J, ...]
+    job_min_avail: "np.ndarray"     # [J] i32
+    job_ready: "np.ndarray"         # [J] i32 — ReadyTaskNum at snapshot time
+    job_queue: "np.ndarray"         # [J] i32 — index into queue axis
+    job_prio: "np.ndarray"          # [J] i32
+    job_creation: "np.ndarray"      # [J] i32
+    job_valid: "np.ndarray"         # [J] bool — gang-valid and in a known queue
+    job_schedulable: "np.ndarray"   # [J] bool — passes the Pending-phase gate
+    job_allocated: "np.ndarray"     # [J, R] f32 — for DRF shares
+    # queues [Q, ...]
+    queue_weight: "np.ndarray"      # [Q] f32
+    queue_capability: "np.ndarray"  # [Q, R] f32 (UNBOUNDED where uncapped)
+    queue_alloc: "np.ndarray"       # [Q, R] f32
+    queue_request: "np.ndarray"     # [Q, R] f32 — total request of queue's jobs
+    queue_valid: "np.ndarray"       # [Q] bool
+    # cluster
+    total: "np.ndarray"             # [R] f32 — Σ allocatable over valid nodes
+    quanta: "np.ndarray"            # [R] f32 — comparison quanta
+
+
+@dataclasses.dataclass
+class SnapshotMeta:
+    """Host-side index maps for decoding device results back to objects."""
+
+    spec: ResourceSpec
+    task_keys: List[str]            # task index → "ns/name"
+    node_names: List[str]           # node index → name
+    job_uids: List[str]             # job index → JobInfo.uid
+    queue_names: List[str]          # queue index → name
+    label_pair_bit: Dict[Tuple[str, str], int]
+    taint_bit: Dict[Tuple[str, str, str], int]
+    n_tasks: int
+    n_nodes: int
+    n_jobs: int
+    n_queues: int
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return (len(self.task_keys), len(self.node_names), len(self.job_uids), len(self.queue_names))
+
+
+def _pack_bits(bit_indices: List[int], words: int) -> np.ndarray:
+    out = np.zeros(words, dtype=np.uint32)
+    for b in bit_indices:
+        out[b // BITS] |= np.uint32(1 << (b % BITS))
+    return out
+
+
+def build_snapshot(
+    cluster: ClusterInfo,
+    pad: bool = True,
+) -> Tuple[DeviceSnapshot, SnapshotMeta]:
+    """Flatten a host ClusterInfo into the SoA tensor image.
+
+    Only gang-valid jobs in known queues contribute schedulable tasks (the
+    session-open drop of invalid jobs, session.go:107-124, is applied by the
+    caller; here job_valid additionally guards padding). Every task of every
+    job is included (the kernels need resident tasks for accounting), but only
+    Pending non-BestEffort tasks are marked task_pending.
+    """
+    spec = cluster.spec
+    R = spec.n
+
+    queues = sorted(cluster.queues.values(), key=lambda q: q.name)
+    queue_idx = {q.name: i for i, q in enumerate(queues)}
+    jobs = sorted(cluster.jobs.values(), key=lambda j: j.uid)
+    job_idx = {j.uid: i for i, j in enumerate(jobs)}
+    nodes = sorted((n for n in cluster.nodes.values()), key=lambda n: n.name)
+    node_idx = {n.name: i for i, n in enumerate(nodes)}
+
+    tasks = []
+    for j in jobs:
+        for t in sorted(j.tasks.values(), key=lambda t: t.key()):
+            tasks.append((t, job_idx[j.uid]))
+
+    nT, nN, nJ, nQ = len(tasks), len(nodes), len(jobs), len(queues)
+    T = bucket(nT) if pad else max(nT, 1)
+    N = bucket(nN) if pad else max(nN, 1)
+    J = bucket(nJ) if pad else max(nJ, 1)
+    Q = bucket(nQ) if pad else max(nQ, 1)
+
+    # ---- label / taint interning over the node universe -----------------
+    label_pair_bit: Dict[Tuple[str, str], int] = {}
+    taint_bit: Dict[Tuple[str, str, str], int] = {}
+    for n in nodes:
+        if n.node is None:
+            continue
+        for k, v in n.node.labels.items():
+            label_pair_bit.setdefault((k, v), len(label_pair_bit))
+        for taint in n.node.taints:
+            if taint.effect in HARD_TAINT_EFFECTS:
+                taint_bit.setdefault((taint.key, taint.value, taint.effect), len(taint_bit))
+    W = max(1, -(-len(label_pair_bit) // BITS))
+    Wt = max(1, -(-len(taint_bit) // BITS))
+
+    # ---- tasks ----------------------------------------------------------
+    task_req = np.zeros((T, R), np.float32)
+    task_resreq = np.zeros((T, R), np.float32)
+    task_job = np.zeros(T, np.int32)
+    task_prio = np.zeros(T, np.int32)
+    task_creation = np.zeros(T, np.int32)
+    task_status = np.full(T, int(TaskStatus.UNKNOWN), np.int32)
+    task_valid = np.zeros(T, bool)
+    task_pending = np.zeros(T, bool)
+    task_best_effort = np.zeros(T, bool)
+    task_sel_bits = np.zeros((T, W), np.uint32)
+    task_sel_impossible = np.zeros(T, bool)
+    task_tol_bits = np.zeros((T, Wt), np.uint32)
+    task_keys: List[str] = []
+
+    taint_list = list(taint_bit.items())  # [((k,v,effect), bit)]
+    for i, (t, ji) in enumerate(tasks):
+        task_keys.append(t.key())
+        task_req[i] = t.init_resreq.vec
+        task_resreq[i] = t.resreq.vec
+        task_job[i] = ji
+        task_prio[i] = t.priority
+        task_creation[i] = t.pod.creation_index
+        task_status[i] = int(t.status)
+        task_valid[i] = True
+        task_best_effort[i] = t.best_effort
+        task_pending[i] = t.status == TaskStatus.PENDING and not t.best_effort
+        # node-selector → required bits (MatchNodeSelector, predicates.go:194-205)
+        sel_bits: List[int] = []
+        for k, v in t.pod.node_selector.items():
+            b = label_pair_bit.get((k, v))
+            if b is None:
+                task_sel_impossible[i] = True  # no node carries this pair
+            else:
+                sel_bits.append(b)
+        # required node-affinity terms with single-value In requirements fold
+        # into the same required-bit mask; richer expressions are handled by
+        # the host-side predicate fallback (plugins/predicates.py).
+        task_sel_bits[i] = _pack_bits(sel_bits, W)
+        # tolerations → tolerated-taint bits (PodToleratesNodeTaints,
+        # predicates.go:220-231): bit set iff some toleration tolerates taint
+        tol_bits = [
+            bit
+            for (tk, tv, te), bit in taint_list
+            if any(
+                tol.tolerates(_TaintView(tk, tv, te)) for tol in t.pod.tolerations
+            )
+        ]
+        task_tol_bits[i] = _pack_bits(tol_bits, Wt)
+
+    # ---- nodes ----------------------------------------------------------
+    node_idle = np.zeros((N, R), np.float32)
+    node_releasing = np.zeros((N, R), np.float32)
+    node_used = np.zeros((N, R), np.float32)
+    node_alloc = np.zeros((N, R), np.float32)
+    node_valid = np.zeros(N, bool)
+    node_sched = np.zeros(N, bool)
+    node_label_bits = np.zeros((N, W), np.uint32)
+    node_taint_bits = np.zeros((N, Wt), np.uint32)
+    node_names: List[str] = []
+    for i, n in enumerate(nodes):
+        node_names.append(n.name)
+        node_idle[i] = n.idle.vec
+        node_releasing[i] = n.releasing.vec
+        node_used[i] = n.used.vec
+        node_alloc[i] = n.allocatable.vec
+        node_valid[i] = n.ready
+        if n.node is not None:
+            node_sched[i] = not n.node.unschedulable
+            node_label_bits[i] = _pack_bits(
+                [label_pair_bit[(k, v)] for k, v in n.node.labels.items()], W
+            )
+            node_taint_bits[i] = _pack_bits(
+                [
+                    taint_bit[(t.key, t.value, t.effect)]
+                    for t in n.node.taints
+                    if t.effect in HARD_TAINT_EFFECTS
+                ],
+                Wt,
+            )
+
+    # ---- jobs -----------------------------------------------------------
+    job_min_avail = np.zeros(J, np.int32)
+    job_ready = np.zeros(J, np.int32)
+    job_queue = np.zeros(J, np.int32)
+    job_prio = np.zeros(J, np.int32)
+    job_creation = np.zeros(J, np.int32)
+    job_valid = np.zeros(J, bool)
+    job_schedulable = np.zeros(J, bool)
+    job_allocated = np.zeros((J, R), np.float32)
+    job_uids: List[str] = []
+    for i, j in enumerate(jobs):
+        job_uids.append(j.uid)
+        job_min_avail[i] = j.min_available
+        job_ready[i] = j.ready_task_num
+        job_queue[i] = queue_idx.get(j.queue, 0)
+        job_prio[i] = j.priority
+        job_creation[i] = j.creation_index
+        job_valid[i] = j.queue in queue_idx
+        phase = j.pod_group.phase if j.pod_group else None
+        job_schedulable[i] = phase != PodGroupPhase.PENDING
+        job_allocated[i] = j.allocated.vec
+
+    # ---- queues ---------------------------------------------------------
+    queue_weight = np.ones(Q, np.float32)
+    queue_capability = np.full((Q, R), UNBOUNDED, np.float32)
+    queue_alloc = np.zeros((Q, R), np.float32)
+    queue_request = np.zeros((Q, R), np.float32)
+    queue_valid = np.zeros(Q, bool)
+    queue_names: List[str] = []
+    for i, q in enumerate(queues):
+        queue_names.append(q.name)
+        queue_weight[i] = q.weight
+        queue_valid[i] = True
+        if q.queue.capability:
+            for name, v in q.queue.capability.items():
+                if name in spec:
+                    queue_capability[i, spec.index(name)] = v
+    for i, j in enumerate(jobs):
+        qi = job_queue[i]
+        queue_alloc[qi] += job_allocated[i]
+        queue_request[qi] += j.total_request.vec
+
+    total = node_alloc[node_valid].sum(axis=0).astype(np.float32) if nN else np.zeros(R, np.float32)
+
+    snap = DeviceSnapshot(
+        task_req=task_req,
+        task_resreq=task_resreq,
+        task_job=task_job,
+        task_prio=task_prio,
+        task_creation=task_creation,
+        task_status=task_status,
+        task_valid=task_valid,
+        task_pending=task_pending,
+        task_best_effort=task_best_effort,
+        task_sel_bits=task_sel_bits,
+        task_sel_impossible=task_sel_impossible,
+        task_tol_bits=task_tol_bits,
+        node_idle=node_idle,
+        node_releasing=node_releasing,
+        node_used=node_used,
+        node_alloc=node_alloc,
+        node_valid=node_valid,
+        node_sched=node_sched,
+        node_label_bits=node_label_bits,
+        node_taint_bits=node_taint_bits,
+        job_min_avail=job_min_avail,
+        job_ready=job_ready,
+        job_queue=job_queue,
+        job_prio=job_prio,
+        job_creation=job_creation,
+        job_valid=job_valid,
+        job_schedulable=job_schedulable,
+        job_allocated=job_allocated,
+        queue_weight=queue_weight,
+        queue_capability=queue_capability,
+        queue_alloc=queue_alloc,
+        queue_request=queue_request,
+        queue_valid=queue_valid,
+        total=total,
+        quanta=spec.quanta.astype(np.float32),
+    )
+    meta = SnapshotMeta(
+        spec=spec,
+        task_keys=task_keys,
+        node_names=node_names,
+        job_uids=job_uids,
+        queue_names=queue_names,
+        label_pair_bit=label_pair_bit,
+        taint_bit=taint_bit,
+        n_tasks=nT,
+        n_nodes=nN,
+        n_jobs=nJ,
+        n_queues=nQ,
+    )
+    return snap, meta
+
+
+class _TaintView:
+    """Duck-typed taint for Toleration.tolerates during interning."""
+
+    __slots__ = ("key", "value", "effect")
+
+    def __init__(self, key: str, value: str, effect: str):
+        self.key = key
+        self.value = value
+        self.effect = effect

@@ -1,0 +1,9 @@
+"""The TPU compute path: each module is a family of rows in the per-cycle
+cost/mask tensor program (SURVEY.md §7.1).
+
+feasibility — boolean [T, N] masks (PredicateFn analog)
+scoring     — additive f32 [T, N] scores (NodeOrderFn analog, incl. binpack)
+fairness    — DRF shares, proportion deserved/overused (drf.go / proportion.go)
+ordering    — total task order encoding job/task order fns as sortable ranks
+assignment  — the gang-constrained allocate solve (allocate.go + statement.go)
+"""

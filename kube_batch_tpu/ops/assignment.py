@@ -1,0 +1,322 @@
+"""The gang-constrained allocate solve — allocate.go + statement.go as one
+compiled tensor program.
+
+The reference's allocate is an ordered greedy loop: pop queue (skip overused),
+pop job, pop task, predicate all nodes (16 workers), score, pick best, place
+on Idle or pipeline on Releasing, commit the job's Statement iff JobReady else
+roll back (allocate.go:95-200, statement.go:309-337). That sequencing is
+O(tasks × nodes) of host work per cycle.
+
+Here the same semantics run as batched auction rounds on device:
+
+  round:  every unplaced task bids for its best feasible node (argmax over a
+          masked score row); conflicts on a node are resolved by admitting
+          bidders in task-order-rank sequence until the node's budget is
+          exhausted (a segmented prefix-sum over the rank-sorted bidders —
+          the moral equivalent of "the PQ order reaches the node first");
+          losers re-bid next round against updated budgets.
+  gang:   after the rounds, jobs whose allocated count (existing ready + new)
+          misses MinAvailable get every new placement reverted — the
+          vectorized Statement.Discard (statement.go:309-322); an outer
+          iteration then lets surviving tasks re-bid for the freed resources.
+
+Divergences from the sequential loop are the sanctioned ones (SURVEY.md
+§7.3): placement ties may resolve differently (the reference's
+SelectBestNode is itself randomized among max-score nodes,
+scheduler_helper.go:147-158), but the invariants hold — no node overcommit,
+no committed partial gang, overused queues don't gain tasks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import DeviceSnapshot
+from kube_batch_tpu.ops import fairness, ordering
+from kube_batch_tpu.ops.ordering import segmented_prefix as _segmented_prefix
+from kube_batch_tpu.ops.feasibility import fits, static_predicates
+from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
+
+NEG = jnp.float32(-3.0e38)
+
+
+class AllocateConfig(NamedTuple):
+    """Static solve configuration (plugin enables + round counts). Part of
+    the jit cache key."""
+
+    rounds: int = 6          # bidding rounds per outer iteration
+    outer: int = 3           # gang discard-retry iterations
+    gang: bool = True        # gang plugin (JobReady commit gate)
+    drf: bool = True         # drf job ordering
+    proportion: bool = True  # queue overused gating + queue order
+    weights: ScoreWeights = ScoreWeights()
+
+
+class AllocateResult(NamedTuple):
+    assigned: jnp.ndarray       # [T] i32 node index, -1 = unplaced
+    pipelined: jnp.ndarray      # [T] bool — placed on Releasing (future) budget
+    committed: jnp.ndarray      # [J] bool — job's new placements were kept
+    node_idle: jnp.ndarray      # [N, R] post-solve
+    node_releasing: jnp.ndarray  # [N, R] post-solve
+    node_used: jnp.ndarray      # [N, R] post-solve
+    deserved: jnp.ndarray       # [Q, R] proportion deserved (diagnostics)
+
+
+def _queue_gate(
+    cand: jnp.ndarray,        # [T] bool — bid this round
+    rank: jnp.ndarray,        # [T] i32
+    task_job: jnp.ndarray,    # [T] i32
+    task_queue: jnp.ndarray,  # [T] i32
+    resreq: jnp.ndarray,      # [T, R]
+    qalloc: jnp.ndarray,      # [Q, R] — queue allocation incl. this cycle
+    deserved: jnp.ndarray,    # [Q, R]
+    quanta: jnp.ndarray,      # [R]
+    job_need: jnp.ndarray,    # [J] i32 — minAvailable − currently-ready
+    n_jobs: int,
+) -> jnp.ndarray:
+    """Proportion admission (the Overused pop-gate, allocate.go:101-104 +
+    proportion.go:198-209, at the granularity the sequential loop actually
+    enforces it): walk each queue's bidders in rank order; a bidder passes
+    while its queue is not yet overused at its prefix position. An unready
+    job's first `need` bidders form the gang chunk and pass iff the queue
+    wasn't overused when the chunk head arrived — the whole Statement commits
+    even if it overshoots deserved, exactly like a popped gang job."""
+    T, R = resreq.shape
+    # queue-major, rank-minor sort; a job's bidders are contiguous inside its
+    # queue segment because rank orders by (job_rank, subrank)
+    order = jnp.argsort(rank, stable=True)
+    order = order[jnp.argsort(task_queue[order], stable=True)]
+    cs = cand[order]
+    qs = task_queue[order]
+    js = task_job[order]
+    rq = jnp.where(cs[:, None], resreq[order], 0.0)
+    q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
+    prefix = _segmented_prefix(rq, q_start)  # [T, R] exclusive, per queue
+    pos_overused = jnp.all(deserved[qs] <= qalloc[qs] + prefix + quanta, axis=-1)
+    # candidate position within the job (segmented candidate count)
+    j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
+    ci = cs.astype(jnp.float32)[:, None]
+    pos_in_job = _segmented_prefix(ci, j_start)[:, 0].astype(jnp.int32)
+    in_chunk = cs & (pos_in_job < job_need[js])
+    # chunk head verdict, broadcast job-wide
+    head_ok = jnp.zeros(n_jobs, bool).at[js].max(cs & (pos_in_job == 0) & ~pos_overused)
+    ok = cs & (~pos_overused | (in_chunk & head_ok[js]))
+    return jnp.zeros(T, bool).at[order].set(ok)
+
+
+def _resolve_conflicts(
+    cand: jnp.ndarray,      # [T] bool — bidding this round on this budget
+    choice: jnp.ndarray,    # [T] i32 — chosen node per task
+    rank: jnp.ndarray,      # [T] i32 — task order (lower wins)
+    fit_req: jnp.ndarray,   # [T, R] — InitResreq (fit check, allocate.go:161)
+    acct_req: jnp.ndarray,  # [T, R] — Resreq (budget consumption,
+    #                                  statement.go allocate→node.AddTask)
+    budget: jnp.ndarray,    # [N, R]
+    quanta: jnp.ndarray,    # [R]
+):
+    """Admit bidders per node in rank order while the prefix fits the budget.
+
+    Returns (accept [T] bool, delta [N, R] consumed). The prefix test charges
+    each bidder its predecessors' Resreq plus its own InitResreq, which is
+    exactly the sequential loop's state when it reaches that task.
+    """
+    T, R = fit_req.shape
+    N = budget.shape[0]
+    seg = jnp.where(cand, choice, N)  # non-bidders park in segment N
+    # rank-major within node: stable sort by rank, then by node
+    order = jnp.argsort(rank, stable=True)
+    order = order[jnp.argsort(seg[order], stable=True)]
+    seg_s = seg[order]
+    acct_s = jnp.where(cand[order, None], acct_req[order], 0.0)
+    fit_s = fit_req[order]
+    is_start = jnp.concatenate([jnp.array([True]), seg_s[1:] != seg_s[:-1]])
+    within_excl = _segmented_prefix(acct_s, is_start)
+    budget_here = budget[jnp.clip(seg_s, 0, N - 1)]
+    ok = jnp.all(fit_s + within_excl <= budget_here + quanta, axis=-1)
+    accept_s = ok & cand[order] & (seg_s < N)
+    accept = jnp.zeros(T, bool).at[order].set(accept_s)
+    delta = jax.ops.segment_sum(
+        jnp.where(accept_s[:, None], acct_s, 0.0), seg_s, num_segments=N + 1
+    )[:N]
+    return accept, delta
+
+
+@partial(jax.jit, static_argnames=("config",))
+def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
+    """One allocate action pass over the snapshot."""
+    T, R = snap.task_req.shape
+    N = snap.node_alloc.shape[0]
+    J = snap.job_min_avail.shape[0]
+    Q = snap.queue_weight.shape[0]
+
+    static_ok = static_predicates(snap)           # [T, N]
+    score = score_matrix(snap, config.weights)    # [T, N]
+    subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
+
+    # proportion deserved is computed once per cycle from the session-open
+    # state (proportion.go:101-154 runs in OnSessionOpen)
+    deserved = fairness.proportion_deserved(
+        snap.total, snap.queue_weight, snap.queue_request, snap.queue_valid
+    )
+
+    eligible = (
+        snap.task_pending
+        & snap.task_valid
+        & snap.job_valid[snap.task_job]
+        & snap.job_schedulable[snap.task_job]
+    )
+
+    def round_body(state, _):
+        idle, releasing, used, assigned, pipelined, job_failed = state
+        placed = assigned >= 0
+        # current allocations (jobs then queues) including this cycle's placements
+        placed_req = jnp.where(placed[:, None], snap.task_resreq, 0.0)
+        job_new = jax.ops.segment_sum(placed_req, snap.task_job, num_segments=J)
+        job_alloc = snap.job_allocated + job_new
+        queue_alloc = snap.queue_alloc + jax.ops.segment_sum(
+            job_new, snap.job_queue, num_segments=Q
+        )
+        new_alloc_cnt = jax.ops.segment_sum(
+            (placed & ~pipelined).astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        job_ready_now = (snap.job_ready + new_alloc_cnt) >= snap.job_min_avail
+
+        pending = eligible & ~placed & ~job_failed[snap.task_job]
+        # fair-queuing virtual-time total order (QueueOrderFn/JobOrderFn/
+        # TaskOrderFn tiers over live shares)
+        rank = ordering.virtual_task_ranks(
+            pending,
+            snap.task_resreq,
+            snap.task_job,
+            snap.job_queue[snap.task_job],
+            subrank,
+            snap.job_prio,
+            job_ready_now,
+            snap.job_creation,
+            job_alloc,
+            queue_alloc,
+            deserved,
+            snap.total,
+            gang_enabled=config.gang,
+            drf_enabled=config.drf,
+            proportion_enabled=config.proportion,
+        )
+
+        fit_idle = fits(snap.task_req, idle, snap.quanta)
+        fit_rel = fits(snap.task_req, releasing, snap.quanta)
+        feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
+        masked = jnp.where(feas, score, NEG)
+        best = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        has = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] > NEG
+        if config.proportion:
+            job_need = jnp.maximum(
+                snap.job_min_avail - (snap.job_ready + new_alloc_cnt), 0
+            )
+            has &= _queue_gate(
+                has,
+                rank,
+                snap.task_job,
+                snap.job_queue[snap.task_job],
+                snap.task_resreq,
+                queue_alloc,
+                deserved,
+                snap.quanta,
+                job_need,
+                J,
+            )
+        # allocate if the chosen node fits Idle, else pipeline onto Releasing
+        # (allocate.go:161-184: the idle-vs-releasing decision happens on the
+        # already-selected best-score node)
+        chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
+        alloc_cand = has & chose_idle
+        pipe_cand = has & ~chose_idle
+
+        acc_a, delta_a = _resolve_conflicts(
+            alloc_cand, best, rank, snap.task_req, snap.task_resreq, idle, snap.quanta
+        )
+        acc_p, delta_p = _resolve_conflicts(
+            pipe_cand, best, rank, snap.task_req, snap.task_resreq, releasing, snap.quanta
+        )
+        # statement.Allocate → node.AddTask(Allocated): Idle -= r, Used += r
+        # statement.Pipeline → node.AddTask(Pipelined): Releasing -= r, Used += r
+        idle = idle - delta_a
+        releasing = releasing - delta_p
+        used = used + delta_a + delta_p
+        assigned = jnp.where(acc_a | acc_p, best, assigned)
+        pipelined = pipelined | acc_p
+        return (idle, releasing, used, assigned, pipelined, job_failed), None
+
+    def outer_body(state, _):
+        idle, releasing, used, assigned, pipelined, job_failed = state
+        (idle, releasing, used, assigned, pipelined, job_failed), _ = jax.lax.scan(
+            round_body,
+            (idle, releasing, used, assigned, pipelined, job_failed),
+            None,
+            length=config.rounds,
+        )
+        # ---- gang commit/discard (vectorized Statement) -----------------
+        new_alloc_cnt = jax.ops.segment_sum(
+            ((assigned >= 0) & ~pipelined).astype(jnp.int32),
+            snap.task_job,
+            num_segments=J,
+        )
+        if config.gang:
+            job_ok = (snap.job_ready + new_alloc_cnt) >= snap.job_min_avail
+        else:
+            job_ok = jnp.ones(J, bool)
+        # a job whose placements get reverted is done for this cycle — the
+        # reference pops each job once and a discarded Statement isn't
+        # retried (allocate.go:192-196); without this, a big starved gang
+        # would re-grab the freed capacity every iteration and smaller jobs
+        # behind it would never see it
+        new_any = jax.ops.segment_sum(
+            (assigned >= 0).astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        job_failed = job_failed | (~job_ok & (new_any > 0))
+        revert = (assigned >= 0) & ~job_ok[snap.task_job]
+        seg = jnp.where(revert, assigned, N)
+        rev_req = jnp.where(revert[:, None], snap.task_resreq, 0.0)
+        rev_alloc = jax.ops.segment_sum(
+            jnp.where(~pipelined[:, None], rev_req, 0.0), seg, num_segments=N + 1
+        )[:N]
+        rev_pipe = jax.ops.segment_sum(
+            jnp.where(pipelined[:, None], rev_req, 0.0), seg, num_segments=N + 1
+        )[:N]
+        idle = idle + rev_alloc
+        releasing = releasing + rev_pipe
+        used = used - rev_alloc - rev_pipe
+        assigned = jnp.where(revert, -1, assigned)
+        pipelined = pipelined & ~revert
+        return (idle, releasing, used, assigned, pipelined, job_failed), None
+
+    init = (
+        snap.node_idle,
+        snap.node_releasing,
+        snap.node_used,
+        jnp.full(T, -1, jnp.int32),
+        jnp.zeros(T, bool),
+        jnp.zeros(J, bool),
+    )
+    (idle, releasing, used, assigned, pipelined, _), _ = jax.lax.scan(
+        outer_body, init, None, length=config.outer
+    )
+
+    # after the final outer revert, every surviving placement belongs to a
+    # job that passed the commit gate; committed = "has surviving placements"
+    new_any_cnt = jax.ops.segment_sum(
+        (assigned >= 0).astype(jnp.int32), snap.task_job, num_segments=J
+    )
+    committed = new_any_cnt > 0
+    return AllocateResult(
+        assigned=assigned,
+        pipelined=pipelined,
+        committed=committed,
+        node_idle=idle,
+        node_releasing=releasing,
+        node_used=used,
+        deserved=deserved,
+    )

@@ -1,0 +1,92 @@
+"""Fairness tensors — DRF shares and proportion max-min queue capacity.
+
+drf.go:161-171 computes a job's dominant share as max over resources of
+allocated/total; proportion.go:101-154 iteratively distributes the cluster
+total among queues by weight, capping each queue at its request, until
+nothing remains or every queue is met. Both are pure arithmetic over small
+[J, R] / [Q, R] arrays — they run inside the same jitted cycle program so the
+assignment rounds can recompute shares incrementally (the reference keeps
+them incremental via session event handlers, drf.go:135-154,
+proportion.go:87-99).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_SEM = slice(0, 2)  # cpu, memory — the semantic share dims (helpers.go:28-60)
+
+
+def dominant_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """[., R], [R] → [.] max over semantic dims of alloc/total, 0 where the
+    cluster has none of a resource (drf.go:161-171 via Resource.Share)."""
+    t = total[_SEM]
+    ratios = jnp.where(t > 0, alloc[..., _SEM] / jnp.maximum(t, 1e-9), 0.0)
+    return jnp.max(ratios, axis=-1)
+
+
+def proportion_deserved(
+    total: jnp.ndarray,       # [R]
+    weight: jnp.ndarray,      # [Q]
+    request: jnp.ndarray,     # [Q, R]
+    valid: jnp.ndarray,       # [Q] bool
+    max_iters: int = 16,
+) -> jnp.ndarray:
+    """Weighted max-min fair deserved[Q, R] (proportion.go:101-154).
+
+    Each iteration hands every unmet queue remaining·w/Σw, caps queues that
+    exceed their request, and returns the excess to the pool. Terminates when
+    the pool is empty or all queues are met; max_iters bounds the lax loop
+    (each iteration retires ≥1 queue in the reference's argument, so Q
+    iterations suffice; 16 covers Q ≤ 2^16 in practice since un-capped
+    iterations converge geometrically)."""
+    Q, R = request.shape
+
+    def cond(state):
+        i, deserved, met, remaining = state
+        some_pool = jnp.any(remaining > 1e-6)
+        some_unmet = jnp.any(valid & ~met)
+        return (i < max_iters) & some_pool & some_unmet
+
+    def body(state):
+        i, deserved, met, remaining = state
+        w = jnp.where(valid & ~met, weight, 0.0)
+        tw = jnp.sum(w)
+        frac = jnp.where(tw > 0, w / jnp.maximum(tw, 1e-9), 0.0)
+        inc = remaining[None, :] * frac[:, None]  # [Q, R]
+        new = deserved + inc
+        # met when deserved covers request in every dim (LessEqual, tolerant)
+        now_met = jnp.all(request <= new + 1e-6, axis=-1) & valid
+        capped = jnp.where(now_met[:, None], jnp.minimum(new, request), new)
+        granted = capped - deserved
+        remaining = jnp.maximum(remaining - jnp.sum(granted, axis=0), 0.0)
+        return (i + 1, capped, met | now_met, remaining)
+
+    _, deserved, _, _ = jax.lax.while_loop(
+        cond, body, (0, jnp.zeros((Q, R), total.dtype), ~valid, total)
+    )
+    return deserved
+
+
+def overused(
+    deserved: jnp.ndarray,  # [Q, R]
+    alloc: jnp.ndarray,     # [Q, R]
+    quanta: jnp.ndarray,    # [R]
+) -> jnp.ndarray:
+    """[Q] bool — queue's allocation already covers its deserved share
+    (proportion.go:198-209: overused iff deserved ≤ allocated)."""
+    return jnp.all(deserved <= alloc + quanta, axis=-1)
+
+
+def queue_share(
+    alloc: jnp.ndarray,     # [Q, R]
+    deserved: jnp.ndarray,  # [Q, R]
+) -> jnp.ndarray:
+    """[Q] — proportion's queue order key: dominant allocated/deserved ratio
+    (proportion.go:156-169, 265-277); lower share schedules first."""
+    d = deserved[..., _SEM]
+    ratios = jnp.where(d > 0, alloc[..., _SEM] / jnp.maximum(d, 1e-9), 0.0)
+    return jnp.max(ratios, axis=-1)

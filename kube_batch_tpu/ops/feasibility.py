@@ -1,0 +1,109 @@
+"""Feasibility masks — the PredicateFn tier as one [T, N] boolean program.
+
+Replaces the reference's 16-worker PredicateNodes fan-out
+(util/scheduler_helper.go:34-64) and the predicates plugin's per-task×node Go
+checks (plugins/predicates/predicates.go:154-298) with vmapped bit/compare
+ops over the device snapshot:
+
+  - resource fit vs Idle / Releasing (allocate.go:80-93 composite predicate),
+    epsilon-tolerant like Resource.LessEqual (resource_info.go:269-284);
+    max-pods (predicates.go:162-166) falls out of the pods dimension
+  - node ready / unschedulable (CheckNodeCondition/CheckNodeUnschedulable,
+    predicates.go:169-192)
+  - node-selector and required node-affinity as label-bit subset tests
+    (MatchNodeSelector, predicates.go:194-205)
+  - taints/tolerations as taint-bit coverage tests (PodToleratesNodeTaints,
+    predicates.go:220-231)
+
+Everything here is shape-polymorphic over a leading task axis and a node
+axis; jit once per (T, N, R) bucket.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import DeviceSnapshot
+
+
+class FeasibilityMasks(NamedTuple):
+    static_ok: jnp.ndarray   # [T, N] bool — non-resource predicates
+    fit_idle: jnp.ndarray    # [T, N] bool — InitResreq ≤ Idle (+quanta)
+    fit_releasing: jnp.ndarray  # [T, N] bool — InitResreq ≤ Releasing (+quanta)
+    feasible: jnp.ndarray    # [T, N] bool — static ∧ (fit_idle ∨ fit_releasing)
+
+
+def fits(req: jnp.ndarray, budget: jnp.ndarray, quanta: jnp.ndarray) -> jnp.ndarray:
+    """Epsilon-tolerant LessEqual broadcast: req [T, R] vs budget [N, R] →
+    [T, N]. A dimension passes if req ≤ budget or the excess is below the
+    quantum (resource_info.go:269-284)."""
+    # [T, 1, R] vs [1, N, R] — XLA fuses the broadcast+reduce, nothing [T,N,R]
+    # is materialized.
+    return jnp.all(req[:, None, :] <= budget[None, :, :] + quanta, axis=-1)
+
+
+def static_predicates(snap: DeviceSnapshot) -> jnp.ndarray:
+    """[T, N] non-resource predicate conjunction."""
+    # node health: Ready and not marked Unschedulable
+    node_ok = snap.node_valid & snap.node_sched  # [N]
+
+    # selector: every required label bit present on the node
+    sel_ok = jnp.all(
+        (snap.task_sel_bits[:, None, :] & snap.node_label_bits[None, :, :])
+        == snap.task_sel_bits[:, None, :],
+        axis=-1,
+    )  # [T, N]
+    sel_ok &= ~snap.task_sel_impossible[:, None]
+
+    # taints: every hard taint on the node must be tolerated
+    taints_ok = jnp.all(
+        (snap.node_taint_bits[None, :, :] & ~snap.task_tol_bits[:, None, :]) == 0,
+        axis=-1,
+    )  # [T, N]
+
+    return node_ok[None, :] & sel_ok & taints_ok
+
+
+def feasibility(snap: DeviceSnapshot) -> FeasibilityMasks:
+    static_ok = static_predicates(snap)
+    fit_idle = fits(snap.task_req, snap.node_idle, snap.quanta)
+    fit_rel = fits(snap.task_req, snap.node_releasing, snap.quanta)
+    feasible = static_ok & (fit_idle | fit_rel)
+    return FeasibilityMasks(static_ok, fit_idle, fit_rel, feasible)
+
+
+# Reason codes for fit-error diagnostics (unschedule_info.go:11-19); the host
+# renders these into FitErrors strings for unplaced tasks only.
+REASON_NODE_UNHEALTHY = 0
+REASON_SELECTOR = 1
+REASON_TAINT = 2
+REASON_RESOURCE = 3
+N_REASONS = 4
+
+
+def failure_histogram(snap: DeviceSnapshot, masks: FeasibilityMasks) -> jnp.ndarray:
+    """[T, N_REASONS] i32: per task, how many valid nodes failed each
+    predicate class — the device analog of FitErrors' reason histogram."""
+    node_ok = snap.node_valid & snap.node_sched
+    nodes = snap.node_valid[None, :]
+    sel_ok = jnp.all(
+        (snap.task_sel_bits[:, None, :] & snap.node_label_bits[None, :, :])
+        == snap.task_sel_bits[:, None, :],
+        axis=-1,
+    ) & ~snap.task_sel_impossible[:, None]
+    taints_ok = jnp.all(
+        (snap.node_taint_bits[None, :, :] & ~snap.task_tol_bits[:, None, :]) == 0,
+        axis=-1,
+    )
+    fit = masks.fit_idle | masks.fit_releasing
+    return jnp.stack(
+        [
+            jnp.sum(nodes & ~node_ok[None, :], axis=1),
+            jnp.sum(nodes & node_ok[None, :] & ~sel_ok, axis=1),
+            jnp.sum(nodes & node_ok[None, :] & sel_ok & ~taints_ok, axis=1),
+            jnp.sum(nodes & masks.static_ok & ~fit, axis=1),
+        ],
+        axis=1,
+    ).astype(jnp.int32)

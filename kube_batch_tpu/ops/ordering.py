@@ -1,0 +1,129 @@
+"""Task/job ordering — the order-fn tiers as device-computed ranks.
+
+The reference's allocate pops queues by QueueOrderFn (proportion share), jobs
+by JobOrderFn (tier chain: gang starved-first → drf share → priority →
+creation/UID fallback, session_plugins.go:281-305), and tasks by TaskOrderFn
+(priority → creation, :336-369). In the batched solve, that whole chain
+collapses into one total order rank[T]: conflicts for the same node are won
+by the lowest rank, which reproduces "who the sequential loop would have
+served first".
+
+Multi-key ordering is built by chained stable argsorts (least-significant key
+first) — no packed integer keys, no precision traps.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.ops import fairness
+
+
+def segmented_prefix(values_sorted: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive per-segment prefix sum of already-sorted [T, R] values ≥ 0.
+    The global exclusive cumsum is monotone per dim, so each segment's base is
+    a running max of the cumsum values captured at segment starts."""
+    csum = jnp.cumsum(values_sorted, axis=0)
+    prev = csum - values_sorted
+    base = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start[:, None], prev, 0.0), axis=0
+    )
+    return prev - base
+
+
+def multisort_ranks(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """rank[i] = position of element i under lexicographic (keys[0], keys[1],
+    ...) ascending order. All keys are 1-D of equal length."""
+    n = keys[0].shape[0]
+    order = jnp.arange(n)
+    for key in reversed(list(keys)):
+        order = order[jnp.argsort(key[order], stable=True)]
+    rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return rank
+
+
+def virtual_task_ranks(
+    pending: jnp.ndarray,      # [T] bool — bidders this round
+    resreq: jnp.ndarray,       # [T, R]
+    task_job: jnp.ndarray,     # [T] i32
+    task_queue: jnp.ndarray,   # [T] i32
+    subrank: jnp.ndarray,      # [T] i32 — within-job TaskOrderFn rank
+    job_prio: jnp.ndarray,     # [J] i32
+    job_ready_now: jnp.ndarray,  # [J] bool
+    job_creation: jnp.ndarray,   # [J] i32
+    job_alloc: jnp.ndarray,    # [J, R] — incl. this cycle's placements
+    queue_alloc: jnp.ndarray,  # [Q, R] — incl. this cycle's placements
+    deserved: jnp.ndarray,     # [Q, R]
+    total: jnp.ndarray,        # [R]
+    gang_enabled: bool,
+    drf_enabled: bool,
+    proportion_enabled: bool,
+) -> jnp.ndarray:
+    """[T] i32 — the total order the sequential pop loop would serve tasks in.
+
+    The reference re-evaluates QueueOrderFn (proportion share) and JobOrderFn
+    (drf share) on *live* state after every placement, producing share-ordered
+    alternation between queues/jobs. The batched analog is fair-queuing
+    virtual time: a task's key is the share its queue (resp. job) will have
+    reached at the task's own prefix position within that queue (resp. job) —
+    sorting by virtual share reproduces the alternation without a sequential
+    loop.
+
+    Key chain (outer→inner), matching the default two-tier conf
+    (pkg/scheduler/util.go:31-42: tier1 priority,gang,conformance; tier2
+    drf,predicates,proportion,nodeorder):
+      1. queue virtual proportion share (QueueOrderFn, proportion.go:156-169)
+      2. job priority desc (priority.go:69-77)
+      3. gang starved-first (gang.go:96-121)
+      4. job virtual drf share (drf.go:114-132)
+      5. job creation asc (fallback, session_plugins.go:281-305)
+      6. within-job subrank (TaskOrderFn)
+    """
+    T = resreq.shape[0]
+    rq = jnp.where(pending[:, None], resreq, 0.0)
+
+    # job-axis virtual drf share: prefix within job in subrank order
+    order_j = jnp.argsort(subrank, stable=True)
+    order_j = order_j[jnp.argsort(task_job[order_j], stable=True)]
+    js = task_job[order_j]
+    j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
+    prefix_j = segmented_prefix(rq[order_j], j_start)
+    vd_sorted = fairness.dominant_share(job_alloc[js] + prefix_j, total)
+    v_drf = jnp.zeros(T, jnp.float32).at[order_j].set(vd_sorted)
+
+    # within-queue key (everything but the queue tier)
+    wq_keys = [-job_prio[task_job]]
+    if gang_enabled:
+        wq_keys.append(job_ready_now[task_job].astype(jnp.int32))  # starved first
+    if drf_enabled:
+        wq_keys.append(jnp.round(v_drf * 1e6).astype(jnp.int32))
+    wq_keys += [job_creation[task_job], subrank]
+    wq_rank = multisort_ranks(wq_keys)
+
+    if not proportion_enabled:
+        # QueueOrderFn falls back to creation/UID — queues drain in index
+        # order, one job at a time
+        return multisort_ranks([task_queue, wq_rank])
+
+    # queue-axis virtual proportion share: prefix within queue in wq order
+    order_q = jnp.argsort(wq_rank, stable=True)
+    order_q = order_q[jnp.argsort(task_queue[order_q], stable=True)]
+    qs = task_queue[order_q]
+    q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
+    prefix_q = segmented_prefix(rq[order_q], q_start)
+    vq_sorted = fairness.queue_share(queue_alloc[qs] + prefix_q, deserved[qs])
+    v_q = jnp.zeros(T, jnp.float32).at[order_q].set(vq_sorted)
+
+    return multisort_ranks([jnp.round(v_q * 1e6).astype(jnp.int32), wq_rank])
+
+
+def task_subranks(task_prio: jnp.ndarray, task_creation: jnp.ndarray) -> jnp.ndarray:
+    """[T] i32 within-job order: priority desc then creation asc
+    (TaskOrderFn via priority plugin, session_plugins.go:336-369). Static per
+    cycle."""
+    return multisort_ranks([-task_prio, task_creation])
+
+

@@ -1,0 +1,208 @@
+"""Kernel-level tests of the gang-constrained allocate solve.
+
+These test *invariants*, not exact placements (SURVEY.md §7.3: the reference
+randomizes tie-breaks itself, scheduler_helper.go:147-158): no node
+overcommit, no committed partial gang, priority wins contention, overused
+queues gain nothing.
+"""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.pod import Node, Pod, PodGroup, Queue
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.resources import DEFAULT_SPEC
+from kube_batch_tpu.api.snapshot import build_snapshot
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+
+GiB = 2**30
+
+
+def build_cluster(nodes, jobs, queues=("default",)):
+    """nodes: [(name, cpu_milli, mem)], jobs: [(name, queue, min_member,
+    [(task, cpu, mem, prio)])]."""
+    ci = ClusterInfo(DEFAULT_SPEC)
+    for q in queues:
+        name, weight = q if isinstance(q, tuple) else (q, 1)
+        ci.queues[name] = QueueInfo(Queue(name=name, weight=weight))
+    for name, cpu, mem in nodes:
+        ni = NodeInfo(
+            Node(name=name, allocatable={"cpu": cpu, "memory": mem, "pods": 110}),
+            DEFAULT_SPEC,
+        )
+        ci.nodes[name] = ni
+    for jname, queue, min_member, tasks in jobs:
+        pg = PodGroup(name=jname, min_member=min_member, queue=queue)
+        job = JobInfo(f"default/{jname}", DEFAULT_SPEC, pg)
+        for tname, cpu, mem, prio in tasks:
+            pod = Pod(name=f"{jname}-{tname}", requests={"cpu": cpu, "memory": mem},
+                      priority=prio, phase=PodPhase.PENDING)
+            job.add_task(TaskInfo(pod, DEFAULT_SPEC))
+        ci.jobs[job.uid] = job
+    return ci
+
+
+def solve(ci, **kw):
+    snap, meta = build_snapshot(ci)
+    res = allocate_solve(snap, AllocateConfig(**kw))
+    return snap, meta, res
+
+
+def assert_no_overcommit(snap, res):
+    assert np.all(np.asarray(res.node_idle) >= -np.asarray(snap.quanta)[None, :])
+    assert np.all(np.asarray(res.node_releasing) >= -np.asarray(snap.quanta)[None, :])
+
+
+class TestBasicAllocate:
+    def test_single_job_fits(self):
+        ci = build_cluster(
+            nodes=[("n1", 4000, 8 * GiB)],
+            jobs=[("j1", "default", 2, [(f"t{i}", 1000, 1 * GiB, 0) for i in range(2)])],
+        )
+        snap, meta, res = solve(ci)
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        assert np.all(assigned >= 0)
+        assert not np.any(np.asarray(res.pipelined)[: meta.n_tasks])
+        assert_no_overcommit(snap, res)
+
+    def test_spreads_across_nodes_when_needed(self):
+        # 4 tasks × 3000m on 2 × 8000m nodes → 2+2 split required
+        ci = build_cluster(
+            nodes=[("n1", 8000, 16 * GiB), ("n2", 8000, 16 * GiB)],
+            jobs=[("j1", "default", 4, [(f"t{i}", 3000, 1 * GiB, 0) for i in range(4)])],
+        )
+        snap, meta, res = solve(ci)
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        assert np.all(assigned >= 0)
+        counts = np.bincount(assigned, minlength=2)
+        assert counts.max() == 2  # 3 × 3000m would overcommit
+        assert_no_overcommit(snap, res)
+
+    def test_padding_rows_never_assigned(self):
+        ci = build_cluster(
+            nodes=[("n1", 4000, 8 * GiB)],
+            jobs=[("j1", "default", 1, [("t0", 1000, GiB, 0)])],
+        )
+        snap, meta, res = solve(ci)
+        assert np.all(np.asarray(res.assigned)[meta.n_tasks:] == -1)
+
+
+class TestGang:
+    def test_partial_gang_discarded(self):
+        # minMember=3 but capacity for 2 → nothing committed (Statement.Discard)
+        ci = build_cluster(
+            nodes=[("n1", 2000, 8 * GiB)],
+            jobs=[("j1", "default", 3, [(f"t{i}", 1000, GiB, 0) for i in range(3)])],
+        )
+        snap, meta, res = solve(ci)
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        assert np.all(assigned == -1)
+        assert not np.asarray(res.committed)[: meta.n_jobs].any()
+        # idle fully restored
+        np.testing.assert_allclose(
+            np.asarray(res.node_idle), np.asarray(snap.node_idle)
+        )
+
+    def test_gang_off_commits_partial(self):
+        ci = build_cluster(
+            nodes=[("n1", 2000, 8 * GiB)],
+            jobs=[("j1", "default", 3, [(f"t{i}", 1000, GiB, 0) for i in range(3)])],
+        )
+        snap, meta, res = solve(ci, gang=False)
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        assert (assigned >= 0).sum() == 2
+
+    def test_discarded_gang_frees_resources_for_smaller_job(self):
+        # big gang (min 4, only 3 fit) must not starve the small job (min 1)
+        ci = build_cluster(
+            nodes=[("n1", 3000, 8 * GiB)],
+            jobs=[
+                ("big", "default", 4, [(f"t{i}", 1000, GiB, 10) for i in range(4)]),
+                ("small", "default", 1, [("t0", 1000, GiB, 0)]),
+            ],
+        )
+        snap, meta, res = solve(ci)
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        job_of = np.asarray(snap.task_job)[: meta.n_tasks]
+        big_idx = meta.job_uids.index("default/big")
+        small_idx = meta.job_uids.index("default/small")
+        assert np.all(assigned[job_of == big_idx] == -1)
+        assert np.all(assigned[job_of == small_idx] >= 0)
+
+    def test_two_gangs_contending(self):
+        # two min=2 gangs, capacity 3 → exactly one gang commits fully
+        ci = build_cluster(
+            nodes=[("n1", 3000, 8 * GiB)],
+            jobs=[
+                ("a", "default", 2, [(f"t{i}", 1000, GiB, 0) for i in range(2)]),
+                ("b", "default", 2, [(f"t{i}", 1000, GiB, 0) for i in range(2)]),
+            ],
+        )
+        snap, meta, res = solve(ci)
+        committed = np.asarray(res.committed)[: meta.n_jobs]
+        assert committed.sum() == 1
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        job_of = np.asarray(snap.task_job)[: meta.n_tasks]
+        winner = np.flatnonzero(committed)[0]
+        assert (assigned[job_of == winner] >= 0).sum() == 2
+        assert np.all(assigned[job_of != winner] == -1)
+
+
+class TestPriorityAndFairness:
+    def test_high_priority_job_wins_contention(self):
+        ci = build_cluster(
+            nodes=[("n1", 2000, 8 * GiB)],
+            jobs=[
+                ("lo", "default", 2, [(f"t{i}", 1000, GiB, 0) for i in range(2)]),
+                ("hi", "default", 2, [(f"t{i}", 1000, GiB, 0) for i in range(2)]),
+            ],
+        )
+        for uid, prio in [("default/lo", 1), ("default/hi", 100)]:
+            ci.jobs[uid].priority = prio
+        snap, meta, res = solve(ci)
+        job_of = np.asarray(snap.task_job)[: meta.n_tasks]
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        hi = meta.job_uids.index("default/hi")
+        assert np.all(assigned[job_of == hi] >= 0)
+        assert np.all(assigned[job_of != hi] == -1)
+
+    def test_proportion_shares_capacity_between_queues(self):
+        # 2 queues, weight 1:1, cluster 4000m; each queue requests 4000m →
+        # each deserves ~2000m → 2 tasks each
+        ci = build_cluster(
+            nodes=[("n1", 4000, 32 * GiB)],
+            queues=[("qa", 1), ("qb", 1)],
+            jobs=[
+                ("ja", "qa", 1, [(f"t{i}", 1000, GiB, 0) for i in range(4)]),
+                ("jb", "qb", 1, [(f"t{i}", 1000, GiB, 0) for i in range(4)]),
+            ],
+        )
+        snap, meta, res = solve(ci)
+        job_of = np.asarray(snap.task_job)[: meta.n_tasks]
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        ja = meta.job_uids.index("default/ja")
+        a_placed = (assigned[job_of == ja] >= 0).sum()
+        b_placed = (assigned[job_of != ja] >= 0).sum()
+        assert a_placed == 2 and b_placed == 2
+
+    def test_weighted_queues(self):
+        # weight 3:1 over 4000m → 3000/1000 split
+        ci = build_cluster(
+            nodes=[("n1", 4000, 32 * GiB)],
+            queues=[("qa", 3), ("qb", 1)],
+            jobs=[
+                ("ja", "qa", 1, [(f"t{i}", 1000, GiB, 0) for i in range(4)]),
+                ("jb", "qb", 1, [(f"t{i}", 1000, GiB, 0) for i in range(4)]),
+            ],
+        )
+        snap, meta, res = solve(ci)
+        job_of = np.asarray(snap.task_job)[: meta.n_tasks]
+        assigned = np.asarray(res.assigned)[: meta.n_tasks]
+        ja = meta.job_uids.index("default/ja")
+        assert (assigned[job_of == ja] >= 0).sum() == 3
+        assert (assigned[job_of != ja] >= 0).sum() == 1
