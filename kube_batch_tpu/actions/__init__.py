@@ -1,0 +1,29 @@
+"""Action registry (actions/factory.go:29-35)."""
+
+from kube_batch_tpu.framework.interface import register_action
+
+from kube_batch_tpu.actions.allocate import AllocateAction
+from kube_batch_tpu.actions.backfill import BackfillAction
+from kube_batch_tpu.actions.enqueue import EnqueueAction
+from kube_batch_tpu.actions.preempt import PreemptAction
+from kube_batch_tpu.actions.reclaim import ReclaimAction
+
+ALL_ACTIONS = (
+    EnqueueAction(),
+    ReclaimAction(),
+    AllocateAction(),
+    BackfillAction(),
+    PreemptAction(),
+)
+
+for action in ALL_ACTIONS:
+    register_action(action)
+
+__all__ = [
+    "AllocateAction",
+    "BackfillAction",
+    "EnqueueAction",
+    "PreemptAction",
+    "ReclaimAction",
+    "ALL_ACTIONS",
+]

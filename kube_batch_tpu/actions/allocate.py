@@ -1,0 +1,89 @@
+"""allocate action — the hot placement pass, device-solved.
+
+The reference's allocate (actions/allocate/allocate.go) is the
+O(tasks × nodes) host loop; here it becomes: build the device snapshot, run
+ops/assignment.allocate_solve (one compiled program: predicates, scoring,
+fairness, ordering, gang commit/discard), then replay the resulting
+assignment through the session's Statement verbs so host state, plugin event
+handlers, and the binder observe exactly the sequential semantics
+(statement.go:29-337)."""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.snapshot import build_snapshot
+from kube_batch_tpu.api.types import PodGroupPhase
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+class AllocateAction(Action):
+    name = "allocate"
+
+    def execute(self, ssn) -> None:
+        # session → ClusterInfo view (the session's jobs/nodes/queues ARE the
+        # snapshot clone; invalid jobs were already dropped at open)
+        cluster = ClusterInfo(ssn.spec)
+        cluster.nodes = ssn.nodes
+        cluster.queues = ssn.queues
+        # the Pending-phase gate (allocate.go:50-52)
+        cluster.jobs = {
+            uid: j
+            for uid, j in ssn.jobs.items()
+            if not (j.pod_group and j.pod_group.phase == PodGroupPhase.PENDING)
+        }
+        if not cluster.jobs or not cluster.nodes:
+            return
+
+        snap, meta = build_snapshot(cluster)
+        config = AllocateConfig(
+            gang=ssn.plugin_enabled("gang"),
+            drf=ssn.plugin_enabled("drf"),
+            proportion=ssn.plugin_enabled("proportion"),
+            weights=ssn.score_weights,
+        )
+        result = allocate_solve(snap, config)
+        assigned = np.asarray(result.assigned)[: meta.n_tasks]
+        pipelined = np.asarray(result.pipelined)[: meta.n_tasks]
+        task_job = np.asarray(snap.task_job)[: meta.n_tasks]
+
+        # group placements by job, in device task order
+        by_job: Dict[int, List[Tuple[str, int, bool]]] = defaultdict(list)
+        for ti in np.flatnonzero(assigned >= 0):
+            by_job[int(task_job[ti])].append(
+                (meta.task_keys[ti], int(assigned[ti]), bool(pipelined[ti]))
+            )
+
+        # replay through Statement per job — host is authoritative for the
+        # commit gate (JobReady, allocate.go:192-196)
+        for ji, placements in by_job.items():
+            job = ssn.jobs.get(meta.job_uids[ji])
+            if job is None:
+                continue
+            stmt = ssn.statement()
+            for task_key, ni, pipe in placements:
+                task = job.tasks.get(task_key)
+                if task is None:
+                    continue
+                node_name = meta.node_names[ni]
+                if pipe:
+                    stmt.pipeline(task, node_name)
+                else:
+                    stmt.allocate(task, node_name)
+            if ssn.job_ready(job):
+                stmt.commit()
+            else:
+                logger.info(
+                    "job %s not ready after device solve (%d placements), discarding",
+                    job.uid,
+                    len(placements),
+                )
+                stmt.discard()

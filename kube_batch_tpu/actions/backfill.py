@@ -1,0 +1,37 @@
+"""backfill action (actions/backfill/backfill.go:42-93): place BestEffort
+tasks (empty InitResreq) on the first node passing the plugin predicates —
+no scoring, immediate allocate. Non-BestEffort backfill remains the
+reference's acknowledged TODO (backfill.go:87)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.job_info import FitError, FitErrors
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import FitFailure
+
+
+class BackfillAction(Action):
+    name = "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in ssn.jobs.values():
+            if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
+                continue
+            pending = list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
+            for task in pending:
+                if not task.best_effort:
+                    continue
+                fit_errors = FitErrors()
+                for node in ssn.nodes.values():
+                    try:
+                        ssn.predicate(task, node)
+                    except FitFailure as e:
+                        fit_errors.set_node_error(
+                            node.name, FitError(task, node.name, [e.reason])
+                        )
+                        continue
+                    ssn.allocate(task, node.name)
+                    break
+                else:
+                    job.nodes_fit_errors[task.uid] = fit_errors

@@ -1,0 +1,67 @@
+"""enqueue action (actions/enqueue/enqueue.go) — the Inqueue gatekeeper.
+
+Computes cluster idle as Σ allocatable × 1.2 − used (20% overcommit,
+enqueue.go:78-81), then walks Pending-phase podgroups in queue/job order:
+no MinResources → Inqueue; else requires JobEnqueueable (proportion
+capability check) AND MinResources ≤ idle, deducting on admission
+(enqueue.go:102-117)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.types import PodGroupPhase
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.utils.priority_queue import PriorityQueue
+
+OVERCOMMIT_FACTOR = 1.2
+
+
+class EnqueueAction(Action):
+    name = "enqueue"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(less=ssn.queue_order_fn)
+        queue_set = set()
+        jobs_map = {}
+        for job in ssn.jobs.values():
+            if job.queue not in ssn.queues:
+                continue
+            if job.pod_group is None or job.pod_group.phase != PodGroupPhase.PENDING:
+                continue
+            queue = ssn.queues[job.queue]
+            if queue.name not in queue_set:
+                queue_set.add(queue.name)
+                queues.push(queue)
+            jobs_map.setdefault(queue.name, PriorityQueue(less=ssn.job_order_fn)).push(job)
+
+        if not jobs_map:
+            return
+
+        # idle = total × 1.2 − used (enqueue.go:74-81)
+        total = ssn.spec.empty()
+        used = ssn.spec.empty()
+        for node in ssn.nodes.values():
+            total.add_(node.allocatable)
+            used.add_(node.used)
+        idle = total.multi(OVERCOMMIT_FACTOR)
+        if used.less_equal(idle):
+            idle.sub_(used)
+        else:
+            idle = ssn.spec.empty()
+
+        while queues:
+            queue = queues.pop()
+            jobs = jobs_map.get(queue.name)
+            if not jobs:
+                continue
+            job = jobs.pop()
+            if job.pod_group.min_resources is None:
+                job.pod_group.phase = PodGroupPhase.INQUEUE
+            else:
+                min_req = ssn.spec.empty()
+                for name, v in job.pod_group.min_resources.items():
+                    if name in ssn.spec:
+                        min_req.vec[ssn.spec.index(name)] = float(v)
+                if ssn.job_enqueueable(job) and min_req.less_equal(idle):
+                    job.pod_group.phase = PodGroupPhase.INQUEUE
+                    idle.sub_(min_req)
+            queues.push(queue)

@@ -1,0 +1,139 @@
+"""preempt action (actions/preempt/preempt.go) — same-queue preemption.
+
+Phase 1: between jobs in a queue — starved (pending-task) jobs pipeline onto
+resources freed by evicting Running victims of *other* jobs in the same
+queue; the Statement commits only once the preemptor job is Pipelined
+(preempt.go:110-137). Phase 2: within a job — task-priority rebalancing,
+committed unconditionally (preempt.go:145-174).
+
+Victim choice per node: filter → ssn.Preemptable (tier-intersection of
+conformance ∩ gang ∩ drf) → validate total covers the request → evict
+lowest-task-order first until covered (preempt.go:180-277)."""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import FitFailure
+from kube_batch_tpu.utils.priority_queue import PriorityQueue
+
+
+class PreemptAction(Action):
+    name = "preempt"
+
+    def execute(self, ssn) -> None:
+        preemptors_map = {}
+        preemptor_tasks = {}
+        under_request = []
+        queues = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
+                continue
+            if ssn.job_valid(job) is not None:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues[queue.name] = queue
+            pending = job.task_status_index.get(TaskStatus.PENDING, {})
+            if pending:
+                preemptors_map.setdefault(
+                    job.queue, PriorityQueue(less=ssn.job_order_fn)
+                ).push(job)
+                under_request.append(job)
+                tq = PriorityQueue(less=ssn.task_order_fn)
+                for task in pending.values():
+                    tq.push(task)
+                preemptor_tasks[job.uid] = tq
+
+        for queue in queues.values():
+            # Phase 1: inter-job within queue
+            preemptors = preemptors_map.get(queue.name)
+            while preemptors:
+                preemptor_job = preemptors.pop()
+                stmt = ssn.statement()
+                assigned = False
+                while preemptor_tasks[preemptor_job.uid]:
+                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+
+                    def inter_job_filter(task: TaskInfo) -> bool:
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        job = ssn.jobs.get(task.job)
+                        if job is None:
+                            return False
+                        return job.queue == preemptor_job.queue and preemptor.job != task.job
+
+                    if self._preempt(ssn, stmt, preemptor, inter_job_filter):
+                        assigned = True
+                    if ssn.job_pipelined(preemptor_job):
+                        break
+                if ssn.job_pipelined(preemptor_job):
+                    stmt.commit()
+                    if assigned:
+                        preemptors.push(preemptor_job)
+                else:
+                    stmt.discard()
+
+            # Phase 2: intra-job task-priority preemption
+            for job in under_request:
+                tq = preemptor_tasks.get(job.uid)
+                while tq:
+                    preemptor = tq.pop()
+
+                    def intra_job_filter(task: TaskInfo) -> bool:
+                        return task.status == TaskStatus.RUNNING and preemptor.job == task.job
+
+                    stmt = ssn.statement()
+                    assigned = self._preempt(ssn, stmt, preemptor, intra_job_filter)
+                    stmt.commit()
+                    if not assigned:
+                        break
+
+    def _preempt(
+        self,
+        ssn,
+        stmt,
+        preemptor: TaskInfo,
+        victim_filter: Callable[[TaskInfo], bool],
+    ) -> bool:
+        """(preempt.go:180-260)"""
+        # predicate + score + sort nodes descending
+        candidates = []
+        for node in ssn.nodes.values():
+            try:
+                ssn.predicate(preemptor, node)
+            except FitFailure:
+                continue
+            candidates.append((ssn.node_order(preemptor, node), node))
+        candidates.sort(key=lambda sn: -sn[0])
+
+        for _, node in candidates:
+            preemptees = [t.clone() for t in node.tasks.values() if victim_filter(t)]
+            victims = ssn.preemptable(preemptor, preemptees)
+            if not victims:
+                continue
+            total = ssn.spec.empty()
+            for v in victims:
+                total.add_(v.resreq)
+            if total.less(preemptor.init_resreq):
+                continue  # not enough even with every victim
+            # evict lowest-task-order first (victimsQueue uses !TaskOrderFn)
+            vq = PriorityQueue(less=lambda l, r: not ssn.task_order_fn(l, r))
+            for v in victims:
+                vq.push(v)
+            preempted = ssn.spec.empty()
+            while vq:
+                victim = vq.pop()
+                stmt.evict(victim, "preempt")
+                preempted.add_(victim.resreq)
+                if preemptor.init_resreq.less_equal(preempted):
+                    break
+            if preemptor.init_resreq.less_equal(preempted):
+                stmt.pipeline(preemptor, node.name)
+                return True
+        return False

@@ -1,0 +1,92 @@
+"""reclaim action (actions/reclaim/reclaim.go) — cross-queue eviction.
+
+For each non-overused queue in order: pop job/task with Pending tasks, scan
+nodes; collect Running tasks *from other queues* as reclaimees, ask
+ssn.Reclaimable (proportion: victim's queue must stay ≥ deserved; gang:
+victim's gang must survive), evict immediately (no Statement) until the
+request is covered, then Pipeline the reclaimer (reclaim.go:107-199)."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import FitFailure
+from kube_batch_tpu.utils.priority_queue import PriorityQueue
+
+
+class ReclaimAction(Action):
+    name = "reclaim"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(less=ssn.queue_order_fn)
+        queue_set = set()
+        preemptors_map = {}
+        preemptor_tasks = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
+                continue
+            if ssn.job_valid(job) is not None:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.name not in queue_set:
+                queue_set.add(queue.name)
+                queues.push(queue)
+            pending = job.task_status_index.get(TaskStatus.PENDING, {})
+            if pending:
+                preemptors_map.setdefault(
+                    job.queue, PriorityQueue(less=ssn.job_order_fn)
+                ).push(job)
+                tq = PriorityQueue(less=ssn.task_order_fn)
+                for task in pending.values():
+                    tq.push(task)
+                preemptor_tasks[job.uid] = tq
+
+        while queues:
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+            jobs = preemptors_map.get(queue.name)
+            if not jobs:
+                continue
+            job = jobs.pop()
+            tasks = preemptor_tasks.get(job.uid)
+            if not tasks:
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in ssn.nodes.values():
+                try:
+                    ssn.predicate(task, node)
+                except FitFailure:
+                    continue
+                reclaimees = []
+                for t in node.tasks.values():
+                    if t.status != TaskStatus.RUNNING:
+                        continue
+                    j = ssn.jobs.get(t.job)
+                    if j is not None and j.queue != job.queue:
+                        reclaimees.append(t.clone())
+                victims = ssn.reclaimable(task, reclaimees)
+                if not victims:
+                    continue
+                total = ssn.spec.empty()
+                for v in victims:
+                    total.add_(v.resreq)
+                if total.less(task.init_resreq):
+                    continue
+                reclaimed = ssn.spec.empty()
+                for victim in victims:
+                    ssn.evict(victim, "reclaim")
+                    reclaimed.add_(victim.resreq)
+                    if task.init_resreq.less_equal(reclaimed):
+                        break
+                if task.init_resreq.less_equal(reclaimed):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+            if assigned:
+                queues.push(queue)

@@ -28,7 +28,7 @@ import numpy as np
 
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.resources import ResourceSpec
-from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus, is_allocated
 
 BITS = 32
 # Effects that hard-exclude a node (PreferNoSchedule is a soft preference the
@@ -285,7 +285,11 @@ def build_snapshot(
     for i, j in enumerate(jobs):
         qi = job_queue[i]
         queue_alloc[qi] += job_allocated[i]
-        queue_request[qi] += j.total_request.vec
+        # proportion's request counts AllocatedStatus + Pending tasks only
+        # (proportion.go:84-99), not the job's whole total_request
+        for t in j.tasks.values():
+            if t.status == TaskStatus.PENDING or is_allocated(t.status):
+                queue_request[qi] += t.resreq.vec
 
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32) if nN else np.zeros(R, np.float32)
 

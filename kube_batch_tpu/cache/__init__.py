@@ -1,0 +1,15 @@
+from kube_batch_tpu.cache.interface import Binder, Evictor, StatusUpdater, VolumeBinder
+from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder
+from kube_batch_tpu.cache.cache import SchedulerCache
+
+__all__ = [
+    "Binder",
+    "Evictor",
+    "StatusUpdater",
+    "VolumeBinder",
+    "FakeBinder",
+    "FakeEvictor",
+    "FakeStatusUpdater",
+    "FakeVolumeBinder",
+    "SchedulerCache",
+]

@@ -1,0 +1,351 @@
+"""SchedulerCache — the host-side cluster mirror (pkg/scheduler/cache).
+
+Mirrors cache.go:71-736 + event_handlers.go: a mutex-guarded in-memory image
+of pods/nodes/podgroups/queues/priorityclasses, fed by event-handler calls
+(the standalone analog of the 10 informers wired at cache.go:256-336), with
+Bind/Evict egress through pluggable Binder/Evictor seams, a failed-write
+resync queue, and a deep-clone Snapshot consumed by each session.
+
+The device snapshot (api/snapshot.py) is built *from* the session's clone;
+this cache stays pure host Python — it is not on the hot path (one snapshot
+per cycle)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.pod import Node, Pod, PodGroup, PriorityClass, Queue
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.resources import DEFAULT_SPEC, ResourceSpec
+from kube_batch_tpu.api.task_info import TaskInfo, job_id_for_pod
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.cache.fake import (
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+)
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+class SchedulerCache:
+    def __init__(
+        self,
+        spec: ResourceSpec = DEFAULT_SPEC,
+        scheduler_name: str = "volcano",
+        default_queue: str = "default",
+        binder=None,
+        evictor=None,
+        status_updater=None,
+        volume_binder=None,
+    ):
+        self.spec = spec
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self.binder = binder if binder is not None else FakeBinder()
+        self.evictor = evictor if evictor is not None else FakeEvictor()
+        self.status_updater = status_updater or FakeStatusUpdater()
+        self.volume_binder = volume_binder or FakeVolumeBinder()
+        self._lock = threading.RLock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.default_priority: int = 0
+        # failed bind/evict tasks awaiting resync (cache.go:559-581)
+        self.err_tasks: List[TaskInfo] = []
+        # pod store: the standalone source of truth the resync loop re-GETs
+        # from (the apiserver analog)
+        self.pods: Dict[str, Pod] = {}
+        self.events: List[tuple] = []  # (kind, object_key, message) record
+
+    # ------------------------------------------------------------------
+    # ingest: pods (event_handlers.go:42-200)
+    # ------------------------------------------------------------------
+    def _owns(self, pod: Pod) -> bool:
+        """Informer filter (cache.go:283-305): our scheduler's pods, or pods
+        already bound anywhere (needed for node accounting)."""
+        return pod.scheduler_name == self.scheduler_name or pod.node_name is not None
+
+    def _resolve_pod_priority(self, pod: Pod) -> None:
+        if pod.priority == 0 and pod.priority_class:
+            pc = self.priority_classes.get(pod.priority_class)
+            if pc is not None:
+                pod.priority = pc.value
+        elif pod.priority == 0 and self.default_priority:
+            pod.priority = self.default_priority
+
+    def _get_or_create_job(self, task: TaskInfo, pod: Pod) -> JobInfo:
+        """(event_handlers.go:42-67) jobs keyed by group annotation; plain
+        pods owned by this scheduler get a shadow PodGroup with minMember=1
+        (cache/util.go:42-60)."""
+        job = self.jobs.get(task.job)
+        if job is None:
+            job = JobInfo(task.job, self.spec)
+            self.jobs[task.job] = job
+        if job.pod_group is None and pod.group_name is None:
+            shadow = PodGroup(
+                name=pod.name,
+                namespace=pod.namespace,
+                min_member=1,
+                queue=self.default_queue,
+                creation_index=pod.creation_index,
+                shadow=True,
+            )
+            job.set_pod_group(shadow)
+        return job
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if not self._owns(pod):
+                return
+            self._resolve_pod_priority(pod)
+            self.pods[pod.key()] = pod
+            task = TaskInfo(pod, self.spec)
+            self._add_task(task, pod)
+
+    def _add_task(self, task: TaskInfo, pod: Pod) -> None:
+        job = self._get_or_create_job(task, pod)
+        job.add_task(task)
+        if task.node_name:
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                # pod arrived before its node: hold a nodeless NodeInfo;
+                # set_node replays accounting when the node shows up
+                node = NodeInfo(None, self.spec)
+                node.name = task.node_name
+                self.nodes[task.node_name] = node
+            node.add_task(task)
+
+    def update_pod(self, pod: Pod) -> None:
+        """delete + add (event_handlers.go:116-130)."""
+        with self._lock:
+            self._delete_pod_locked(pod)
+            if self._owns(pod):
+                self._resolve_pod_priority(pod)
+                self.pods[pod.key()] = pod
+                self._add_task(TaskInfo(pod, self.spec), pod)
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._delete_pod_locked(pod)
+
+    def _delete_pod_locked(self, pod: Pod) -> None:
+        self.pods.pop(pod.key(), None)
+        job_id = job_id_for_pod(pod)
+        job = self.jobs.get(job_id)
+        if job is not None:
+            task = job.tasks.get(pod.key())
+            if task is not None:
+                job.delete_task(task)
+                node = self.nodes.get(task.node_name) if task.node_name else None
+                if node is not None and task.key() in node.tasks:
+                    node.remove_task(task)
+            self._maybe_collect_job(job)
+
+    def _maybe_collect_job(self, job: JobInfo) -> None:
+        """processCleanupJob analog (cache.go:533-557): drop a job once it
+        has no tasks and no (non-shadow) PodGroup."""
+        if not job.tasks and (job.pod_group is None or job.pod_group.shadow):
+            self.jobs.pop(job.uid, None)
+
+    # ------------------------------------------------------------------
+    # ingest: nodes (event_handlers.go:261-360)
+    # ------------------------------------------------------------------
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            existing = self.nodes.get(node.name)
+            if existing is None:
+                self.nodes[node.name] = NodeInfo(node, self.spec)
+            else:
+                existing.set_node(node)
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+
+    # ------------------------------------------------------------------
+    # ingest: podgroups (event_handlers.go:362-481)
+    # ------------------------------------------------------------------
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            if not pg.queue:
+                pg.queue = self.default_queue  # default fill
+            job_id = pg.key()
+            job = self.jobs.get(job_id)
+            if job is None:
+                job = JobInfo(job_id, self.spec)
+                self.jobs[job_id] = job
+            job.set_pod_group(pg)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        self.add_pod_group(pg)
+
+    def delete_pod_group(self, key: str) -> None:
+        with self._lock:
+            job = self.jobs.get(key)
+            if job is not None:
+                job.pod_group = None
+                if not job.tasks:
+                    self.jobs.pop(key, None)
+
+    # ------------------------------------------------------------------
+    # ingest: queues / priority classes (event_handlers.go:597-785)
+    # ------------------------------------------------------------------
+    def add_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self.queues[queue.name] = QueueInfo(queue)
+
+    def update_queue(self, queue: Queue) -> None:
+        self.add_queue(queue)
+
+    def delete_queue(self, name: str) -> None:
+        with self._lock:
+            self.queues.pop(name, None)
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.name] = pc
+            if pc.global_default:
+                self.default_priority = pc.value
+
+    def delete_priority_class(self, name: str) -> None:
+        with self._lock:
+            pc = self.priority_classes.pop(name, None)
+            if pc is not None and pc.global_default:
+                self.default_priority = 0
+
+    # ------------------------------------------------------------------
+    # egress: bind / evict (cache.go:404-487)
+    # ------------------------------------------------------------------
+    def _own_task(self, task: TaskInfo) -> Optional[TaskInfo]:
+        job = self.jobs.get(task.job)
+        return job.tasks.get(task.key()) if job else None
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        """Mark Binding in the cache, then call the binder; a binder failure
+        queues the task for resync (cache.go:447-487; synchronous here — the
+        async goroutine is replaced by the resync repair path)."""
+        with self._lock:
+            own = self._own_task(task)
+            if own is not None:
+                job = self.jobs[task.job]
+                job.update_task_status(own, TaskStatus.BINDING)
+                own.node_name = hostname
+                node = self.nodes.get(hostname)
+                if node is not None and own.key() not in node.tasks:
+                    node.add_task(own)
+            pod = self.pods.get(task.key())
+        try:
+            if pod is not None:
+                self.binder.bind(pod, hostname)
+                self.events.append(("Scheduled", task.key(), hostname))
+        except Exception as e:  # noqa: BLE001 — repair path mirrors resyncTask
+            logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
+            self.resync_task(task)
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        """(cache.go:404-444)"""
+        with self._lock:
+            own = self._own_task(task)
+            if own is not None:
+                job = self.jobs[task.job]
+                job.update_task_status(own, TaskStatus.RELEASING)
+                node = self.nodes.get(own.node_name) if own.node_name else None
+                if node is not None:
+                    node.update_task(own)
+            pod = self.pods.get(task.key())
+        try:
+            if pod is not None:
+                self.evictor.evict(pod)
+                self.events.append(("Evict", task.key(), reason))
+        except Exception as e:  # noqa: BLE001
+            logger.error("evict of %s failed: %s", task.key(), e)
+            self.resync_task(task)
+
+    # volume seams (no-op standalone, cache.go:189-209)
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+        task.volume_ready = True
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # ------------------------------------------------------------------
+    # repair: resync (cache.go:559-581, event_handlers.go:96-122)
+    # ------------------------------------------------------------------
+    def resync_task(self, task: TaskInfo) -> None:
+        with self._lock:
+            self.err_tasks.append(task)
+
+    def process_resync_tasks(self) -> None:
+        """Re-sync each errored task from the pod store: gone → delete;
+        present → rebuild (delete + add)."""
+        with self._lock:
+            tasks, self.err_tasks = self.err_tasks, []
+            for task in tasks:
+                pod = self.pods.get(task.key())
+                if pod is None:
+                    continue
+                self._delete_pod_locked(pod)
+                self.pods[pod.key()] = pod
+                self._add_task(TaskInfo(pod, self.spec), pod)
+
+    # ------------------------------------------------------------------
+    # status egress (cache.go:688-736)
+    # ------------------------------------------------------------------
+    def record_job_status_event(self, job: JobInfo) -> None:
+        self.events.append(("Unschedulable", job.uid, job.fit_error()))
+
+    def update_job_status(self, job: JobInfo) -> None:
+        """Write the session's derived PodGroup status back to the
+        authoritative store (UpdatePodGroup, cache.go:722-736)."""
+        with self._lock:
+            own = self.jobs.get(job.uid)
+            if own is not None and own.pod_group is not None and job.pod_group is not None:
+                own.pod_group.phase = job.pod_group.phase
+                own.pod_group.conditions = list(job.pod_group.conditions)
+                own.pod_group.running = job.pod_group.running
+                own.pod_group.failed = job.pod_group.failed
+                own.pod_group.succeeded = job.pod_group.succeeded
+        self.status_updater.update_pod_group(job.pod_group)
+
+    # ------------------------------------------------------------------
+    # snapshot (cache.go:584-654)
+    # ------------------------------------------------------------------
+    def snapshot(self) -> ClusterInfo:
+        """Deep-clone ready nodes, all queues, and every job that has a
+        PodGroup and whose queue exists."""
+        with self._lock:
+            ci = ClusterInfo(self.spec)
+            for name, node in self.nodes.items():
+                if node.ready:
+                    ci.nodes[name] = node.clone()
+            for name, q in self.queues.items():
+                ci.queues[name] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.pod_group is None:
+                    continue
+                if job.queue not in self.queues:
+                    logger.warning("job %s queue %s not found, skipped", uid, job.queue)
+                    continue
+                clone = job.clone()
+                # resolve job priority from PriorityClass (cache.go:610-620)
+                pc = self.priority_classes.get(
+                    job.pod_group.priority_class
+                ) if job.pod_group.priority_class else None
+                if pc is not None:
+                    clone.priority = pc.value
+                elif self.default_priority:
+                    clone.priority = self.default_priority
+                ci.jobs[uid] = clone
+            return ci

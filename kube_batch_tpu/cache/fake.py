@@ -1,0 +1,48 @@
+"""Test fakes (pkg/scheduler/util/test_utils.go:94-163): record effects into
+maps and signal a channel-like event so tests can await async binds."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from kube_batch_tpu.api.pod import Pod
+
+
+class FakeBinder:
+    def __init__(self):
+        self.binds: Dict[str, str] = {}  # "ns/name" → node
+        self.channel: List[str] = []
+        self.event = threading.Event()
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        key = f"{pod.namespace}/{pod.name}"
+        self.binds[key] = hostname
+        self.channel.append(key)
+        self.event.set()
+
+
+class FakeEvictor:
+    def __init__(self):
+        self.evicts: List[str] = []
+        self.event = threading.Event()
+
+    def evict(self, pod: Pod) -> None:
+        self.evicts.append(f"{pod.namespace}/{pod.name}")
+        self.event.set()
+
+
+class FakeStatusUpdater:
+    def update_pod_condition(self, pod, condition) -> None:
+        pass
+
+    def update_pod_group(self, pod_group) -> None:
+        pass
+
+
+class FakeVolumeBinder:
+    def allocate_volumes(self, task, hostname) -> None:
+        pass
+
+    def bind_volumes(self, task) -> None:
+        pass

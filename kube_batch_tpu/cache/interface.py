@@ -1,0 +1,33 @@
+"""Cache side-effect seams (cache/interface.go:27-78).
+
+These are the process boundary: everything above them is in-memory scheduling
+state; implementations talk to whatever actually runs pods (a k8s apiserver
+adapter, the synthetic cluster backend, or test fakes)."""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from kube_batch_tpu.api.pod import Pod
+
+
+class Binder(Protocol):
+    def bind(self, pod: Pod, hostname: str) -> None:
+        """Place the pod; raise to signal failure (→ resync)."""
+
+
+class Evictor(Protocol):
+    def evict(self, pod: Pod) -> None:
+        """Delete/evict the pod; raise to signal failure (→ resync)."""
+
+
+class StatusUpdater(Protocol):
+    def update_pod_condition(self, pod: Pod, condition: dict) -> None: ...
+
+    def update_pod_group(self, pod_group) -> None: ...
+
+
+class VolumeBinder(Protocol):
+    def allocate_volumes(self, task, hostname: str) -> None: ...
+
+    def bind_volumes(self, task) -> None: ...

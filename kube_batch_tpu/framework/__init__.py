@@ -1,0 +1,38 @@
+from kube_batch_tpu.framework.arguments import Arguments
+from kube_batch_tpu.framework.conf import (
+    PluginOption,
+    SchedulerConfiguration,
+    Tier,
+    default_configuration,
+    load_scheduler_conf,
+)
+from kube_batch_tpu.framework.interface import (
+    Action,
+    Plugin,
+    get_action,
+    get_plugin_builder,
+    list_actions,
+    register_action,
+    register_plugin_builder,
+)
+from kube_batch_tpu.framework.session import Session, Statement, open_session, close_session
+
+__all__ = [
+    "Arguments",
+    "PluginOption",
+    "SchedulerConfiguration",
+    "Tier",
+    "default_configuration",
+    "load_scheduler_conf",
+    "Action",
+    "Plugin",
+    "get_action",
+    "get_plugin_builder",
+    "list_actions",
+    "register_action",
+    "register_plugin_builder",
+    "Session",
+    "Statement",
+    "open_session",
+    "close_session",
+]

@@ -1,0 +1,143 @@
+"""Scheduler behavior configuration (conf/scheduler_conf.go:20-56 +
+pkg/scheduler/util.go:31-70 loadSchedulerConf + plugins/defaults.go:22-52).
+
+YAML shape, compatible with the reference's scheduler-conf files:
+
+    actions: "enqueue, reclaim, allocate, backfill, preempt"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+      - name: conformance
+    - plugins:
+      - name: drf
+      - name: predicates
+      - name: proportion
+      - name: nodeorder
+        arguments:
+          leastrequested.weight: 2
+
+Each plugin option carries nine enable switches (all default true) and an
+Arguments string map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import yaml
+
+from kube_batch_tpu.framework.arguments import Arguments
+
+ENABLE_FIELDS = (
+    "enabledJobOrder",
+    "enabledJobReady",
+    "enabledJobPipelined",
+    "enabledTaskOrder",
+    "enabledPreemptable",
+    "enabledReclaimable",
+    "enabledQueueOrder",
+    "enabledPredicate",
+    "enabledNodeOrder",
+)
+
+
+@dataclasses.dataclass
+class PluginOption:
+    name: str
+    enabled_job_order: bool = True
+    enabled_job_ready: bool = True
+    enabled_job_pipelined: bool = True
+    enabled_task_order: bool = True
+    enabled_preemptable: bool = True
+    enabled_reclaimable: bool = True
+    enabled_queue_order: bool = True
+    enabled_predicate: bool = True
+    enabled_node_order: bool = True
+    arguments: Arguments = dataclasses.field(default_factory=Arguments)
+
+
+@dataclasses.dataclass
+class Tier:
+    plugins: List[PluginOption] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SchedulerConfiguration:
+    actions: List[str] = dataclasses.field(default_factory=list)
+    tiers: List[Tier] = dataclasses.field(default_factory=list)
+
+    def plugin_option(self, name: str) -> Optional[PluginOption]:
+        for tier in self.tiers:
+            for p in tier.plugins:
+                if p.name == name:
+                    return p
+        return None
+
+    def plugin_enabled(self, name: str) -> bool:
+        return self.plugin_option(name) is not None
+
+
+def _snake(field: str) -> str:
+    # enabledJobOrder → enabled_job_order
+    out = []
+    for ch in field:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def parse_scheduler_conf(text: str) -> SchedulerConfiguration:
+    """Parse the YAML conf; unknown action names raise at load like
+    util.go:63-70."""
+    data = yaml.safe_load(text) or {}
+    actions = [a.strip() for a in str(data.get("actions", "")).split(",") if a.strip()]
+    tiers: List[Tier] = []
+    for tier_data in data.get("tiers") or []:
+        plugins = []
+        for p in tier_data.get("plugins") or []:
+            opt = PluginOption(name=p["name"])
+            for field in ENABLE_FIELDS:
+                if field in p:
+                    setattr(opt, _snake(field), bool(p[field]))
+            if p.get("arguments"):
+                opt.arguments = Arguments(
+                    {str(k): str(v) for k, v in p["arguments"].items()}
+                )
+            plugins.append(opt)
+        tiers.append(Tier(plugins=plugins))
+    return SchedulerConfiguration(actions=actions, tiers=tiers)
+
+
+DEFAULT_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def default_configuration() -> SchedulerConfiguration:
+    """The built-in fallback conf (pkg/scheduler/util.go:31-42)."""
+    return parse_scheduler_conf(DEFAULT_CONF)
+
+
+def load_scheduler_conf(path: Optional[str]) -> SchedulerConfiguration:
+    """Load conf from a file path, or the built-in default when None
+    (pkg/scheduler/util.go:44-61). Unknown actions raise KeyError at
+    Scheduler construction when resolved against the action registry."""
+    if not path:
+        return default_configuration()
+    with open(path) as f:
+        return parse_scheduler_conf(f.read())

@@ -1,0 +1,74 @@
+"""Action/Plugin interfaces and registries (framework/interface.go:20-41,
+framework/plugins.go:24-72)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+from kube_batch_tpu.framework.arguments import Arguments
+
+
+class Plugin:
+    """A scheduling policy: registers callbacks into the Session on open
+    (interface.go:35-41)."""
+
+    name: str = "plugin"
+
+    def __init__(self, arguments: Arguments | None = None):
+        self.arguments = arguments or Arguments()
+
+    def on_session_open(self, session) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def on_session_close(self, session) -> None:
+        pass
+
+
+class Action:
+    """A scheduling pass over the session (interface.go:20-32)."""
+
+    name: str = "action"
+
+    def initialize(self) -> None:
+        pass
+
+    def execute(self, session) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def uninitialize(self) -> None:
+        pass
+
+
+_lock = threading.Lock()
+_plugin_builders: Dict[str, Callable[[Arguments], Plugin]] = {}
+_actions: Dict[str, Action] = {}
+
+
+def register_plugin_builder(name: str, builder: Callable[[Arguments], Plugin]) -> None:
+    with _lock:
+        _plugin_builders[name] = builder
+
+
+def get_plugin_builder(name: str) -> Callable[[Arguments], Plugin]:
+    with _lock:
+        if name not in _plugin_builders:
+            raise KeyError(f"unknown plugin {name!r}")
+        return _plugin_builders[name]
+
+
+def register_action(action: Action) -> None:
+    with _lock:
+        _actions[action.name] = action
+
+
+def get_action(name: str) -> Action:
+    with _lock:
+        if name not in _actions:
+            raise KeyError(f"unknown action {name!r} (util.go:63-70)")
+        return _actions[name]
+
+
+def list_actions() -> List[str]:
+    with _lock:
+        return sorted(_actions)

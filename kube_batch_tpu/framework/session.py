@@ -1,0 +1,448 @@
+"""Session — the per-cycle runtime (framework/session.go, session_plugins.go,
+statement.go, framework.go).
+
+A Session owns one immutable-ish snapshot of the cluster (deep-cloned by the
+cache), the tier-configured plugin callbacks, and the mutation verbs
+(Allocate/Pipeline/Evict) whose committed effects flow back to the cache as
+bind/evict calls. The TPU divergence: the hot allocate path doesn't use the
+per-task verbs — it runs the device solve (ops/assignment.py) over the
+snapshot tensors and then *replays* the resulting assignment through the same
+verbs so host state, event handlers, and the binder see exactly the
+sequential semantics.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.pod import PodGroupCondition
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.framework.conf import Tier
+
+# fn-kind names used in the per-plugin registries
+JOB_ORDER, QUEUE_ORDER, TASK_ORDER = "job_order", "queue_order", "task_order"
+JOB_READY, JOB_PIPELINED, JOB_VALID = "job_ready", "job_pipelined", "job_valid"
+JOB_ENQUEUEABLE, OVERUSED = "job_enqueueable", "overused"
+PREEMPTABLE, RECLAIMABLE = "preemptable", "reclaimable"
+PREDICATE, NODE_ORDER = "predicate", "node_order"
+
+_ENABLE_FIELD = {
+    JOB_ORDER: "enabled_job_order",
+    QUEUE_ORDER: "enabled_queue_order",
+    TASK_ORDER: "enabled_task_order",
+    JOB_READY: "enabled_job_ready",
+    JOB_PIPELINED: "enabled_job_pipelined",
+    JOB_VALID: None,  # JobValid has no enable switch (session_plugins.go:244)
+    JOB_ENQUEUEABLE: None,
+    OVERUSED: None,
+    PREEMPTABLE: "enabled_preemptable",
+    RECLAIMABLE: "enabled_reclaimable",
+    PREDICATE: "enabled_predicate",
+    NODE_ORDER: "enabled_node_order",
+}
+
+
+class Event:
+    """Allocate/Deallocate event (framework/event.go:24-32)."""
+
+    def __init__(self, task: TaskInfo):
+        self.task = task
+
+
+class EventHandler:
+    def __init__(self, allocate_func=None, deallocate_func=None):
+        self.allocate_func = allocate_func
+        self.deallocate_func = deallocate_func
+
+
+class FitFailure(Exception):
+    """A predicate rejection with a reason (api.FitError analog)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class Session:
+    def __init__(self, cache, cluster: ClusterInfo, tiers: List[Tier]):
+        self.uid = str(uuid.uuid4())
+        self.cache = cache
+        self.spec = cluster.spec
+        self.jobs: Dict[str, JobInfo] = cluster.jobs
+        self.nodes: Dict[str, NodeInfo] = cluster.nodes
+        self.queues: Dict[str, QueueInfo] = cluster.queues
+        self.tiers = tiers
+        self.plugins: List = []
+        # plugin-fn registries: kind → {plugin_name: fn}
+        self._fns: Dict[str, Dict[str, Callable]] = {}
+        self.event_handlers: List[EventHandler] = []
+        # device-solve knobs populated by plugins at session open
+        from kube_batch_tpu.ops.scoring import ScoreWeights
+
+        self.score_weights = ScoreWeights()
+        # PodGroup statuses as they stood at open (session.go:102-105), used
+        # by the job updater to skip no-op writes
+        self.pod_group_status_at_open: Dict[str, object] = {
+            j.uid: (j.pod_group.phase, len(j.pod_group.conditions))
+            for j in self.jobs.values()
+            if j.pod_group
+        }
+
+    # ---- registration (session_plugins.go:25-97) ------------------------
+    def add_fn(self, kind: str, plugin_name: str, fn: Callable) -> None:
+        self._fns.setdefault(kind, {})[plugin_name] = fn
+
+    def add_event_handler(self, handler: EventHandler) -> None:
+        self.event_handlers.append(handler)
+
+    def _enabled(self, kind: str, opt) -> bool:
+        field = _ENABLE_FIELD[kind]
+        return True if field is None else getattr(opt, field)
+
+    def _iter_fns(self, kind: str):
+        """Yield (tier_index, fn) for enabled plugins, in tier order."""
+        fns = self._fns.get(kind, {})
+        for ti, tier in enumerate(self.tiers):
+            for opt in tier.plugins:
+                fn = fns.get(opt.name)
+                if fn is not None and self._enabled(kind, opt):
+                    yield ti, fn
+
+    def plugin_enabled(self, name: str) -> bool:
+        return any(opt.name == name for tier in self.tiers for opt in tier.plugins)
+
+    # ---- tiered dispatch ------------------------------------------------
+    def _order(self, kind: str, l, r, l_info: Tuple, r_info: Tuple) -> bool:
+        """First non-zero verdict wins; fallback CreationTimestamp-then-UID
+        (session_plugins.go:281-305)."""
+        for _, fn in self._iter_fns(kind):
+            v = fn(l, r)
+            if v != 0:
+                return v < 0
+        return l_info < r_info
+
+    def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
+        return self._order(JOB_ORDER, l, r, (l.creation_index, l.uid), (r.creation_index, r.uid))
+
+    def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
+        return self._order(QUEUE_ORDER, l, r, (l.name,), (r.name,))
+
+    def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
+        return self._order(
+            TASK_ORDER, l, r, (l.pod.creation_index, l.uid), (r.pod.creation_index, r.uid)
+        )
+
+    def _veto(self, kind: str, obj) -> bool:
+        """All enabled plugins must pass (JobReady session_plugins.go:202-220)."""
+        for _, fn in self._iter_fns(kind):
+            if not fn(obj):
+                return False
+        return True
+
+    def job_ready(self, job: JobInfo) -> bool:
+        return self._veto(JOB_READY, job)
+
+    def job_pipelined(self, job: JobInfo) -> bool:
+        return self._veto(JOB_PIPELINED, job)
+
+    def job_enqueueable(self, job: JobInfo) -> bool:
+        return self._veto(JOB_ENQUEUEABLE, job)
+
+    def job_valid(self, job: JobInfo) -> Optional[str]:
+        """First failing plugin's reason, None = valid
+        (session_plugins.go:244-260)."""
+        for _, fn in self._iter_fns(JOB_VALID):
+            reason = fn(job)
+            if reason is not None:
+                return reason
+        return None
+
+    def overused(self, queue: QueueInfo) -> bool:
+        """Any plugin saying overused wins (session_plugins.go:185-199)."""
+        return any(fn(queue) for _, fn in self._iter_fns(OVERUSED))
+
+    def _victims(self, kind: str, actor: TaskInfo, candidates: List[TaskInfo]):
+        """Per-tier intersection; first tier with a non-None verdict wins
+        (session_plugins.go:100-182). None = no plugin in the tier voted;
+        [] = plugins voted and vetoed everything."""
+        for ti, tier in enumerate(self.tiers):
+            victims: Optional[List[TaskInfo]] = None
+            init = False
+            for opt in tier.plugins:
+                fn = self._fns.get(kind, {}).get(opt.name)
+                if fn is None or not self._enabled(kind, opt):
+                    continue
+                cand = fn(actor, candidates)
+                if not init:
+                    victims, init = cand, True
+                elif victims is not None:
+                    cand_uids = {c.uid for c in (cand or [])}
+                    victims = [v for v in victims if v.uid in cand_uids]
+            if victims is not None:
+                return victims
+        return None
+
+    def preemptable(self, preemptor: TaskInfo, preemptees: List[TaskInfo]):
+        return self._victims(PREEMPTABLE, preemptor, preemptees)
+
+    def reclaimable(self, reclaimer: TaskInfo, reclaimees: List[TaskInfo]):
+        return self._victims(RECLAIMABLE, reclaimer, reclaimees)
+
+    def predicate(self, task: TaskInfo, node: NodeInfo) -> None:
+        """All enabled predicates must pass; raises FitFailure
+        (session_plugins.go:372-389)."""
+        for _, fn in self._iter_fns(PREDICATE):
+            fn(task, node)  # raises FitFailure
+
+    def node_order(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Additive score (session_plugins.go:392-412)."""
+        return sum(fn(task, node) for _, fn in self._iter_fns(NODE_ORDER))
+
+    # ---- verbs (session.go:199-363) -------------------------------------
+    def _fire(self, allocate: bool, task: TaskInfo) -> None:
+        for eh in self.event_handlers:
+            fn = eh.allocate_func if allocate else eh.deallocate_func
+            if fn is not None:
+                fn(Event(task))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        job = self.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self._fire(True, task)
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        """Allocate + (when the job turns ready) dispatch every Allocated
+        task to the binder (session.go:252-296)."""
+        self.cache.allocate_volumes(task, hostname)
+        job = self.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self._fire(True, task)
+        if job is not None and self.job_ready(job):
+            for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
+                self.dispatch(t)
+
+    def dispatch(self, task: TaskInfo) -> None:
+        self.cache.bind_volumes(task)
+        self.cache.bind(task, task.node_name)
+        job = self.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.BINDING)
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        self.cache.evict(task, reason)
+        job = self.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.RELEASING)
+        node = self.nodes.get(task.node_name)
+        if node is not None:
+            node.update_task(task)
+        self._fire(False, task)
+
+    def statement(self) -> "Statement":
+        return Statement(self)
+
+    def update_job_condition(self, job: JobInfo, condition: PodGroupCondition) -> None:
+        """Upsert by type (session.go:366-388)."""
+        if job.pod_group is None:
+            return
+        for i, c in enumerate(job.pod_group.conditions):
+            if c.type == condition.type:
+                job.pod_group.conditions[i] = condition
+                return
+        job.pod_group.conditions.append(condition)
+
+
+class Statement:
+    """All-or-nothing op log (statement.go:29-337): verbs mutate session
+    state immediately and append ops; Commit replays against the cache,
+    Discard undoes in reverse."""
+
+    def __init__(self, ssn: Session):
+        self.ssn = ssn
+        self.operations: List[Tuple[str, tuple]] = []
+
+    # -- session-visible verbs -------------------------------------------
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire(False, reclaimee)
+        self.operations.append(("evict", (reclaimee, reason)))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire(True, task)
+        self.operations.append(("pipeline", (task, hostname)))
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        self.ssn.cache.allocate_volumes(task, hostname)
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire(True, task)
+        self.operations.append(("allocate", (task, hostname)))
+
+    # -- terminal ---------------------------------------------------------
+    def commit(self) -> None:
+        for name, args in self.operations:
+            if name == "evict":
+                task, reason = args
+                self.ssn.cache.evict(task, reason)
+            elif name == "pipeline":
+                pass  # session-only state (statement.go pipeline no-ops on commit)
+            elif name == "allocate":
+                task, _ = args
+                self.ssn.cache.bind_volumes(task)
+                self.ssn.cache.bind(task, task.node_name)
+                job = self.ssn.jobs.get(task.job)
+                if job is not None:
+                    job.update_task_status(task, TaskStatus.BINDING)
+        self.operations = []
+
+    def discard(self) -> None:
+        for name, args in reversed(self.operations):
+            if name == "evict":
+                task, _ = args
+                self._unevict(task)
+            elif name == "pipeline":
+                task, _ = args
+                self._unpipeline(task)
+            elif name == "allocate":
+                task, _ = args
+                self._unallocate(task)
+        self.operations = []
+
+    # -- inverses (statement.go unevict/unpipeline/unallocate) ------------
+    def _unevict(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.RUNNING)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.update_task(task)
+        self.ssn._fire(True, task)
+
+    def _unpipeline(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PENDING)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        task.node_name = None
+        self.ssn._fire(False, task)
+
+    def _unallocate(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.PENDING)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        task.node_name = None
+        self.ssn._fire(False, task)
+
+
+# ---- session lifecycle (framework/framework.go:30-62) -------------------
+
+def open_session(cache, tiers: List[Tier], plugin_options=None) -> Session:
+    """Snapshot the cache, drop gang-invalid jobs (marking them
+    unschedulable, session.go:107-124), and run every configured plugin's
+    OnSessionOpen."""
+    from kube_batch_tpu.framework.interface import get_plugin_builder
+
+    cluster = cache.snapshot()
+    ssn = Session(cache, cluster, tiers)
+    for tier in tiers:
+        for opt in tier.plugins:
+            plugin = get_plugin_builder(opt.name)(opt.arguments)
+            ssn.plugins.append(plugin)
+            plugin.on_session_open(ssn)
+    # gang-validity gate after plugins registered their JobValid fns
+    for uid, job in list(ssn.jobs.items()):
+        reason = ssn.job_valid(job)
+        if reason is not None:
+            ssn.update_job_condition(
+                job,
+                PodGroupCondition(
+                    type="Unschedulable",
+                    status="True",
+                    transition_id=ssn.uid,
+                    reason="NotEnoughPods",
+                    message=reason,
+                ),
+            )
+            cache.record_job_status_event(job)
+            del ssn.jobs[uid]
+    return ssn
+
+
+def job_status(ssn: Session, job: JobInfo) -> None:
+    """Derive and set the PodGroup phase/counts (session.go:151-189)."""
+    pg = job.pod_group
+    if pg is None:
+        return
+    unschedulable = any(
+        c.type == "Unschedulable" and c.status == "True" and c.transition_id == ssn.uid
+        for c in pg.conditions
+    )
+    running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+    if running and unschedulable:
+        pg.phase = PodGroupPhase.UNKNOWN
+    else:
+        allocated = job.task_num(
+            TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING, TaskStatus.ALLOCATED
+        )
+        if allocated >= pg.min_member:
+            pg.phase = PodGroupPhase.RUNNING
+        elif pg.phase != PodGroupPhase.INQUEUE:
+            pg.phase = PodGroupPhase.PENDING
+    pg.running = running
+    pg.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+    pg.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+
+
+def close_session(ssn: Session) -> None:
+    """Plugin close hooks then the job updater (framework.go:55-62 +
+    job_updater.go:33-122, sans the 16-worker pool — the host loop is cold)."""
+    for plugin in ssn.plugins:
+        plugin.on_session_close(ssn)
+    for job in ssn.jobs.values():
+        if job.pod_group is None:
+            continue
+        job_status(ssn, job)
+        ssn.cache.update_job_status(job)
+    ssn.jobs = {}
+    ssn.nodes = {}
+    ssn.queues = {}
+    ssn.plugins = []

@@ -1,0 +1,180 @@
+"""Prometheus-compatible metrics (pkg/scheduler/metrics/metrics.go:27-121).
+
+Same metric names and label sets under the `volcano` subsystem, with the
+reference's 5·2^k exponential buckets, rendered in the Prometheus text
+exposition format. Implemented standalone (no prometheus_client dependency);
+serve render_prometheus() from any HTTP endpoint to match the reference's
+`/metrics` (server.go:96-99)."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+# 5·2^k, k=0..9 (metrics.go:38-72)
+EXP_BUCKETS = [5.0 * (2**k) for k in range(10)]
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str, labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = labels
+        self._lock = threading.Lock()
+        self._buckets: Dict[Tuple[str, ...], List[int]] = defaultdict(
+            lambda: [0] * (len(EXP_BUCKETS) + 1)
+        )
+        self._sum: Dict[Tuple[str, ...], float] = defaultdict(float)
+        self._count: Dict[Tuple[str, ...], int] = defaultdict(int)
+
+    def observe(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            b = self._buckets[label_values]
+            b[bisect.bisect_left(EXP_BUCKETS, value)] += 1
+            self._sum[label_values] += value
+            self._count[label_values] += 1
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for labels, buckets in self._buckets.items():
+                base = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self.label_names, labels)
+                )
+                cum = 0
+                for le, cnt in zip(EXP_BUCKETS, buckets):
+                    cum += cnt
+                    sep = "," if base else ""
+                    lines.append(f'{self.name}_bucket{{{base}{sep}le="{le:g}"}} {cum}')
+                cum += buckets[-1]
+                sep = "," if base else ""
+                lines.append(f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {cum}')
+                lines.append(f"{self.name}_sum{{{base}}} {self._sum[labels]:g}")
+                lines.append(f"{self.name}_count{{{base}}} {self._count[labels]}")
+        return "\n".join(lines)
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, labels: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = labels
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = defaultdict(float)
+
+    def add(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[label_values] += value
+
+    def inc(self, *label_values: str) -> None:
+        self.add(1.0, *label_values)
+
+    def set(self, value: float, *label_values: str) -> None:
+        with self._lock:
+            self._values[label_values] = value
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for labels, v in self._values.items():
+                base = ",".join(f'{n}="{val}"' for n, val in zip(self.label_names, labels))
+                lines.append(f"{self.name}{{{base}}} {v:g}")
+        return "\n".join(lines)
+
+
+_SUBSYSTEM = "volcano"
+
+E2E_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_e2e_scheduling_latency_milliseconds",
+    "E2E scheduling latency in milliseconds",
+)
+PLUGIN_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_plugin_scheduling_latency_microseconds",
+    "Plugin scheduling latency in microseconds",
+    ("plugin", "OnSession"),
+)
+ACTION_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_action_scheduling_latency_microseconds",
+    "Action scheduling latency in microseconds",
+    ("action",),
+)
+TASK_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_task_scheduling_latency_microseconds",
+    "Task scheduling latency in microseconds",
+)
+SCHEDULE_ATTEMPTS = Counter(
+    f"{_SUBSYSTEM}_schedule_attempts_total",
+    "Number of attempts to schedule pods, by the result",
+    ("result",),
+)
+POD_PREEMPTION_VICTIMS = Counter(
+    f"{_SUBSYSTEM}_pod_preemption_victims",
+    "Number of selected preemption victims",
+)
+PREEMPTION_ATTEMPTS = Counter(
+    f"{_SUBSYSTEM}_total_preemption_attempts",
+    "Total preemption attempts in the cluster till now",
+)
+UNSCHEDULE_TASK_COUNT = Counter(
+    f"{_SUBSYSTEM}_unschedule_task_count",
+    "Number of tasks could not be scheduled",
+    ("job_id",),
+)
+UNSCHEDULE_JOB_COUNT = Counter(
+    f"{_SUBSYSTEM}_unschedule_job_count",
+    "Number of jobs could not be scheduled",
+)
+
+METRICS = [
+    E2E_LATENCY,
+    PLUGIN_LATENCY,
+    ACTION_LATENCY,
+    TASK_LATENCY,
+    SCHEDULE_ATTEMPTS,
+    POD_PREEMPTION_VICTIMS,
+    PREEMPTION_ATTEMPTS,
+    UNSCHEDULE_TASK_COUNT,
+    UNSCHEDULE_JOB_COUNT,
+]
+
+
+def observe_e2e_latency(ms: float) -> None:
+    E2E_LATENCY.observe(ms)
+
+
+def observe_action_latency(action: str, us: float) -> None:
+    ACTION_LATENCY.observe(us, action)
+
+
+def observe_plugin_latency(plugin: str, on_session: str, us: float) -> None:
+    PLUGIN_LATENCY.observe(us, plugin, on_session)
+
+
+def observe_task_latency(us: float) -> None:
+    TASK_LATENCY.observe(us)
+
+
+def register_schedule_attempt(result: str) -> None:
+    SCHEDULE_ATTEMPTS.inc(result)
+
+
+def update_preemption_victims(count: int) -> None:
+    POD_PREEMPTION_VICTIMS.add(count)
+
+
+def register_preemption_attempt() -> None:
+    PREEMPTION_ATTEMPTS.inc()
+
+
+def update_unschedule_task_count(job_id: str, count: int) -> None:
+    UNSCHEDULE_TASK_COUNT.set(count, job_id)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    UNSCHEDULE_JOB_COUNT.set(count)
+
+
+def render_prometheus() -> str:
+    return "\n".join(m.render() for m in METRICS) + "\n"

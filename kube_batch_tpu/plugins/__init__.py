@@ -1,0 +1,28 @@
+"""Plugin registry (plugins/factory.go:31-42 + binpack, SURVEY.md §2.4)."""
+
+from kube_batch_tpu.framework.interface import register_plugin_builder
+
+from kube_batch_tpu.plugins.binpack import BinpackPlugin
+from kube_batch_tpu.plugins.conformance import ConformancePlugin
+from kube_batch_tpu.plugins.drf import DrfPlugin
+from kube_batch_tpu.plugins.gang import GangPlugin
+from kube_batch_tpu.plugins.nodeorder import NodeOrderPlugin
+from kube_batch_tpu.plugins.predicates import PredicatesPlugin
+from kube_batch_tpu.plugins.priority import PriorityPlugin
+from kube_batch_tpu.plugins.proportion import ProportionPlugin
+
+ALL_PLUGINS = (
+    GangPlugin,
+    DrfPlugin,
+    ProportionPlugin,
+    PriorityPlugin,
+    PredicatesPlugin,
+    NodeOrderPlugin,
+    ConformancePlugin,
+    BinpackPlugin,
+)
+
+for cls in ALL_PLUGINS:
+    register_plugin_builder(cls.name, cls)
+
+__all__ = [cls.__name__ for cls in ALL_PLUGINS] + ["ALL_PLUGINS"]

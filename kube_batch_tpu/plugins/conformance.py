@@ -1,0 +1,32 @@
+"""conformance plugin (plugins/conformance/conformance.go:42-59): never evict
+critical pods — system-cluster-critical / system-node-critical priority
+classes or anything in kube-system."""
+
+from __future__ import annotations
+
+from typing import List
+
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+
+class ConformancePlugin(Plugin):
+    name = "conformance"
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        def evictable(evictor: TaskInfo, evictees: List[TaskInfo]) -> List[TaskInfo]:
+            victims = []
+            for ee in evictees:
+                if (
+                    ee.pod.priority_class in CRITICAL_PRIORITY_CLASSES
+                    or ee.namespace == "kube-system"
+                ):
+                    continue
+                victims.append(ee)
+            return victims
+
+        ssn.add_fn(fw.PREEMPTABLE, self.name, evictable)
+        ssn.add_fn(fw.RECLAIMABLE, self.name, evictable)

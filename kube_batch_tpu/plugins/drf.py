@@ -1,0 +1,108 @@
+"""drf plugin (plugins/drf/drf.go) — dominant-resource fairness at job level.
+
+Registers: Preemptable (preemptor's post-allocation share must stay ≤
+victim-job's post-eviction share), JobOrder (lower share first), and event
+handlers keeping per-job allocated/share incrementally updated during the
+session (drf.go:135-154). The device solve reproduces the same ordering via
+virtual drf shares (ops/ordering.py); this host state drives preempt/reclaim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.resources import Resource
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import is_allocated
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+SHARE_DELTA = 1e-6  # drf.go:33
+
+
+class _JobAttr:
+    __slots__ = ("allocated", "share")
+
+    def __init__(self, allocated: Resource):
+        self.allocated = allocated
+        self.share = 0.0
+
+
+class DrfPlugin(Plugin):
+    name = "drf"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.total: Resource | None = None
+        self.job_attrs: Dict[str, _JobAttr] = {}
+
+    def _update_share(self, attr: _JobAttr) -> None:
+        attr.share = attr.allocated.share(self.total)
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        self.total = ssn.spec.empty()
+        for node in ssn.nodes.values():
+            self.total.add_(node.allocatable)
+        for job in ssn.jobs.values():
+            attr = _JobAttr(ssn.spec.empty())
+            for status, tasks in job.task_status_index.items():
+                if is_allocated(status):
+                    for t in tasks.values():
+                        attr.allocated.add_(t.resreq)
+            self._update_share(attr)
+            self.job_attrs[job.uid] = attr
+
+        def preemptable(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
+            """(drf.go:85-110)"""
+            lattr = self.job_attrs.get(preemptor.job)
+            if lattr is None:
+                return []
+            lalloc = lattr.allocated.add(preemptor.resreq)
+            ls = lalloc.share(self.total)
+            allocations: Dict[str, Resource] = {}
+            victims: List[TaskInfo] = []
+            for ee in preemptees:
+                rattr = self.job_attrs.get(ee.job)
+                if rattr is None:
+                    continue
+                if ee.job not in allocations:
+                    allocations[ee.job] = rattr.allocated.clone()
+                ralloc = allocations[ee.job]
+                if not ee.resreq.less_equal(ralloc):
+                    continue
+                ralloc.sub_(ee.resreq)
+                rs = ralloc.share(self.total)
+                if ls < rs or abs(ls - rs) <= SHARE_DELTA:
+                    victims.append(ee)
+            return victims
+
+        def job_order(l: JobInfo, r: JobInfo) -> int:
+            """(drf.go:114-132) lower dominant share first."""
+            ls = self.job_attrs[l.uid].share if l.uid in self.job_attrs else 0.0
+            rs = self.job_attrs[r.uid].share if r.uid in self.job_attrs else 0.0
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        def on_allocate(event: fw.Event) -> None:
+            attr = self.job_attrs.get(event.task.job)
+            if attr is not None:
+                attr.allocated.add_(event.task.resreq)
+                self._update_share(attr)
+
+        def on_deallocate(event: fw.Event) -> None:
+            attr = self.job_attrs.get(event.task.job)
+            if attr is not None:
+                attr.allocated.sub_(event.task.resreq)
+                self._update_share(attr)
+
+        ssn.add_fn(fw.PREEMPTABLE, self.name, preemptable)
+        ssn.add_fn(fw.JOB_ORDER, self.name, job_order)
+        ssn.add_event_handler(
+            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def on_session_close(self, ssn: fw.Session) -> None:
+        self.total = None
+        self.job_attrs = {}

@@ -1,0 +1,87 @@
+"""gang plugin (plugins/gang/gang.go) — gang-integrity policy.
+
+Registers: JobValid (minMember check), Preemptable/Reclaimable (never shrink
+a gang below minAvailable), JobOrder (starved gangs first), JobReady,
+JobPipelined. OnSessionClose writes Unschedulable conditions + fit errors for
+still-unready jobs (gang.go:132-175).
+
+The device allocate solve enforces the same commit gate tensor-side
+(ops/assignment.py outer_body); this host plugin is authoritative for the
+host-path actions (preempt/reclaim/backfill) and for session bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.pod import PodGroupCondition
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+
+class GangPlugin(Plugin):
+    name = "gang"
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        def job_valid(job: JobInfo):
+            """(gang.go:48-69) valid iff enough potentially-runnable tasks."""
+            valid = job.valid_task_num
+            if valid < job.min_available:
+                return (
+                    f"Not enough valid tasks for gang-scheduling, "
+                    f"valid: {valid}, min: {job.min_available}"
+                )
+            return None
+
+        def evictable(evictor: TaskInfo, evictees: List[TaskInfo]) -> List[TaskInfo]:
+            """(gang.go:71-94) a task is a victim only if its job stays at or
+            above minAvailable after all victims so far are removed."""
+            victims: List[TaskInfo] = []
+            occupied: Dict[str, int] = {}
+            for ee in evictees:
+                job = ssn.jobs.get(ee.job)
+                if job is None:
+                    continue
+                if job.uid not in occupied:
+                    occupied[job.uid] = job.ready_task_num
+                if occupied[job.uid] > job.min_available:
+                    occupied[job.uid] -= 1
+                    victims.append(ee)
+            return victims
+
+        def job_order(l: JobInfo, r: JobInfo) -> int:
+            """(gang.go:96-121) starved (not ready) gangs first."""
+            l_ready, r_ready = l.ready(), r.ready()
+            if l_ready == r_ready:
+                return 0
+            return 1 if l_ready else -1
+
+        ssn.add_fn(fw.JOB_VALID, self.name, job_valid)
+        ssn.add_fn(fw.PREEMPTABLE, self.name, evictable)
+        ssn.add_fn(fw.RECLAIMABLE, self.name, evictable)
+        ssn.add_fn(fw.JOB_ORDER, self.name, job_order)
+        ssn.add_fn(fw.JOB_READY, self.name, lambda job: job.ready())
+        ssn.add_fn(fw.JOB_PIPELINED, self.name, lambda job: job.pipelined())
+
+    def on_session_close(self, ssn: fw.Session) -> None:
+        """(gang.go:132-175) mark still-unready jobs Unschedulable."""
+        for job in ssn.jobs.values():
+            if job.ready() or not job.tasks:
+                continue
+            fit_errors = [fe.error() for fe in job.nodes_fit_errors.values()]
+            message = job.fit_error() + (
+                f"; {fit_errors[0]}" if fit_errors else ""
+            )
+            ssn.update_job_condition(
+                job,
+                PodGroupCondition(
+                    type="Unschedulable",
+                    status="True",
+                    transition_id=ssn.uid,
+                    reason="NotEnoughResources",
+                    message=message,
+                ),
+            )
+            ssn.cache.record_job_status_event(job)

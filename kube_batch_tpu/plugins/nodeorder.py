@@ -1,0 +1,63 @@
+"""nodeorder plugin (plugins/nodeorder/nodeorder.go) — weighted node scoring.
+
+Configures the device score rows (ops/scoring.py) via session.score_weights
+and registers the host per-(task, node) scorer used by preempt/reclaim.
+Weights come from plugin arguments (nodeorder.go:34-43), default 1 each.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+NODE_AFFINITY_WEIGHT = "nodeaffinity.weight"
+POD_AFFINITY_WEIGHT = "podaffinity.weight"
+LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
+BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
+
+MAX_PRIORITY = 10.0
+
+
+def least_requested_score(task: TaskInfo, node: NodeInfo) -> float:
+    total = 0.0
+    for i in (0, 1):  # cpu, memory
+        alloc = node.allocatable.vec[i]
+        if alloc <= 0:
+            continue
+        free = alloc - node.used.vec[i] - task.resreq.vec[i]
+        total += max(min(free / alloc, 1.0), 0.0) * MAX_PRIORITY
+    return total / 2.0
+
+
+def balanced_resource_score(task: TaskInfo, node: NodeInfo) -> float:
+    fracs = []
+    for i in (0, 1):
+        alloc = node.allocatable.vec[i]
+        want = node.used.vec[i] + task.resreq.vec[i]
+        fracs.append(min(want / alloc, 1.0) if alloc > 0 else 1.0)
+    return (1.0 - abs(fracs[0] - fracs[1])) * MAX_PRIORITY
+
+
+class NodeOrderPlugin(Plugin):
+    name = "nodeorder"
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        w_least = self.arguments.get_int(LEAST_REQUESTED_WEIGHT, 1)
+        w_balanced = self.arguments.get_int(BALANCED_RESOURCE_WEIGHT, 1)
+        w_affinity = self.arguments.get_int(NODE_AFFINITY_WEIGHT, 1)
+
+        ssn.score_weights = ssn.score_weights._replace(
+            least_requested=float(w_least),
+            balanced_resource=float(w_balanced),
+            node_affinity=float(w_affinity),
+        )
+
+        def node_order(task: TaskInfo, node: NodeInfo) -> float:
+            return (
+                w_least * least_requested_score(task, node)
+                + w_balanced * balanced_resource_score(task, node)
+            )
+
+        ssn.add_fn(fw.NODE_ORDER, self.name, node_order)

@@ -1,0 +1,104 @@
+"""predicates plugin (plugins/predicates/predicates.go) — node filtering.
+
+The device solve evaluates these same predicates as bitset tensor ops
+(ops/feasibility.py); this host fn is the authoritative per-(task, node)
+form used by the host-path actions (preempt/reclaim/backfill) and by tests.
+
+Checks, mirroring predicates.go:154-298:
+  max-pods (:162-166), CheckNodeCondition/Unschedulable (:169-192),
+  MatchNodeSelector incl. required node-affinity terms (:194-205),
+  PodFitsHostPorts (:207-218), PodToleratesNodeTaints (:220-231), and the
+  optional Memory/Disk/PID pressure gates driven by plugin arguments
+  (:233-276; arg keys :34-41). Inter-pod affinity is not yet modeled (the
+  snapshot carries no pod-affinity terms); tracked for a later round.
+"""
+
+from __future__ import annotations
+
+from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.snapshot import HARD_TAINT_EFFECTS
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+# plugin argument keys (predicates.go:34-41)
+MEMORY_PRESSURE_KEY = "predicate.MemoryPressureEnable"
+DISK_PRESSURE_KEY = "predicate.DiskPressureEnable"
+PID_PRESSURE_KEY = "predicate.PIDPressureEnable"
+
+
+def match_node_selector(task: TaskInfo, node: NodeInfo) -> bool:
+    labels = node.node.labels if node.node else {}
+    for k, v in task.pod.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    if task.pod.affinity is not None:
+        terms = task.pod.affinity.node_terms
+        if terms:
+            def term_ok(term):
+                for key, op, values in term:
+                    has = key in labels
+                    if op == "In" and labels.get(key) not in values:
+                        return False
+                    if op == "NotIn" and labels.get(key) in values:
+                        return False
+                    if op == "Exists" and not has:
+                        return False
+                    if op == "DoesNotExist" and has:
+                        return False
+                return True
+
+            if not any(term_ok(t) for t in terms):
+                return False
+    return True
+
+
+def tolerates_taints(task: TaskInfo, node: NodeInfo) -> bool:
+    for taint in node.node.taints if node.node else []:
+        if taint.effect not in HARD_TAINT_EFFECTS:
+            continue
+        if not any(tol.tolerates(taint) for tol in task.pod.tolerations):
+            return False
+    return True
+
+
+def fits_host_ports(task: TaskInfo, node: NodeInfo) -> bool:
+    wanted = set(task.pod.host_ports)
+    if not wanted:
+        return True
+    for other in node.tasks.values():
+        if wanted & set(other.pod.host_ports):
+            return False
+    return True
+
+
+class PredicatesPlugin(Plugin):
+    name = "predicates"
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        check_mem = self.arguments.get_bool(MEMORY_PRESSURE_KEY, False)
+        check_disk = self.arguments.get_bool(DISK_PRESSURE_KEY, False)
+        check_pid = self.arguments.get_bool(PID_PRESSURE_KEY, False)
+
+        def predicate(task: TaskInfo, node: NodeInfo) -> None:
+            if node.node is None or not node.node.ready:
+                raise fw.FitFailure("node(s) were not ready")
+            if node.node.unschedulable:
+                raise fw.FitFailure("node(s) were unschedulable")
+            if node.pod_count + 1 > int(node.allocatable.pods):
+                raise fw.FitFailure("node(s) pod number exceeded")
+            if not match_node_selector(task, node):
+                raise fw.FitFailure("node(s) didn't match node selector")
+            if not fits_host_ports(task, node):
+                raise fw.FitFailure("node(s) didn't have free ports")
+            if not tolerates_taints(task, node):
+                raise fw.FitFailure("node(s) had taints that the pod didn't tolerate")
+            conds = node.node.conditions
+            if check_mem and conds.get("MemoryPressure"):
+                raise fw.FitFailure("node(s) had memory pressure")
+            if check_disk and conds.get("DiskPressure"):
+                raise fw.FitFailure("node(s) had disk pressure")
+            if check_pid and conds.get("PIDPressure"):
+                raise fw.FitFailure("node(s) had pid pressure")
+
+        ssn.add_fn(fw.PREDICATE, self.name, predicate)

@@ -1,0 +1,189 @@
+"""proportion plugin (plugins/proportion/proportion.go) — weighted max-min
+fair queue capacity.
+
+Registers: QueueOrder (lower share first), Reclaimable (victim's queue must
+stay ≥ deserved), Overused, JobEnqueueable (capability cap), and event
+handlers keeping per-queue allocation live. The deserved waterfill here is
+the host (numpy) twin of ops/fairness.proportion_deserved used by the device
+solve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from kube_batch_tpu.api.job_info import JobInfo
+from kube_batch_tpu.api.queue_info import QueueInfo
+from kube_batch_tpu.api.resources import Resource
+from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import TaskStatus, is_allocated
+from kube_batch_tpu.framework.interface import Plugin
+from kube_batch_tpu.framework import session as fw
+
+
+class _QueueAttr:
+    __slots__ = ("queue", "weight", "deserved", "allocated", "request", "share")
+
+    def __init__(self, queue: QueueInfo, spec):
+        self.queue = queue
+        self.weight = queue.weight
+        self.deserved = spec.empty()
+        self.allocated = spec.empty()
+        self.request = spec.empty()
+        self.share = 0.0
+
+
+class ProportionPlugin(Plugin):
+    name = "proportion"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.total: Resource | None = None
+        self.queue_attrs: Dict[str, _QueueAttr] = {}
+
+    def _update_share(self, attr: _QueueAttr) -> None:
+        """share = dominant allocated/deserved (proportion.go:265-277)."""
+        attr.share = _dominant(attr.allocated, attr.deserved)
+
+    def on_session_open(self, ssn: fw.Session) -> None:
+        spec = ssn.spec
+        self.total = spec.empty()
+        for node in ssn.nodes.values():
+            self.total.add_(node.allocatable)
+        # queue attrs from jobs present this session (proportion.go:67-99)
+        for job in ssn.jobs.values():
+            if job.queue not in ssn.queues:
+                continue
+            attr = self.queue_attrs.get(job.queue)
+            if attr is None:
+                attr = _QueueAttr(ssn.queues[job.queue], spec)
+                self.queue_attrs[job.queue] = attr
+            for status, tasks in job.task_status_index.items():
+                if is_allocated(status):
+                    for t in tasks.values():
+                        attr.allocated.add_(t.resreq)
+                        attr.request.add_(t.resreq)
+                elif status == TaskStatus.PENDING:
+                    for t in tasks.values():
+                        attr.request.add_(t.resreq)
+        self._waterfill(spec)
+        for attr in self.queue_attrs.values():
+            self._update_share(attr)
+
+        def queue_order(l: QueueInfo, r: QueueInfo) -> int:
+            la = self.queue_attrs.get(l.name)
+            ra = self.queue_attrs.get(r.name)
+            ls = la.share if la else 0.0
+            rs = ra.share if ra else 0.0
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        def reclaimable(reclaimer: TaskInfo, reclaimees: List[TaskInfo]) -> List[TaskInfo]:
+            """(proportion.go:171-196) victim OK if its queue stays ≥ deserved."""
+            victims: List[TaskInfo] = []
+            allocations: Dict[str, Resource] = {}
+            for ee in reclaimees:
+                job = ssn.jobs.get(ee.job)
+                if job is None or job.queue not in self.queue_attrs:
+                    continue
+                attr = self.queue_attrs[job.queue]
+                if job.queue not in allocations:
+                    allocations[job.queue] = attr.allocated.clone()
+                alloc = allocations[job.queue]
+                if not ee.resreq.less_equal(alloc):
+                    continue
+                alloc.sub_(ee.resreq)
+                if attr.deserved.less_equal(alloc):
+                    victims.append(ee)
+            return victims
+
+        def overused_fn(queue: QueueInfo) -> bool:
+            attr = self.queue_attrs.get(queue.name)
+            if attr is None:
+                return False
+            return attr.deserved.less_equal(attr.allocated)
+
+        def job_enqueueable(job: JobInfo) -> bool:
+            """(proportion.go:211-233) capability quota not exceeded."""
+            queue = ssn.queues.get(job.queue)
+            attr = self.queue_attrs.get(job.queue)
+            if queue is None or attr is None:
+                return True
+            capability = queue.queue.capability
+            if not capability:
+                return True
+            cap = ssn.spec.empty()
+            for name, v in capability.items():
+                if name in ssn.spec:
+                    cap.vec[ssn.spec.index(name)] = float(v)
+            min_res = ssn.spec.empty()
+            for name, v in (job.pod_group.min_resources or {}).items():
+                if name in ssn.spec:
+                    min_res.vec[ssn.spec.index(name)] = float(v)
+            return min_res.add(attr.allocated).less_equal(cap)
+
+        def on_allocate(event: fw.Event) -> None:
+            job = ssn.jobs.get(event.task.job)
+            if job and job.queue in self.queue_attrs:
+                attr = self.queue_attrs[job.queue]
+                attr.allocated.add_(event.task.resreq)
+                self._update_share(attr)
+
+        def on_deallocate(event: fw.Event) -> None:
+            job = ssn.jobs.get(event.task.job)
+            if job and job.queue in self.queue_attrs:
+                attr = self.queue_attrs[job.queue]
+                attr.allocated.sub_(event.task.resreq)
+                self._update_share(attr)
+
+        ssn.add_fn(fw.QUEUE_ORDER, self.name, queue_order)
+        ssn.add_fn(fw.RECLAIMABLE, self.name, reclaimable)
+        ssn.add_fn(fw.OVERUSED, self.name, overused_fn)
+        ssn.add_fn(fw.JOB_ENQUEUEABLE, self.name, job_enqueueable)
+        ssn.add_event_handler(
+            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+        )
+
+    def _waterfill(self, spec) -> None:
+        """deserved by weighted max-min (proportion.go:101-154); host twin of
+        ops/fairness.proportion_deserved."""
+        attrs = list(self.queue_attrs.values())
+        if not attrs:
+            return
+        remaining = self.total.vec.copy()
+        met = [False] * len(attrs)
+        for _ in range(max(len(attrs) * 2, 16)):
+            if not np.any(remaining > 1e-6) or all(met):
+                break
+            weights = np.array(
+                [a.weight if not m else 0.0 for a, m in zip(attrs, met)]
+            )
+            tw = weights.sum()
+            if tw <= 0:
+                break
+            for i, attr in enumerate(attrs):
+                if met[i]:
+                    continue
+                inc = remaining * (weights[i] / tw)
+                new = attr.deserved.vec + inc
+                if np.all(attr.request.vec <= new + 1e-6):
+                    new = np.minimum(new, attr.request.vec)
+                    met[i] = True
+                attr.deserved = spec.from_vec(new)
+            granted = sum(a.deserved.vec for a in attrs)
+            remaining = np.maximum(self.total.vec - granted, 0.0)
+
+    def on_session_close(self, ssn: fw.Session) -> None:
+        self.total = None
+        self.queue_attrs = {}
+
+
+def _dominant(alloc: Resource, deserved: Resource) -> float:
+    m = alloc.spec.semantic_mask
+    d = deserved.vec[m]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        r = np.where(d > 0, alloc.vec[m] / np.maximum(d, 1e-9), 0.0)
+    return float(r.max()) if r.size else 0.0

@@ -1,0 +1,66 @@
+"""Scheduler — the L1 loop (pkg/scheduler/scheduler.go:38-102).
+
+Holds the cache, the configured action pipeline, and the plugin tiers; each
+tick opens a session (snapshot + plugin open), executes the actions in conf
+order, and closes the session (status writeback). `run_forever` is the
+wait.Until(runOnce, period) analog."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from kube_batch_tpu import actions as _actions  # registers actions
+from kube_batch_tpu import plugins as _plugins  # registers plugin builders
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.framework.conf import SchedulerConfiguration, load_scheduler_conf
+from kube_batch_tpu.framework.interface import Action, get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu import metrics
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        conf: Optional[SchedulerConfiguration] = None,
+        conf_path: Optional[str] = None,
+        schedule_period: float = 1.0,
+    ):
+        self.cache = cache
+        self.conf = conf if conf is not None else load_scheduler_conf(conf_path)
+        # resolve actions at construction — unknown names raise (util.go:63-70)
+        self.actions: List[Action] = [get_action(n) for n in self.conf.actions]
+        self.schedule_period = schedule_period
+        self._stop = False
+
+    def run_once(self) -> None:
+        """(scheduler.go:88-102)"""
+        start = time.perf_counter()
+        ssn = open_session(self.cache, self.conf.tiers)
+        try:
+            for action in self.actions:
+                a_start = time.perf_counter()
+                action.execute(ssn)
+                metrics.observe_action_latency(
+                    action.name, (time.perf_counter() - a_start) * 1e6
+                )
+        finally:
+            close_session(ssn)
+        metrics.observe_e2e_latency((time.perf_counter() - start) * 1e3)
+
+    def run_forever(self) -> None:
+        while not self._stop:
+            tick = time.perf_counter()
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — next cycle self-corrects
+                logger.exception("scheduling cycle failed")
+            elapsed = time.perf_counter() - tick
+            time.sleep(max(self.schedule_period - elapsed, 0.0))
+
+    def stop(self) -> None:
+        self._stop = True

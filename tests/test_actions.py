@@ -1,0 +1,254 @@
+"""Fake-backend session tests of the actions — the rebuild's analog of
+actions/allocate/allocate_test.go, preempt_test.go, reclaim_test.go: real
+cache + real handlers + fake binder/evictor, assert on captured effects."""
+
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import PodGroup, Queue
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+from kube_batch_tpu.framework.conf import parse_scheduler_conf
+from kube_batch_tpu.framework.interface import get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu.scheduler import Scheduler
+
+from tests.fixtures import GiB, build_cache, build_node, build_pod
+
+TWO_TIER_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def run_actions(cache, conf_text=TWO_TIER_CONF, action_names=None):
+    conf = parse_scheduler_conf(conf_text)
+    ssn = open_session(cache, conf.tiers)
+    for name in action_names or conf.actions:
+        get_action(name).execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+class TestAllocateAction:
+    def test_gang_job_binds_all_tasks(self):
+        """allocate_test.go "allocate for gang": minMember gang placed and
+        bound through the FakeBinder."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=3, queue="default")],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB), build_node("n2", cpu=4000, mem=8 * GiB)],
+            pods=[
+                build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg1")
+                for i in range(3)
+            ],
+        )
+        run_actions(cache)
+        assert len(cache.binder.binds) == 3
+        assert set(cache.binder.binds) == {"c1/p0", "c1/p1", "c1/p2"}
+        assert all(n in ("n1", "n2") for n in cache.binder.binds.values())
+
+    def test_unsatisfiable_gang_binds_nothing(self):
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=5, queue="default")],
+            nodes=[build_node("n1", cpu=2000, mem=8 * GiB)],
+            pods=[
+                build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg1")
+                for i in range(5)
+            ],
+        )
+        run_actions(cache)
+        assert cache.binder.binds == {}
+        # job marked unschedulable at close (gang.go:132-175)
+        job = cache.jobs["c1/pg1"]
+        assert any(c.type == "Unschedulable" for c in job.pod_group.conditions)
+
+    def test_plain_pod_shadow_podgroup(self):
+        """A plain pod (no group annotation) gets a shadow PodGroup
+        (cache/util.go:42-60) and schedules alone."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "solo", None, PodPhase.PENDING, {"cpu": 500, "memory": GiB})],
+        )
+        run_actions(cache)
+        assert cache.binder.binds == {"c1/solo": "n1"}
+
+    def test_respects_existing_usage(self):
+        """Running pods already on the node shrink idle."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB)],
+            pods=[
+                build_pod("c1", "existing", "n1", PodPhase.RUNNING, {"cpu": 3000, "memory": GiB}),
+                build_pod("c1", "new1", None, PodPhase.PENDING, {"cpu": 2000, "memory": GiB}),
+                build_pod("c1", "new2", None, PodPhase.PENDING, {"cpu": 1000, "memory": GiB}),
+            ],
+        )
+        run_actions(cache)
+        # only the 1000m pod fits next to the 3000m resident
+        assert cache.binder.binds == {"c1/new2": "n1"}
+
+    def test_node_selector_respected(self):
+        cache = build_cache(
+            queues=["default"],
+            nodes=[
+                build_node("gpu-node", labels={"accel": "gpu"}),
+                build_node("cpu-node", labels={}),
+            ],
+            pods=[
+                build_pod("c1", "wants-gpu", None, PodPhase.PENDING,
+                          {"cpu": 500, "memory": GiB}, node_selector={"accel": "gpu"}),
+            ],
+        )
+        run_actions(cache)
+        assert cache.binder.binds == {"c1/wants-gpu": "gpu-node"}
+
+    def test_pending_phase_podgroup_skipped_without_enqueue(self):
+        """allocate.go:50-52: explicit Pending phase gates allocation."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=1, queue="default",
+                                 phase=PodGroupPhase.PENDING)],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "p0", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB}, group_name="pg1")],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds == {}
+
+    def test_enqueue_promotes_then_allocates(self):
+        """enqueue.go:102-117 → Inqueue → allocate binds."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=1, queue="default",
+                                 phase=PodGroupPhase.PENDING)],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "p0", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB}, group_name="pg1")],
+        )
+        run_actions(cache, action_names=["enqueue", "allocate"])
+        assert cache.binder.binds == {"c1/p0": "n1"}
+        assert cache.jobs["c1/pg1"].pod_group.phase == PodGroupPhase.RUNNING
+
+
+class TestBackfillAction:
+    def test_best_effort_backfilled(self):
+        """backfill.go:55-89: BestEffort pods placed without scoring."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=100, mem=GiB)],  # nearly no capacity
+            pods=[build_pod("c1", "be", None, PodPhase.PENDING, {})],
+        )
+        run_actions(cache)
+        assert cache.binder.binds == {"c1/be": "n1"}
+
+
+class TestPreemptAction:
+    def test_high_priority_job_preempts_within_queue(self):
+        """preempt_test.go: a starved high-priority gang evicts a running
+        lower-priority job's tasks in the same queue."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=1, queue="default"),
+                PodGroup(name="high", namespace="c1", min_member=1, queue="default",
+                         priority_class="high-prio"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "high-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="high", priority=100),
+            ],
+        )
+        from kube_batch_tpu.api.pod import PriorityClass
+
+        cache.add_priority_class(PriorityClass(name="high-prio", value=100))
+        run_actions(cache, action_names=["preempt"])
+        assert len(cache.evictor.evicts) == 1
+        assert cache.evictor.evicts[0].startswith("c1/low-")
+
+    def test_no_preemption_when_gang_would_break(self):
+        """gang.go:71-94: can't evict below the victim job's minAvailable."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=2, queue="default"),
+                PodGroup(name="high", namespace="c1", min_member=1, queue="default"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "high-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="high", priority=100),
+            ],
+        )
+        run_actions(cache, action_names=["preempt"])
+        assert cache.evictor.evicts == []
+
+
+class TestReclaimAction:
+    def test_starved_queue_reclaims_from_overfed_queue(self):
+        """reclaim_test.go / queue.go e2e: queue B's pending task evicts
+        queue A's running task when A is over its deserved share."""
+        cache = build_cache(
+            queues=[Queue(name="qa", weight=1), Queue(name="qb", weight=1)],
+            pod_groups=[
+                PodGroup(name="ja", namespace="c1", min_member=1, queue="qa"),
+                PodGroup(name="jb", namespace="c1", min_member=1, queue="qb"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "a-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="ja"),
+                build_pod("c1", "a-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="ja"),
+                build_pod("c1", "b-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="jb"),
+            ],
+        )
+        run_actions(cache, action_names=["reclaim"])
+        assert len(cache.evictor.evicts) == 1
+        assert cache.evictor.evicts[0].startswith("c1/a-")
+
+
+class TestSchedulerLoop:
+    def test_run_once_end_to_end(self):
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=2, queue="default")],
+            nodes=[build_node("n1")],
+            pods=[
+                build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg1")
+                for i in range(2)
+            ],
+        )
+        sched = Scheduler(cache)
+        sched.run_once()
+        assert len(cache.binder.binds) == 2
+
+    def test_unknown_action_raises(self):
+        cache = build_cache(queues=["default"])
+        conf = parse_scheduler_conf('actions: "bogus"\ntiers: []')
+        with pytest.raises(KeyError):
+            Scheduler(cache, conf=conf)
