@@ -1,0 +1,78 @@
+"""Benchmark — the BASELINE.json north star on real hardware.
+
+Times one gang-constrained scheduling cycle at 50k pods × 5k nodes
+(heterogeneous GPU gangs, 3 weighted queues, minMember=4): host→device ship
+of the snapshot arrays, the compiled allocate solve (predicates + scoring +
+fairness + ordering + gang commit/discard), and the assignment vector back.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against the driver-provided target of a 1000 ms
+cycle (BASELINE.md: the reference publishes no numbers; its design cadence
+is the 1 s schedule-period) — >1 means faster than target.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import jax
+import numpy as np
+
+from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
+
+TARGET_MS = 1000.0  # <1s per cycle on TPU v5e (BASELINE.md north star)
+
+N_TASKS = 50_000
+N_NODES = 5_000
+CYCLES = 5
+
+
+def one_cycle(snap_np, config):
+    snap = jax.device_put(snap_np)             # host→device: the only ship in
+    result = allocate_solve(snap, config)      # compiled cycle program
+    assigned = np.asarray(result.assigned)     # device→host: assignment back
+    return assigned
+
+
+def main() -> None:
+    config = AllocateConfig()
+    snap_np, meta = synthetic_device_snapshot(
+        n_tasks=N_TASKS,
+        n_nodes=N_NODES,
+        gang_size=4,
+        n_queues=3,
+        gpu_task_frac=0.2,
+        gpu_node_frac=0.25,
+    )
+
+    # warmup: compile + first execute
+    assigned = one_cycle(snap_np, config)
+    placed = int((assigned[: meta.n_tasks] >= 0).sum())
+
+    times = []
+    for _ in range(CYCLES):
+        t0 = time.perf_counter()
+        one_cycle(snap_np, config)
+        times.append((time.perf_counter() - t0) * 1e3)
+
+    p50 = statistics.median(times)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"gang_allocate_cycle_ms_{N_TASKS // 1000}k_pods_"
+                    f"{N_NODES // 1000}k_nodes_placed_{placed}"
+                ),
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_MS / p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,8 +39,14 @@ UNBOUNDED = np.float32(3.4e38)
 
 
 def bucket(n: int, floor: int = 8) -> int:
-    """Next power-of-two bucket ≥ max(n, floor) — bounds jit recompiles."""
-    return max(floor, 1 << max(0, math.ceil(math.log2(max(n, 1)))))
+    """Shape bucket ≥ max(n, floor) — bounds jit recompiles while keeping
+    padding waste low at scale: powers of two up to 4096, then multiples of
+    1024 (divisible by any power-of-two mesh axis ≤ 1024, and ≤2.5% waste
+    at the 50k/5k north-star sizes vs 64%/23% for pure powers of two)."""
+    n = max(n, floor)
+    if n <= 4096:
+        return max(floor, 1 << max(0, math.ceil(math.log2(n))))
+    return -(-n // 1024) * 1024
 
 
 class DeviceSnapshot(NamedTuple):

@@ -43,6 +43,22 @@ from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
 
 NEG = jnp.float32(-3.0e38)
 
+# Tie-break jitter magnitude: the reference's SelectBestNode picks uniformly
+# among max-score nodes (scheduler_helper.go:147-158); without an analog every
+# equal-score task herds onto the same argmax node and each bidding round
+# fills exactly one node. 1e-3 is far below any real score difference (the
+# k8s priority rows move in ~0.1 steps) but splits exact ties uniformly.
+JITTER_EPS = jnp.float32(1e-3)
+
+
+def _tie_break_jitter(T: int, N: int) -> jnp.ndarray:
+    """[T, N] deterministic per-(task, node) hash in [0, JITTER_EPS)."""
+    ti = jnp.arange(T, dtype=jnp.uint32)[:, None]
+    ni = jnp.arange(N, dtype=jnp.uint32)[None, :]
+    h = ti * jnp.uint32(0x9E3779B1) + ni * jnp.uint32(0x85EBCA77)
+    h = (h ^ (h >> 15)) * jnp.uint32(0xCA87C3EB)
+    return ((h >> 16).astype(jnp.float32) / 65536.0) * JITTER_EPS
+
 
 class AllocateConfig(NamedTuple):
     """Static solve configuration (plugin enables + round counts). Part of
@@ -88,8 +104,7 @@ def _queue_gate(
     T, R = resreq.shape
     # queue-major, rank-minor sort; a job's bidders are contiguous inside its
     # queue segment because rank orders by (job_rank, subrank)
-    order = jnp.argsort(rank, stable=True)
-    order = order[jnp.argsort(task_queue[order], stable=True)]
+    order = ordering.sort_by_segment_then_rank(task_queue, rank, qalloc.shape[0])
     cs = cand[order]
     qs = task_queue[order]
     js = task_job[order]
@@ -127,9 +142,8 @@ def _resolve_conflicts(
     T, R = fit_req.shape
     N = budget.shape[0]
     seg = jnp.where(cand, choice, N)  # non-bidders park in segment N
-    # rank-major within node: stable sort by rank, then by node
-    order = jnp.argsort(rank, stable=True)
-    order = order[jnp.argsort(seg[order], stable=True)]
+    # rank-major within node
+    order = ordering.sort_by_segment_then_rank(seg, rank, N + 1)
     seg_s = seg[order]
     acct_s = jnp.where(cand[order, None], acct_req[order], 0.0)
     fit_s = fit_req[order]
@@ -154,7 +168,7 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     Q = snap.queue_weight.shape[0]
 
     static_ok = static_predicates(snap)           # [T, N]
-    score = score_matrix(snap, config.weights)    # [T, N]
+    score = score_matrix(snap, config.weights) + _tie_break_jitter(T, N)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
 
     # proportion deserved is computed once per cycle from the session-open
@@ -170,26 +184,24 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         & snap.job_schedulable[snap.task_job]
     )
 
-    def round_body(state, _):
+    def outer_body(state, _):
         idle, releasing, used, assigned, pipelined, job_failed = state
-        placed = assigned >= 0
-        # current allocations (jobs then queues) including this cycle's placements
-        placed_req = jnp.where(placed[:, None], snap.task_resreq, 0.0)
-        job_new = jax.ops.segment_sum(placed_req, snap.task_job, num_segments=J)
-        job_alloc = snap.job_allocated + job_new
-        queue_alloc = snap.queue_alloc + jax.ops.segment_sum(
-            job_new, snap.job_queue, num_segments=Q
-        )
-        new_alloc_cnt = jax.ops.segment_sum(
-            (placed & ~pipelined).astype(jnp.int32), snap.task_job, num_segments=J
-        )
-        job_ready_now = (snap.job_ready + new_alloc_cnt) >= snap.job_min_avail
 
-        pending = eligible & ~placed & ~job_failed[snap.task_job]
-        # fair-queuing virtual-time total order (QueueOrderFn/JobOrderFn/
-        # TaskOrderFn tiers over live shares)
+        # ---- fairness state + virtual-time rank, once per outer pass -----
+        # (the rank is a static plan for the whole round set: virtual time
+        # already charges each bidder its prefix position, so per-round
+        # recomputation only corrects second-order drift — not worth the
+        # dozen extra 50k-element sorts per round)
+        placed0 = assigned >= 0
+        placed_req0 = jnp.where(placed0[:, None], snap.task_resreq, 0.0)
+        job_new0 = jax.ops.segment_sum(placed_req0, snap.task_job, num_segments=J)
+        new_alloc_cnt0 = jax.ops.segment_sum(
+            (placed0 & ~pipelined).astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        job_ready_now = (snap.job_ready + new_alloc_cnt0) >= snap.job_min_avail
+        pending0 = eligible & ~placed0 & ~job_failed[snap.task_job]
         rank = ordering.virtual_task_ranks(
-            pending,
+            pending0,
             snap.task_resreq,
             snap.task_job,
             snap.job_queue[snap.task_job],
@@ -197,8 +209,9 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             snap.job_prio,
             job_ready_now,
             snap.job_creation,
-            job_alloc,
-            queue_alloc,
+            snap.job_allocated + job_new0,
+            snap.queue_alloc
+            + jax.ops.segment_sum(job_new0, snap.job_queue, num_segments=Q),
             deserved,
             snap.total,
             gang_enabled=config.gang,
@@ -206,57 +219,74 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             proportion_enabled=config.proportion,
         )
 
-        fit_idle = fits(snap.task_req, idle, snap.quanta)
-        fit_rel = fits(snap.task_req, releasing, snap.quanta)
-        feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
-        masked = jnp.where(feas, score, NEG)
-        best = jnp.argmax(masked, axis=1).astype(jnp.int32)
-        has = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] > NEG
-        if config.proportion:
-            job_need = jnp.maximum(
-                snap.job_min_avail - (snap.job_ready + new_alloc_cnt), 0
-            )
-            has &= _queue_gate(
-                has,
-                rank,
-                snap.task_job,
-                snap.job_queue[snap.task_job],
-                snap.task_resreq,
-                queue_alloc,
-                deserved,
-                snap.quanta,
-                job_need,
-                J,
-            )
-        # allocate if the chosen node fits Idle, else pipeline onto Releasing
-        # (allocate.go:161-184: the idle-vs-releasing decision happens on the
-        # already-selected best-score node)
-        chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
-        alloc_cand = has & chose_idle
-        pipe_cand = has & ~chose_idle
+        def round_cond(state):
+            *_, i, progress = state
+            return (i < config.rounds) & progress
 
-        acc_a, delta_a = _resolve_conflicts(
-            alloc_cand, best, rank, snap.task_req, snap.task_resreq, idle, snap.quanta
-        )
-        acc_p, delta_p = _resolve_conflicts(
-            pipe_cand, best, rank, snap.task_req, snap.task_resreq, releasing, snap.quanta
-        )
-        # statement.Allocate → node.AddTask(Allocated): Idle -= r, Used += r
-        # statement.Pipeline → node.AddTask(Pipelined): Releasing -= r, Used += r
-        idle = idle - delta_a
-        releasing = releasing - delta_p
-        used = used + delta_a + delta_p
-        assigned = jnp.where(acc_a | acc_p, best, assigned)
-        pipelined = pipelined | acc_p
-        return (idle, releasing, used, assigned, pipelined, job_failed), None
+        def round_body(state):
+            idle, releasing, used, assigned, pipelined, i, _ = state
+            placed = assigned >= 0
+            placed_req = jnp.where(placed[:, None], snap.task_resreq, 0.0)
+            job_new = jax.ops.segment_sum(placed_req, snap.task_job, num_segments=J)
+            queue_alloc = snap.queue_alloc + jax.ops.segment_sum(
+                job_new, snap.job_queue, num_segments=Q
+            )
+            pending = eligible & ~placed & ~job_failed[snap.task_job]
 
-    def outer_body(state, _):
-        idle, releasing, used, assigned, pipelined, job_failed = state
-        (idle, releasing, used, assigned, pipelined, job_failed), _ = jax.lax.scan(
+            fit_idle = fits(snap.task_req, idle, snap.quanta)
+            fit_rel = fits(snap.task_req, releasing, snap.quanta)
+            feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
+            masked = jnp.where(feas, score, NEG)
+            best = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            has = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] > NEG
+            if config.proportion:
+                new_alloc_cnt = jax.ops.segment_sum(
+                    (placed & ~pipelined).astype(jnp.int32),
+                    snap.task_job,
+                    num_segments=J,
+                )
+                job_need = jnp.maximum(
+                    snap.job_min_avail - (snap.job_ready + new_alloc_cnt), 0
+                )
+                has &= _queue_gate(
+                    has,
+                    rank,
+                    snap.task_job,
+                    snap.job_queue[snap.task_job],
+                    snap.task_resreq,
+                    queue_alloc,
+                    deserved,
+                    snap.quanta,
+                    job_need,
+                    J,
+                )
+            # allocate if the chosen node fits Idle, else pipeline onto
+            # Releasing (allocate.go:161-184: the idle-vs-releasing decision
+            # happens on the already-selected best-score node)
+            chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
+            alloc_cand = has & chose_idle
+            pipe_cand = has & ~chose_idle
+
+            acc_a, delta_a = _resolve_conflicts(
+                alloc_cand, best, rank, snap.task_req, snap.task_resreq, idle, snap.quanta
+            )
+            acc_p, delta_p = _resolve_conflicts(
+                pipe_cand, best, rank, snap.task_req, snap.task_resreq, releasing, snap.quanta
+            )
+            # statement.Allocate → node.AddTask(Allocated): Idle -= r, Used += r
+            # statement.Pipeline → node.AddTask(Pipelined): Releasing -= r, Used += r
+            idle = idle - delta_a
+            releasing = releasing - delta_p
+            used = used + delta_a + delta_p
+            newly = acc_a | acc_p
+            assigned = jnp.where(newly, best, assigned)
+            pipelined = pipelined | acc_p
+            return (idle, releasing, used, assigned, pipelined, i + 1, jnp.any(newly))
+
+        (idle, releasing, used, assigned, pipelined, _, _) = jax.lax.while_loop(
+            round_cond,
             round_body,
-            (idle, releasing, used, assigned, pipelined, job_failed),
-            None,
-            length=config.rounds,
+            (idle, releasing, used, assigned, pipelined, jnp.int32(0), jnp.bool_(True)),
         )
         # ---- gang commit/discard (vectorized Statement) -----------------
         new_alloc_cnt = jax.ops.segment_sum(

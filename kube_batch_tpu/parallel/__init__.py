@@ -1,0 +1,3 @@
+from kube_batch_tpu.parallel.mesh import make_mesh, sharded_allocate_solve, snapshot_shardings
+
+__all__ = ["make_mesh", "sharded_allocate_solve", "snapshot_shardings"]

@@ -1,0 +1,3 @@
+from kube_batch_tpu.testing.synthetic import synthetic_cluster, synthetic_device_snapshot
+
+__all__ = ["synthetic_cluster", "synthetic_device_snapshot"]

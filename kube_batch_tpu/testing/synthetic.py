@@ -1,0 +1,212 @@
+"""Synthetic cluster generator — the kubemark successor (SURVEY.md §4.3).
+
+The reference scale-tests against GCE "hollow node" clusters (test/kubemark);
+here synthetic workloads feed the device snapshot directly — no apiserver —
+at the BASELINE.json config matrix scale (50k pods × 5k nodes, gang
+minMember=4, multi-queue DRF/proportion, heterogeneous GPU gangs).
+
+Two constructors:
+  synthetic_device_snapshot — builds the SoA arrays directly (bench hot path;
+    building 50k host TaskInfo objects would measure Python, not the solver)
+  synthetic_cluster — builds a real SchedulerCache through the event handlers
+    (used for smaller end-to-end tests of the full loop)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.api.pod import Node, PodGroup, Queue
+from kube_batch_tpu.api.resources import GPU, ResourceSpec
+from kube_batch_tpu.api.snapshot import DeviceSnapshot, SnapshotMeta, UNBOUNDED, bucket
+from kube_batch_tpu.api.types import PodPhase, TaskStatus
+
+GiB = float(2**30)
+
+NODE_CPU = 32000.0       # 32 cores in milli
+NODE_MEM = 128 * GiB
+NODE_PODS = 110.0
+NODE_GPU = 8000.0        # 8 GPUs in milli
+
+CPU_CHOICES = np.array([250.0, 500.0, 1000.0, 2000.0, 4000.0])
+MEM_CHOICES = np.array([1, 2, 4, 8]) * GiB
+
+
+def synthetic_device_snapshot(
+    n_tasks: int = 50_000,
+    n_nodes: int = 5_000,
+    gang_size: int = 4,
+    n_queues: int = 3,
+    gpu_task_frac: float = 0.0,
+    gpu_node_frac: float = 0.25,
+    seed: int = 0,
+    spec: Optional[ResourceSpec] = None,
+) -> Tuple[DeviceSnapshot, SnapshotMeta]:
+    """Direct SoA construction of a pending synthetic workload."""
+    rng = np.random.default_rng(seed)
+    spec = spec or ResourceSpec(scalar_names=(GPU,))
+    R = spec.n
+    gpu_col = spec.index(GPU)
+
+    n_jobs = -(-n_tasks // gang_size)
+    T, N, J, Q = bucket(n_tasks), bucket(n_nodes), bucket(n_jobs), bucket(n_queues)
+
+    # ---- tasks ----------------------------------------------------------
+    task_req = np.zeros((T, R), np.float32)
+    task_req[:n_tasks, 0] = rng.choice(CPU_CHOICES, n_tasks)
+    task_req[:n_tasks, 1] = rng.choice(MEM_CHOICES, n_tasks)
+    task_req[:n_tasks, 2] = 1.0
+    task_job = np.zeros(T, np.int32)
+    task_job[:n_tasks] = np.arange(n_tasks) // gang_size
+    if gpu_task_frac > 0:
+        # whole gangs ask for GPUs so gang semantics stay heterogeneous
+        gpu_jobs = rng.random(n_jobs) < gpu_task_frac
+        is_gpu_task = gpu_jobs[task_job[:n_tasks]]
+        task_req[:n_tasks, gpu_col] = np.where(
+            is_gpu_task, rng.choice([1000.0, 2000.0, 4000.0], n_tasks), 0.0
+        )
+    task_valid = np.zeros(T, bool)
+    task_valid[:n_tasks] = True
+
+    # ---- nodes ----------------------------------------------------------
+    node_alloc = np.zeros((N, R), np.float32)
+    node_alloc[:n_nodes, 0] = NODE_CPU
+    node_alloc[:n_nodes, 1] = NODE_MEM
+    node_alloc[:n_nodes, 2] = NODE_PODS
+    n_gpu_nodes = int(n_nodes * gpu_node_frac)
+    node_alloc[:n_gpu_nodes, gpu_col] = NODE_GPU
+    node_valid = np.zeros(N, bool)
+    node_valid[:n_nodes] = True
+
+    # ---- jobs -----------------------------------------------------------
+    job_min = np.zeros(J, np.int32)
+    job_min[:n_jobs] = np.minimum(
+        gang_size, n_tasks - np.arange(n_jobs) * gang_size
+    )  # last gang may be short
+    job_queue = np.zeros(J, np.int32)
+    job_queue[:n_jobs] = np.arange(n_jobs) % n_queues
+    job_prio = np.zeros(J, np.int32)
+    job_prio[:n_jobs] = np.where(rng.random(n_jobs) < 0.05, 100, 0)
+    job_valid = np.zeros(J, bool)
+    job_valid[:n_jobs] = True
+
+    # ---- queues ---------------------------------------------------------
+    queue_weight = np.ones(Q, np.float32)
+    queue_weight[:n_queues] = 1.0 + np.arange(n_queues)
+    queue_valid = np.zeros(Q, bool)
+    queue_valid[:n_queues] = True
+    queue_request = np.zeros((Q, R), np.float32)
+    np.add.at(queue_request, job_queue[task_job[:n_tasks]], task_req[:n_tasks])
+
+    total = node_alloc[:n_nodes].sum(axis=0).astype(np.float32)
+
+    snap = DeviceSnapshot(
+        task_req=task_req,
+        task_resreq=task_req.copy(),
+        task_job=task_job,
+        task_prio=np.zeros(T, np.int32),
+        task_creation=np.arange(T, dtype=np.int32),
+        task_status=np.where(task_valid, TaskStatus.PENDING, TaskStatus.UNKNOWN).astype(
+            np.int32
+        ),
+        task_valid=task_valid,
+        task_pending=task_valid.copy(),
+        task_best_effort=np.zeros(T, bool),
+        task_sel_bits=np.zeros((T, 1), np.uint32),
+        task_sel_impossible=np.zeros(T, bool),
+        task_tol_bits=np.zeros((T, 1), np.uint32),
+        node_idle=node_alloc.copy(),
+        node_releasing=np.zeros((N, R), np.float32),
+        node_used=np.zeros((N, R), np.float32),
+        node_alloc=node_alloc,
+        node_valid=node_valid,
+        node_sched=node_valid.copy(),
+        node_label_bits=np.zeros((N, 1), np.uint32),
+        node_taint_bits=np.zeros((N, 1), np.uint32),
+        job_min_avail=job_min,
+        job_ready=np.zeros(J, np.int32),
+        job_queue=job_queue,
+        job_prio=job_prio,
+        job_creation=np.arange(J, dtype=np.int32),
+        job_valid=job_valid,
+        job_schedulable=job_valid.copy(),
+        job_allocated=np.zeros((J, R), np.float32),
+        queue_weight=queue_weight,
+        queue_capability=np.full((Q, R), UNBOUNDED, np.float32),
+        queue_alloc=np.zeros((Q, R), np.float32),
+        queue_request=queue_request,
+        queue_valid=queue_valid,
+        total=total,
+        quanta=spec.quanta.astype(np.float32),
+    )
+    meta = SnapshotMeta(
+        spec=spec,
+        task_keys=[f"bench/t{i}" for i in range(n_tasks)],
+        node_names=[f"n{i}" for i in range(n_nodes)],
+        job_uids=[f"bench/j{i}" for i in range(n_jobs)],
+        queue_names=[f"q{i}" for i in range(n_queues)],
+        label_pair_bit={},
+        taint_bit={},
+        n_tasks=n_tasks,
+        n_nodes=n_nodes,
+        n_jobs=n_jobs,
+        n_queues=n_queues,
+    )
+    return snap, meta
+
+
+def synthetic_cluster(
+    n_tasks: int = 200,
+    n_nodes: int = 20,
+    gang_size: int = 4,
+    n_queues: int = 2,
+    seed: int = 0,
+):
+    """Small synthetic cluster through the real cache handlers (full-loop
+    tests). Returns a SchedulerCache with fake binder/evictor."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.api.resources import ResourceSpec
+
+    rng = np.random.default_rng(seed)
+    spec = ResourceSpec(scalar_names=(GPU,))
+    cache = SchedulerCache(spec=spec)
+    for q in range(n_queues):
+        cache.add_queue(Queue(name=f"q{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cache.add_node(
+            Node(
+                name=f"n{i}",
+                allocatable={"cpu": NODE_CPU, "memory": NODE_MEM, "pods": NODE_PODS},
+            )
+        )
+    n_jobs = -(-n_tasks // gang_size)
+    for j in range(n_jobs):
+        cache.add_pod_group(
+            PodGroup(
+                name=f"pg{j}",
+                namespace="bench",
+                min_member=min(gang_size, n_tasks - j * gang_size),
+                queue=f"q{j % n_queues}",
+                creation_index=j,
+            )
+        )
+    for i in range(n_tasks):
+        j = i // gang_size
+        cache.add_pod(
+            Pod(
+                name=f"t{i}",
+                namespace="bench",
+                requests={
+                    "cpu": float(rng.choice(CPU_CHOICES)),
+                    "memory": float(rng.choice(MEM_CHOICES)),
+                },
+                annotations={GROUP_NAME_ANNOTATION: f"pg{j}"},
+                phase=PodPhase.PENDING,
+                creation_index=i,
+            )
+        )
+    return cache

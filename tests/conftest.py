@@ -8,7 +8,9 @@ before jax initializes, hence module scope here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the image exports JAX_PLATFORMS=axon (the real-TPU tunnel);
+# tests must run on the virtual 8-device CPU backend deterministically.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
