@@ -20,6 +20,7 @@ from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.snapshot import build_snapshot
 from kube_batch_tpu.api.types import PodGroupPhase
 from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.session import FitFailure
 from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
 logger = logging.getLogger("kube_batch_tpu")
@@ -74,6 +75,30 @@ class AllocateAction(Action):
                 if task is None:
                     continue
                 node_name = meta.node_names[ni]
+                # validation net: the device mask is a sound approximation of
+                # the full predicate set (rich affinity terms, host ports are
+                # host-only) — re-check each *proposed* placement, O(placed)
+                # not O(T×N)
+                node = ssn.nodes.get(node_name)
+                try:
+                    if node is not None:
+                        ssn.predicate(task, node)
+                    # live fit re-check: a host-fallback placement (below) may
+                    # have consumed capacity the device solve promised to this
+                    # placement; node.add_task does not re-verify fit
+                    if node is not None and not (
+                        (not pipe and task.init_resreq.less_equal(node.idle))
+                        or (pipe and task.init_resreq.less_equal(node.releasing))
+                    ):
+                        raise FitFailure("node resources taken by host fallback")
+                except FitFailure as e:
+                    logger.info("device placement %s→%s rejected by host predicate: %s",
+                                task_key, node_name, e.reason)
+                    # the device would re-propose the same node next cycle
+                    # (the solve is deterministic), so fall back to the
+                    # reference's own sequential path for this task
+                    self._host_place(ssn, stmt, task)
+                    continue
                 if pipe:
                     stmt.pipeline(task, node_name)
                 else:
@@ -87,3 +112,30 @@ class AllocateAction(Action):
                     len(placements),
                 )
                 stmt.discard()
+
+    def _host_place(self, ssn, stmt, task) -> bool:
+        """Sequential placement for a task the device model couldn't encode:
+        predicate every node, pick the best-scoring fit — exactly
+        allocate.go:151-184 (PredicateNodes → PrioritizeNodes →
+        SelectBestNode → Allocate on Idle / Pipeline on Releasing)."""
+        best, best_score = None, None
+        for node in ssn.nodes.values():
+            try:
+                ssn.predicate(task, node)
+            except FitFailure:
+                continue
+            if not (task.init_resreq.less_equal(node.idle)
+                    or task.init_resreq.less_equal(node.releasing)):
+                continue
+            score = ssn.node_order(task, node)
+            if best is None or score > best_score:
+                best, best_score = node, score
+        if best is None:
+            return False
+        # allocate-vs-pipeline is decided on the already-selected node
+        # (allocate.go:161-184), not folded into the selection
+        if task.init_resreq.less_equal(best.idle):
+            stmt.allocate(task, best.name)
+        else:
+            stmt.pipeline(task, best.name)
+        return True

@@ -197,17 +197,27 @@ def build_snapshot(
         task_valid[i] = True
         task_best_effort[i] = t.best_effort
         task_pending[i] = t.status == TaskStatus.PENDING and not t.best_effort
-        # node-selector → required bits (MatchNodeSelector, predicates.go:194-205)
+        # required label pairs → bits: node-selector terms (MatchNodeSelector,
+        # predicates.go:194-205) plus single-term node-affinity whose
+        # In-requirements carry one value (necessary AND sufficient for that
+        # term). Multi-term affinity (OR) or richer operators stay host-side —
+        # the allocate replay re-validates every proposed placement through
+        # the predicates plugin, so the device mask only needs to be a sound
+        # over-approximation of feasibility.
+        required_pairs = list(t.pod.node_selector.items())
+        if t.pod.affinity is not None and len(t.pod.affinity.node_terms) == 1:
+            required_pairs += [
+                (key, values[0])
+                for key, op, values in t.pod.affinity.node_terms[0]
+                if op == "In" and len(values) == 1
+            ]
         sel_bits: List[int] = []
-        for k, v in t.pod.node_selector.items():
+        for k, v in required_pairs:
             b = label_pair_bit.get((k, v))
             if b is None:
                 task_sel_impossible[i] = True  # no node carries this pair
             else:
                 sel_bits.append(b)
-        # required node-affinity terms with single-value In requirements fold
-        # into the same required-bit mask; richer expressions are handled by
-        # the host-side predicate fallback (plugins/predicates.py).
         task_sel_bits[i] = _pack_bits(sel_bits, W)
         # tolerations → tolerated-taint bits (PodToleratesNodeTaints,
         # predicates.go:220-231): bit set iff some toleration tolerates taint

@@ -43,21 +43,28 @@ from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
 
 NEG = jnp.float32(-3.0e38)
 
-# Tie-break jitter magnitude: the reference's SelectBestNode picks uniformly
-# among max-score nodes (scheduler_helper.go:147-158); without an analog every
-# equal-score task herds onto the same argmax node and each bidding round
-# fills exactly one node. 1e-3 is far below any real score difference (the
-# k8s priority rows move in ~0.1 steps) but splits exact ties uniformly.
-JITTER_EPS = jnp.float32(1e-3)
-
-
-def _tie_break_jitter(T: int, N: int) -> jnp.ndarray:
-    """[T, N] deterministic per-(task, node) hash in [0, JITTER_EPS)."""
+def _tie_break_hash(T: int, N: int) -> jnp.ndarray:
+    """[T, N] deterministic per-(task, node) hash in [0, 1)."""
     ti = jnp.arange(T, dtype=jnp.uint32)[:, None]
     ni = jnp.arange(N, dtype=jnp.uint32)[None, :]
     h = ti * jnp.uint32(0x9E3779B1) + ni * jnp.uint32(0x85EBCA77)
     h = (h ^ (h >> 15)) * jnp.uint32(0xCA87C3EB)
-    return ((h >> 16).astype(jnp.float32) / 65536.0) * JITTER_EPS
+    return (h >> 16).astype(jnp.float32) / 65536.0
+
+
+def _best_node(masked: jnp.ndarray, tie_hash: jnp.ndarray):
+    """Lexicographic argmax: among the nodes carrying the exact maximum
+    score, pick by per-(task, node) hash — the reference's SelectBestNode
+    picks uniformly among max-score nodes (scheduler_helper.go:147-158), and
+    without a spread every equal-score task herds onto the same argmax node,
+    filling one node per bidding round. Exact two-key semantics: a hash can
+    never override a genuine score difference (unlike additive jitter).
+
+    Returns (best [T] i32, has [T] bool)."""
+    best_val = jnp.max(masked, axis=1)
+    tie = masked >= best_val[:, None]
+    best = jnp.argmax(jnp.where(tie, tie_hash, -1.0), axis=1).astype(jnp.int32)
+    return best, best_val > NEG
 
 
 class AllocateConfig(NamedTuple):
@@ -168,7 +175,8 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     Q = snap.queue_weight.shape[0]
 
     static_ok = static_predicates(snap)           # [T, N]
-    score = score_matrix(snap, config.weights) + _tie_break_jitter(T, N)
+    score = score_matrix(snap, config.weights)
+    tie_hash = _tie_break_hash(T, N)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
 
     # proportion deserved is computed once per cycle from the session-open
@@ -199,6 +207,9 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             (placed0 & ~pipelined).astype(jnp.int32), snap.task_job, num_segments=J
         )
         job_ready_now = (snap.job_ready + new_alloc_cnt0) >= snap.job_min_avail
+        job_need0 = jnp.maximum(
+            snap.job_min_avail - (snap.job_ready + new_alloc_cnt0), 0
+        )
         pending0 = eligible & ~placed0 & ~job_failed[snap.task_job]
         rank = ordering.virtual_task_ranks(
             pending0,
@@ -214,6 +225,7 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             + jax.ops.segment_sum(job_new0, snap.job_queue, num_segments=Q),
             deserved,
             snap.total,
+            job_need0,
             gang_enabled=config.gang,
             drf_enabled=config.drf,
             proportion_enabled=config.proportion,
@@ -237,8 +249,7 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             fit_rel = fits(snap.task_req, releasing, snap.quanta)
             feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
             masked = jnp.where(feas, score, NEG)
-            best = jnp.argmax(masked, axis=1).astype(jnp.int32)
-            has = jnp.take_along_axis(masked, best[:, None], axis=1)[:, 0] > NEG
+            best, has = _best_node(masked, tie_hash)
             if config.proportion:
                 new_alloc_cnt = jax.ops.segment_sum(
                     (placed & ~pipelined).astype(jnp.int32),

@@ -75,6 +75,7 @@ def virtual_task_ranks(
     queue_alloc: jnp.ndarray,  # [Q, R] — incl. this cycle's placements
     deserved: jnp.ndarray,     # [Q, R]
     total: jnp.ndarray,        # [R]
+    job_need: jnp.ndarray,     # [J] i32 — minAvailable − currently-ready
     gang_enabled: bool,
     drf_enabled: bool,
     proportion_enabled: bool,
@@ -88,6 +89,15 @@ def virtual_task_ranks(
     reached at the task's own prefix position within that queue (resp. job) —
     sorting by virtual share reproduces the alternation without a sequential
     loop.
+
+    Gang-chunk granularity: the sequential loop serves a popped job until
+    JobReady before re-evaluating any order fn (allocate.go:137-190), so an
+    unready job's first `job_need` pending tasks (its gang chunk) must be
+    CONTIGUOUS in the rank — otherwise two starved gangs interleave, both
+    place partially, and the commit gate reverts both where the reference
+    would have served one then the other. In-chunk tasks therefore all carry
+    the share at the chunk start; only beyond-chunk tasks accrue per-task
+    virtual time.
 
     Key chain (outer→inner), matching the default two-tier conf
     (pkg/scheduler/util.go:31-42: tier1 priority,gang,conformance; tier2
@@ -104,12 +114,24 @@ def virtual_task_ranks(
     n_queues = deserved.shape[0]
     rq = jnp.where(pending[:, None], resreq, 0.0)
 
-    # job-axis virtual drf share: prefix within job in subrank order
+    # job-axis: position of each pending task within its job (subrank order)
     order_j = sort_by_segment_then_rank(task_job, subrank, n_jobs)
     js = task_job[order_j]
     j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
+    ci = pending[order_j].astype(jnp.float32)[:, None]
+    pos_in_job = segmented_prefix(ci, j_start)[:, 0].astype(jnp.int32)
+    in_chunk_sorted = pending[order_j] & (pos_in_job < job_need[js])
+    in_chunk = jnp.zeros(T, bool).at[order_j].set(in_chunk_sorted)
+
+    # virtual drf share: chunk-start share for in-chunk tasks, per-task
+    # prefix share beyond the chunk
     prefix_j = segmented_prefix(rq[order_j], j_start)
-    vd_sorted = fairness.dominant_share(job_alloc[js] + prefix_j, total)
+    share_start = fairness.dominant_share(job_alloc, total)  # [J]
+    vd_sorted = jnp.where(
+        in_chunk_sorted,
+        share_start[js],
+        fairness.dominant_share(job_alloc[js] + prefix_j, total),
+    )
     v_drf = jnp.zeros(T, jnp.float32).at[order_j].set(vd_sorted)
 
     # within-queue key (everything but the queue tier)
@@ -126,13 +148,20 @@ def virtual_task_ranks(
         # order, one job at a time
         return multisort_ranks([task_queue, wq_rank])
 
-    # queue-axis virtual proportion share: prefix within queue in wq order
+    # queue-axis virtual proportion share: prefix within queue in wq order.
+    # A job's chunk is contiguous in wq_rank (all chunk tasks tie on v_drf and
+    # job keys), so the chunk-head's share can be broadcast job-wide via a
+    # scatter-min — the whole chunk then ties on v_q too and stays contiguous.
     order_q = sort_by_segment_then_rank(task_queue, wq_rank, n_queues)
     qs = task_queue[order_q]
     q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
     prefix_q = segmented_prefix(rq[order_q], q_start)
     vq_sorted = fairness.queue_share(queue_alloc[qs] + prefix_q, deserved[qs])
     v_q = jnp.zeros(T, jnp.float32).at[order_q].set(vq_sorted)
+    head_vq = jnp.full(n_jobs, jnp.inf, jnp.float32).at[task_job].min(
+        jnp.where(in_chunk, v_q, jnp.inf)
+    )
+    v_q = jnp.where(in_chunk, head_vq[task_job], v_q)
 
     return multisort_ranks([jnp.round(v_q * 1e6).astype(jnp.int32), wq_rank])
 

@@ -86,28 +86,37 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
     )
 
 
+# jitted solve per (mesh, config) — a fresh jax.jit wrapper per call would
+# retrace and recompile the whole solve every scheduling cycle
+_jit_cache: dict = {}
+
+
 def sharded_allocate_solve(
     snap: DeviceSnapshot, config: AllocateConfig, mesh: Mesh
 ) -> AllocateResult:
     """The allocate solve jitted over the mesh. Node-axis inputs/outputs are
     sharded; the assignment vector comes back replicated."""
-    in_shardings = snapshot_shardings(mesh)
-    node2 = NamedSharding(mesh, P(NODE_AXIS, None))
-    repl = NamedSharding(mesh, P())
-    out_shardings = AllocateResult(
-        assigned=repl,
-        pipelined=repl,
-        committed=repl,
-        node_idle=node2,
-        node_releasing=node2,
-        node_used=node2,
-        deserved=repl,
-    )
-    fn = jax.jit(
-        partial(_solve, config=config),
-        in_shardings=(in_shardings,),
-        out_shardings=out_shardings,
-    )
+    key = (mesh, config)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        in_shardings = snapshot_shardings(mesh)
+        node2 = NamedSharding(mesh, P(NODE_AXIS, None))
+        repl = NamedSharding(mesh, P())
+        out_shardings = AllocateResult(
+            assigned=repl,
+            pipelined=repl,
+            committed=repl,
+            node_idle=node2,
+            node_releasing=node2,
+            node_used=node2,
+            deserved=repl,
+        )
+        fn = jax.jit(
+            partial(_solve, config=config),
+            in_shardings=(in_shardings,),
+            out_shardings=out_shardings,
+        )
+        _jit_cache[key] = fn
     with mesh:
         return fn(snap)
 

@@ -14,7 +14,6 @@ Two constructors:
 
 from __future__ import annotations
 
-import math
 from typing import Optional, Tuple
 
 import numpy as np
@@ -169,7 +168,6 @@ def synthetic_cluster(
     tests). Returns a SchedulerCache with fake binder/evictor."""
     from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
     from kube_batch_tpu.cache.cache import SchedulerCache
-    from kube_batch_tpu.api.resources import ResourceSpec
 
     rng = np.random.default_rng(seed)
     spec = ResourceSpec(scalar_names=(GPU,))

@@ -1,0 +1,155 @@
+"""SchedulerCache tests — the rebuild's cache/cache_test.go analog: feed
+objects through the real handlers, assert on the cache's Jobs/Nodes content,
+plus the resync repair path (cache.go:559-581) and snapshot filtering."""
+
+from kube_batch_tpu.api.pod import Node, PodGroup, Queue
+from kube_batch_tpu.api.types import PodPhase, TaskStatus
+
+from tests.fixtures import GiB, build_cache, build_node, build_pod
+
+
+class TestPodIngest:
+    def test_add_pod_creates_shadow_job(self):
+        """A plain owned pod creates a job keyed by its own name with a
+        shadow PodGroup minMember=1 (event_handlers.go:42-67, util.go:42-60)."""
+        cache = build_cache(queues=["default"])
+        cache.add_pod(build_pod("ns", "p1", None, PodPhase.PENDING,
+                                {"cpu": 1000, "memory": GiB}))
+        assert "ns/p1" in cache.jobs
+        job = cache.jobs["ns/p1"]
+        assert job.pod_group.shadow and job.pod_group.min_member == 1
+        assert len(job.tasks) == 1
+
+    def test_add_bound_pod_accounts_on_node(self):
+        cache = build_cache(queues=["default"], nodes=[build_node("n1", cpu=8000)])
+        cache.add_pod(build_pod("ns", "p1", "n1", PodPhase.RUNNING,
+                                {"cpu": 3000, "memory": GiB}))
+        node = cache.nodes["n1"]
+        assert node.idle.vec[0] == 5000
+        assert node.used.vec[0] == 3000
+
+    def test_pod_before_node_replays_accounting(self):
+        """A bound pod arriving before its node is held on a nodeless
+        NodeInfo; set_node replays the accounting (node_info.go OutOfSync)."""
+        cache = build_cache(queues=["default"])
+        cache.add_pod(build_pod("ns", "p1", "n1", PodPhase.RUNNING,
+                                {"cpu": 3000, "memory": GiB}))
+        assert not cache.nodes["n1"].ready
+        cache.add_node(build_node("n1", cpu=8000))
+        node = cache.nodes["n1"]
+        assert node.ready
+        assert node.idle.vec[0] == 5000
+
+    def test_delete_pod_releases(self):
+        cache = build_cache(queues=["default"], nodes=[build_node("n1", cpu=8000)])
+        pod = build_pod("ns", "p1", "n1", PodPhase.RUNNING,
+                        {"cpu": 3000, "memory": GiB})
+        cache.add_pod(pod)
+        cache.delete_pod(pod)
+        assert cache.nodes["n1"].idle.vec[0] == 8000
+        assert "ns/p1" not in cache.jobs  # shadow job collected
+
+    def test_update_pod_moves_status(self):
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")])
+        pod = build_pod("ns", "p1", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB})
+        cache.add_pod(pod)
+        import dataclasses
+        bound = dataclasses.replace(pod, node_name="n1", phase=PodPhase.RUNNING)
+        cache.update_pod(bound)
+        job = cache.jobs["ns/p1"]
+        task = next(iter(job.tasks.values()))
+        assert task.status == TaskStatus.RUNNING
+        assert task.node_name == "n1"
+
+    def test_foreign_scheduler_unbound_pod_ignored(self):
+        """Informer filter (cache.go:283-305): unbound pods of another
+        scheduler are not ours; bound ones still count for node usage."""
+        cache = build_cache(queues=["default"], nodes=[build_node("n1", cpu=8000)])
+        cache.add_pod(build_pod("ns", "other", None, PodPhase.PENDING,
+                                {"cpu": 1000, "memory": GiB},
+                                scheduler_name="default-scheduler"))
+        assert cache.jobs == {}
+        cache.add_pod(build_pod("ns", "bound", "n1", PodPhase.RUNNING,
+                                {"cpu": 1000, "memory": GiB},
+                                scheduler_name="default-scheduler"))
+        assert cache.nodes["n1"].used.vec[0] == 1000
+
+
+class TestPodGroupQueueIngest:
+    def test_podgroup_defaults_queue(self):
+        cache = build_cache(queues=["default"])
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns", min_member=2))
+        assert cache.jobs["ns/pg"].queue == "default"
+
+    def test_delete_podgroup_keeps_tasked_job(self):
+        cache = build_cache(queues=["default"])
+        cache.add_pod(build_pod("ns", "p1", None, PodPhase.PENDING,
+                                {"cpu": 1000, "memory": GiB}, group_name="pg"))
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns", min_member=1))
+        cache.delete_pod_group("ns/pg")
+        assert "ns/pg" in cache.jobs  # still has a task
+        assert cache.jobs["ns/pg"].pod_group is None
+
+    def test_queue_crud(self):
+        cache = build_cache()
+        cache.add_queue(Queue(name="q1", weight=4))
+        assert cache.queues["q1"].weight == 4
+        cache.delete_queue("q1")
+        assert "q1" not in cache.queues
+
+
+class TestSnapshotFilters:
+    def test_job_without_podgroup_excluded(self):
+        """Snapshot excludes jobs with no PodGroup (cache.go:625-633) — can't
+        happen through add_pod (shadow groups), so build directly."""
+        cache = build_cache(queues=["default"])
+        from kube_batch_tpu.api.job_info import JobInfo
+        cache.jobs["ns/bare"] = JobInfo("ns/bare", cache.spec)
+        snap = cache.snapshot()
+        assert "ns/bare" not in snap.jobs
+
+    def test_job_with_unknown_queue_excluded(self):
+        cache = build_cache(queues=["default"])
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns", queue="ghost"))
+        snap = cache.snapshot()
+        assert snap.jobs == {}
+
+    def test_not_ready_node_excluded(self):
+        cache = build_cache(queues=["default"],
+                            nodes=[build_node("up"), build_node("down", ready=False)])
+        snap = cache.snapshot()
+        assert set(snap.nodes) == {"up"}
+
+    def test_snapshot_is_a_deep_clone(self):
+        cache = build_cache(queues=["default"], nodes=[build_node("n1", cpu=8000)])
+        cache.add_pod(build_pod("ns", "p1", None, PodPhase.PENDING,
+                                {"cpu": 1000, "memory": GiB}))
+        snap = cache.snapshot()
+        snap.nodes["n1"].idle.vec[0] = 0
+        next(iter(snap.jobs.values())).min_available = 99
+        assert cache.nodes["n1"].idle.vec[0] == 8000
+        assert cache.jobs["ns/p1"].min_available == 1
+
+
+class TestResyncRepair:
+    def test_failed_bind_resyncs_task(self):
+        """A binder failure queues the task; process_resync_tasks restores it
+        to the pre-bind state from the pod store (cache.go:478-484,559-581)."""
+        class ExplodingBinder:
+            def bind(self, pod, hostname):
+                raise RuntimeError("apiserver down")
+
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")])
+        cache.binder = ExplodingBinder()
+        pod = build_pod("ns", "p1", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB})
+        cache.add_pod(pod)
+        task = next(iter(cache.jobs["ns/p1"].tasks.values()))
+        cache.bind(task, "n1")
+        assert len(cache.err_tasks) == 1
+        cache.process_resync_tasks()
+        assert cache.err_tasks == []
+        task = next(iter(cache.jobs["ns/p1"].tasks.values()))
+        assert task.status == TaskStatus.PENDING
+        assert task.node_name is None

@@ -1,0 +1,83 @@
+"""Scheduler-conf loader tests (the rebuild's analog of the conf parsing
+covered by pkg/scheduler/util.go:44-70 + framework/arguments_test.go)."""
+
+import pytest
+
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.framework.arguments import Arguments
+from kube_batch_tpu.framework.conf import (
+    DEFAULT_CONF,
+    load_scheduler_conf,
+    parse_scheduler_conf,
+)
+
+
+class TestConfParsing:
+    def test_default_conf(self):
+        """Built-in fallback (util.go:31-42): allocate+backfill, two tiers."""
+        conf = load_scheduler_conf(None)
+        assert conf.actions == ["allocate", "backfill"]
+        assert len(conf.tiers) == 2
+        tier1 = [p.name for p in conf.tiers[0].plugins]
+        assert tier1 == ["priority", "gang", "conformance"]
+
+    def test_shipped_conf_shape(self):
+        """The shipped kube-batch-conf.yaml uses all five actions."""
+        conf = parse_scheduler_conf(
+            'actions: "enqueue, reclaim, allocate, backfill, preempt"\n'
+            "tiers:\n- plugins:\n  - name: gang\n"
+        )
+        assert conf.actions == ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+
+    def test_enable_switches_parse(self):
+        conf = parse_scheduler_conf(
+            "actions: allocate\n"
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: drf\n"
+            "    enabledPreemptable: false\n"
+        )
+        opt = conf.tiers[0].plugins[0]
+        assert opt.enabled_preemptable is False
+        assert opt.enabled_job_order is True  # defaults true (defaults.go:22-52)
+
+    def test_arguments_passed_through(self):
+        conf = parse_scheduler_conf(
+            "actions: allocate\n"
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: nodeorder\n"
+            "    arguments:\n"
+            "      leastrequested.weight: 2\n"
+        )
+        args = conf.tiers[0].plugins[0].arguments
+        assert args.get_int("leastrequested.weight", 1) == 2
+
+    def test_conf_file_roundtrip(self, tmp_path):
+        p = tmp_path / "conf.yaml"
+        p.write_text(DEFAULT_CONF)
+        conf = load_scheduler_conf(str(p))
+        assert conf.actions == ["allocate", "backfill"]
+
+
+class TestArguments:
+    """arguments_test.go:24-76 GetInt table."""
+
+    def test_get_int(self):
+        args = Arguments({"k": "5"})
+        assert args.get_int("k", 1) == 5
+        assert args.get_int("missing", 7) == 7
+
+    def test_get_int_garbage_falls_back(self):
+        args = Arguments({"k": "not-a-number"})
+        assert args.get_int("k", 3) == 3
+
+    def test_get_bool(self):
+        args = Arguments({"t": "true", "f": "false"})
+        assert args.get_bool("t", False) is True
+        assert args.get_bool("f", True) is False
+        assert args.get_bool("missing", True) is True
+
+    def test_get_float(self):
+        args = Arguments({"w": "1.5"})
+        assert args.get_float("w", 1.0) == 1.5
