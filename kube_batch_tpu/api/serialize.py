@@ -1,0 +1,99 @@
+"""JSON (de)serialization for the ingest object model.
+
+The reference's ingest protocol is the Kubernetes API server's watch/write
+JSON (cache.go:256-336). The standalone analog is this module: every object
+the cache consumes (Pod, PodGroup, Queue, Node, PriorityClass) round-trips
+through plain JSON dicts, used by the HTTP ingest API (cmd/server.py) and the
+queue CLI (cli/queue.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from kube_batch_tpu.api.pod import (
+    Affinity,
+    Node,
+    Pod,
+    PodGroup,
+    PodGroupCondition,
+    PriorityClass,
+    Queue,
+    Taint,
+    Toleration,
+)
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+
+
+def _clean(d: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in d.items() if v not in (None, {}, [], (), "")}
+
+
+def pod_to_dict(pod: Pod) -> Dict[str, Any]:
+    d = dataclasses.asdict(pod)
+    d["phase"] = pod.phase.value if pod.phase else None
+    if pod.affinity is not None:
+        d["affinity"] = {
+            "node_terms": [
+                [[k, op, list(vals)] for (k, op, vals) in term]
+                for term in pod.affinity.node_terms
+            ]
+        }
+    d["host_ports"] = list(pod.host_ports)
+    return _clean(d)
+
+
+def pod_from_dict(d: Dict[str, Any]) -> Pod:
+    d = dict(d)
+    if "phase" in d:
+        d["phase"] = PodPhase(d["phase"])
+    if "tolerations" in d:
+        d["tolerations"] = [Toleration(**t) for t in d["tolerations"]]
+    if "affinity" in d and d["affinity"] is not None:
+        d["affinity"] = Affinity(
+            node_terms=[
+                [(k, op, tuple(vals)) for (k, op, vals) in term]
+                for term in d["affinity"].get("node_terms", [])
+            ]
+        )
+    if "host_ports" in d:
+        d["host_ports"] = tuple(d["host_ports"])
+    return Pod(**d)
+
+
+def node_to_dict(node: Node) -> Dict[str, Any]:
+    return _clean(dataclasses.asdict(node))  # _clean keeps booleans
+
+
+def node_from_dict(d: Dict[str, Any]) -> Node:
+    d = dict(d)
+    if "taints" in d:
+        d["taints"] = [Taint(**t) for t in d["taints"]]
+    return Node(**d)
+
+
+def pod_group_to_dict(pg: PodGroup) -> Dict[str, Any]:
+    d = dataclasses.asdict(pg)
+    d["phase"] = pg.phase.value if pg.phase is not None else None
+    return _clean(d)
+
+
+def pod_group_from_dict(d: Dict[str, Any]) -> PodGroup:
+    d = dict(d)
+    if d.get("phase") is not None:
+        d["phase"] = PodGroupPhase(d["phase"])
+    if "conditions" in d:
+        d["conditions"] = [PodGroupCondition(**c) for c in d["conditions"]]
+    return PodGroup(**d)
+
+
+def queue_to_dict(q: Queue) -> Dict[str, Any]:
+    return _clean(dataclasses.asdict(q))
+
+
+def queue_from_dict(d: Dict[str, Any]) -> Queue:
+    return Queue(**d)
+
+
+def priority_class_from_dict(d: Dict[str, Any]) -> PriorityClass:
+    return PriorityClass(**d)

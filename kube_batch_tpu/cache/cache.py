@@ -44,10 +44,13 @@ class SchedulerCache:
         evictor=None,
         status_updater=None,
         volume_binder=None,
+        resolve_priority: bool = True,
     ):
         self.spec = spec
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # --priority-class toggle (options.go:30, consumed cache.go:352,378)
+        self.resolve_priority = resolve_priority
         self.binder = binder if binder is not None else FakeBinder()
         self.evictor = evictor if evictor is not None else FakeEvictor()
         self.status_updater = status_updater or FakeStatusUpdater()
@@ -74,6 +77,8 @@ class SchedulerCache:
         return pod.scheduler_name == self.scheduler_name or pod.node_name is not None
 
     def _resolve_pod_priority(self, pod: Pod) -> None:
+        if not self.resolve_priority:
+            return
         if pod.priority == 0 and pod.priority_class:
             pc = self.priority_classes.get(pod.priority_class)
             if pc is not None:
@@ -213,6 +218,8 @@ class SchedulerCache:
             self.queues.pop(name, None)
 
     def add_priority_class(self, pc: PriorityClass) -> None:
+        if not self.resolve_priority:
+            return  # informer not wired when disabled (cache.go:352,378)
         with self._lock:
             self.priority_classes[pc.name] = pc
             if pc.global_default:

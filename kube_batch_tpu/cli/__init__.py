@@ -1,0 +1,1 @@
+"""kubectl-style CLI (cmd/cli, pkg/cli/queue in the reference)."""

@@ -1,0 +1,66 @@
+"""`kb-ctl queue create|list` — the reference's cobra CLI
+(cmd/cli/queue.go:26-52; pkg/cli/queue/create.go, list.go), speaking the
+scheduler's HTTP admin API instead of the Kubernetes API server.
+
+    python -m kube_batch_tpu.cli.queue create --name q1 --weight 2 \
+        --server http://127.0.0.1:8080
+    python -m kube_batch_tpu.cli.queue list --server http://127.0.0.1:8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def _request(server: str, method: str, path: str, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        server.rstrip("/") + path,
+        data=data,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+def create(args) -> int:
+    """(pkg/cli/queue/create.go:38-68)"""
+    _request(args.server, "POST", "/v1/queues",
+             {"name": args.name, "weight": args.weight})
+    print(f"queue/{args.name} created")
+    return 0
+
+
+def list_(args) -> int:
+    """(pkg/cli/queue/list.go:51-87): Name, Weight, then the Queue status
+    podgroup-phase counts."""
+    rows = _request(args.server, "GET", "/v1/queues")
+    fmt = "%-25s%-8s%-8s%-8s%-8s%-8s"
+    print(fmt % ("Name", "Weight", "Pending", "Running", "Unknown", "Inqueue"))
+    for r in rows:
+        print(fmt % (r["name"], r["weight"], r["pending"], r["running"],
+                     r["unknown"], r["inqueue"]))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kb-ctl queue")
+    parser.add_argument("--server", default="http://127.0.0.1:8080",
+                        help="scheduler admin API address")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    pc = sub.add_parser("create", help="create a queue")
+    pc.add_argument("--name", required=True)
+    pc.add_argument("--weight", type=int, default=1)
+    pc.set_defaults(fn=create)
+    pl = sub.add_parser("list", help="list queues")
+    pl.set_defaults(fn=list_)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Process entry layer (cmd/kube-batch in the reference)."""

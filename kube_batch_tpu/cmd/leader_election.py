@@ -1,0 +1,148 @@
+"""Active/passive HA via a file-based lease lock.
+
+The reference does leader election with a ConfigMap resource lock — 15s
+lease, 10s renew deadline, 5s retry, and `glog.Fatalf` (crash → standby takes
+over) on lost leadership (cmd/kube-batch/app/server.go:48-52,106-151). The
+standalone analog uses an atomically-renamed lease file in the
+lock-object-namespace directory with the same timing constants and the same
+crash-on-loss contract."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+LEASE_DURATION = 15.0  # server.go:49
+RENEW_DEADLINE = 10.0  # server.go:50
+RETRY_PERIOD = 5.0     # server.go:51
+
+
+class LostLeadership(RuntimeError):
+    """Raised on the leader thread when renewal fails — the analog of
+    `glog.Fatalf("leaderelection lost")` (server.go:145)."""
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lock_dir: str,
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        self.lock_path = os.path.join(lock_dir, "kube-batch-tpu-lock")
+        self.identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._stop = threading.Event()
+
+    # -- lease record ---------------------------------------------------
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.lock_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self.lock_path))
+        with os.fdopen(fd, "w") as f:
+            json.dump({"holder": self.identity, "renew_time": time.time()}, f)
+        os.replace(tmp, self.lock_path)  # atomic on POSIX
+
+    def _try_acquire_or_renew(self) -> bool:
+        """The read-check-write is serialized through a short-lived O_EXCL
+        claim file so two standbys can't both grab an expired lease (the
+        resourcelock's apiserver-side compare-and-swap analog)."""
+        claim = self.lock_path + ".claim"
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:  # break a claim orphaned by a crashed contender
+                if time.time() - os.path.getmtime(claim) > self.lease_duration:
+                    os.unlink(claim)
+            except OSError:
+                pass
+            return False
+        try:
+            rec = self._read()
+            now = time.time()
+            if rec is not None and rec["holder"] != self.identity:
+                if now - rec["renew_time"] < self.lease_duration:
+                    return False  # current leader's lease still valid
+            self._write()
+            return True
+        finally:
+            os.close(fd)
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+
+    # -- run loop (leaderelection.RunOrDie analog) ----------------------
+    def run(
+        self,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Block until leadership is acquired, run the callback, and renew in
+        the background. If renewal misses the deadline, `on_stopped_leading`
+        is invoked (it must make the leading callback return — e.g.
+        Scheduler.stop) and LostLeadership is raised, mirroring the
+        reference's crash-on-loss (server.go:145)."""
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            self._stop.wait(self.retry_period)
+        if self._stop.is_set():
+            return
+
+        failure = []
+
+        def renew_loop():
+            last_renew = time.time()
+            while not self._stop.is_set():
+                self._stop.wait(self.retry_period)
+                if self._stop.is_set():
+                    return
+                if self._try_acquire_or_renew():
+                    last_renew = time.time()
+                elif time.time() - last_renew > self.renew_deadline:
+                    failure.append(True)
+                    if on_stopped_leading is not None:
+                        on_stopped_leading()
+                    return
+
+        t = threading.Thread(target=renew_loop, daemon=True, name="lease-renew")
+        t.start()
+        try:
+            on_started_leading()
+        finally:
+            self.release()
+        if failure:
+            raise LostLeadership(f"{self.identity} lost the lease")
+
+    def is_leader(self) -> bool:
+        rec = self._read()
+        return (
+            rec is not None
+            and rec["holder"] == self.identity
+            and time.time() - rec["renew_time"] < self.lease_duration
+        )
+
+    def release(self) -> None:
+        self._stop.set()
+        rec = self._read()
+        if rec is not None and rec["holder"] == self.identity:
+            try:
+                os.unlink(self.lock_path)
+            except OSError:
+                pass

@@ -1,0 +1,31 @@
+"""Process entry (cmd/kube-batch/main.go): `python -m kube_batch_tpu.cmd.main`."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from kube_batch_tpu.cmd import options, server
+from kube_batch_tpu.version import version_string
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(levelname).1s%(asctime)s %(name)s] %(message)s",
+    )
+    opt = options.parse(argv)
+    if opt.print_version:
+        print(version_string())
+        return 0
+    try:
+        opt.check_option_or_die()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    server.run(opt)  # validated: run() itself doesn't re-check
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Server options — all 13 CLI flags of the reference
+(cmd/kube-batch/app/options/options.go:37-95), adapted to the standalone
+host: `master`/`kubeconfig` become the listen address of an upstream ingest
+feed (optional), QPS/burst throttle the egress writer."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ServerOption:
+    """(options.go:37-51; defaults options.go:63-81)"""
+
+    master: str = ""
+    kubeconfig: str = ""
+    scheduler_name: str = "volcano"
+    scheduler_conf: str = ""
+    schedule_period: float = 1.0  # seconds (`--schedule-period`, 1s default)
+    default_queue: str = "default"
+    enable_leader_election: bool = False
+    lock_object_namespace: str = ""
+    listen_address: str = ":8080"
+    enable_priority_class: bool = True
+    kube_api_qps: float = 50.0
+    kube_api_burst: int = 100
+    print_version: bool = False
+
+    def check_option_or_die(self) -> None:
+        """(options.go:84-90): leader election requires a lock namespace;
+        the listen address must carry a numeric port."""
+        if self.enable_leader_election and not self.lock_object_namespace:
+            raise ValueError(
+                "lock-object-namespace must not be nil when LeaderElection is enabled"
+            )
+        self.listen_host_port  # noqa: B018 — raises ValueError when malformed
+
+    @property
+    def listen_host_port(self) -> tuple:
+        host, sep, port = self.listen_address.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(
+                f"listen-address {self.listen_address!r} must be host:port"
+            )
+        host = host.strip("[]")  # [::]:8080 → ::
+        return host or "0.0.0.0", int(port)
+
+
+# process-global options (options.go:54 `ServerOpts`)
+server_opts: Optional[ServerOption] = None
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    """(options.go:63-81)"""
+    d = ServerOption()
+    parser.add_argument("--master", default=d.master,
+                        help="url of an upstream cluster feed (accepted for CLI "
+                             "parity; standalone ingest is the HTTP admin API)")
+    parser.add_argument("--kubeconfig", default=d.kubeconfig,
+                        help="path to a cluster-connection config file (accepted "
+                             "for CLI parity; unused standalone)")
+    parser.add_argument("--scheduler-name", default=d.scheduler_name,
+                        help="the scheduler name pods request in schedulerName")
+    parser.add_argument("--scheduler-conf", default=d.scheduler_conf,
+                        help="path to the YAML actions/tiers configuration")
+    parser.add_argument("--schedule-period", default=d.schedule_period, type=float,
+                        help="seconds between scheduling cycles")
+    parser.add_argument("--default-queue", default=d.default_queue,
+                        help="queue assigned to podgroups that name none")
+    parser.add_argument("--leader-elect", action="store_true",
+                        default=d.enable_leader_election,
+                        help="enable active/passive HA via a lease lock")
+    parser.add_argument("--lock-object-namespace", default=d.lock_object_namespace,
+                        help="namespace (directory) holding the leader lease")
+    parser.add_argument("--listen-address", default=d.listen_address,
+                        help="host:port for /metrics and the admin API")
+    parser.add_argument("--priority-class", dest="priority_class", default=d.enable_priority_class,
+                        action="store_true",
+                        help="resolve pod/job priority from PriorityClasses")
+    parser.add_argument("--no-priority-class", dest="priority_class", action="store_false")
+    parser.add_argument("--kube-api-qps", default=d.kube_api_qps, type=float,
+                        help="egress write QPS limit")
+    parser.add_argument("--kube-api-burst", default=d.kube_api_burst, type=int,
+                        help="egress write burst")
+    parser.add_argument("--version", action="store_true", default=False,
+                        help="print version and exit")
+
+
+def parse(argv: Optional[List[str]] = None) -> ServerOption:
+    parser = argparse.ArgumentParser(prog="kube-batch-tpu")
+    add_flags(parser)
+    ns = parser.parse_args(argv)
+    opt = ServerOption(
+        master=ns.master,
+        kubeconfig=ns.kubeconfig,
+        scheduler_name=ns.scheduler_name,
+        scheduler_conf=ns.scheduler_conf,
+        schedule_period=ns.schedule_period,
+        default_queue=ns.default_queue,
+        enable_leader_election=ns.leader_elect,
+        lock_object_namespace=ns.lock_object_namespace,
+        listen_address=ns.listen_address,
+        enable_priority_class=ns.priority_class,
+        kube_api_qps=ns.kube_api_qps,
+        kube_api_burst=ns.kube_api_burst,
+        print_version=ns.version,
+    )
+    global server_opts
+    server_opts = opt
+    return opt

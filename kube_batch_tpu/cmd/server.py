@@ -1,0 +1,257 @@
+"""HTTP server + process run loop (cmd/kube-batch/app/server.go).
+
+The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
+(server.go:96-99) and ingests cluster state through ten API-server informers
+(cache.go:256-336). Standalone, the same listener carries both:
+
+- GET  /metrics                — Prometheus text exposition (same metric names)
+- GET  /healthz                — liveness
+- GET  /version
+- POST/DELETE /v1/pods         — informer-shaped ingest (JSON bodies per
+- POST/DELETE /v1/nodes          api/serialize.py); POST is add-or-update,
+- POST/DELETE /v1/podgroups      matching the informers' upsert handlers
+- POST/DELETE /v1/queues         (event_handlers.go)
+- POST        /v1/priorityclasses
+- GET  /v1/queues              — queue list w/ podgroup phase counts (the
+                                 Queue CRD status the CLI renders, list.go:51)
+- GET  /v1/jobs                — podgroup phases/conditions
+- GET  /v1/bindings            — pod→node decisions made so far
+
+`Run` mirrors app.Run (server.go:76-151): build cache + scheduler, start the
+HTTP listener, then run the scheduling loop — optionally gated behind leader
+election."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kube_batch_tpu import metrics
+from kube_batch_tpu.api import serialize
+from kube_batch_tpu.api.types import PodGroupPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cmd.leader_election import LeaderElector
+from kube_batch_tpu.cmd.options import ServerOption
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.version import version_string
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+def _queue_status(cache: SchedulerCache) -> list:
+    """Queue list with the CRD's status counts (types.go:211-223)."""
+    with cache._lock:
+        counts = {
+            name: {"pending": 0, "running": 0, "unknown": 0, "inqueue": 0}
+            for name in cache.queues
+        }
+        for job in cache.jobs.values():
+            c = counts.get(job.queue)
+            if c is None or job.pod_group is None:
+                continue
+            phase = job.pod_group.phase or PodGroupPhase.PENDING
+            c[phase.value.lower()] = c.get(phase.value.lower(), 0) + 1
+        return [
+            {"name": name, "weight": q.weight, **counts[name]}
+            for name, q in sorted(cache.queues.items())
+        ]
+
+
+def _job_status(cache: SchedulerCache) -> list:
+    with cache._lock:
+        rows = []
+        for uid, job in sorted(cache.jobs.items()):
+            pg = job.pod_group
+            rows.append(
+                {
+                    "uid": uid,
+                    "queue": job.queue,
+                    "min_member": job.min_available,
+                    "phase": (pg.phase.value if pg and pg.phase else "Pending"),
+                    "running": pg.running if pg else 0,
+                    "conditions": [
+                        {"type": c.type, "status": c.status, "reason": c.reason,
+                         "message": c.message}
+                        for c in (pg.conditions if pg else [])
+                    ],
+                }
+            )
+        return rows
+
+
+def _bindings(cache: SchedulerCache) -> list:
+    with cache._lock:
+        out = []
+        for job in cache.jobs.values():
+            for task in job.tasks.values():
+                if task.node_name is not None:
+                    out.append({"pod": task.key(), "node": task.node_name,
+                                "status": task.status.name})
+        return sorted(out, key=lambda r: r["pod"])
+
+
+def make_handler(cache: SchedulerCache):
+    ingest = {
+        # POST is add-or-update: update_pod is delete+add (event_handlers.go:116-130)
+        "pods": (serialize.pod_from_dict, cache.update_pod, cache.delete_pod),
+        "nodes": (serialize.node_from_dict, cache.add_node,
+                  lambda n: cache.delete_node(n.name)),
+        "podgroups": (serialize.pod_group_from_dict, cache.add_pod_group,
+                      lambda pg: cache.delete_pod_group(pg.key())),
+        "queues": (serialize.queue_from_dict, cache.add_queue,
+                   lambda q: cache.delete_queue(q.name)),
+        "priorityclasses": (serialize.priority_class_from_dict,
+                            cache.add_priority_class,
+                            lambda pc: cache.delete_priority_class(pc.name)),
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to glog-analog logger
+            logger.debug("http: " + fmt, *args)
+
+        def _send(self, code: int, body: str, ctype="application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, metrics.render_prometheus(), "text/plain; version=0.0.4")
+            elif self.path == "/healthz":
+                self._send(200, "ok", "text/plain")
+            elif self.path == "/version":
+                self._send(200, version_string(), "text/plain")
+            elif self.path == "/v1/queues":
+                self._send(200, json.dumps(_queue_status(cache)))
+            elif self.path == "/v1/jobs":
+                self._send(200, json.dumps(_job_status(cache)))
+            elif self.path == "/v1/bindings":
+                self._send(200, json.dumps(_bindings(cache)))
+            else:
+                self._send(404, json.dumps({"error": "not found"}))
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def _ingest(self, delete: bool):
+            kind = self.path.rsplit("/", 1)[-1]
+            entry = ingest.get(kind)
+            if entry is None:
+                self._send(404, json.dumps({"error": f"unknown kind {kind}"}))
+                return
+            parse, add, remove = entry
+            try:
+                obj = parse(self._body())
+                (remove if delete else add)(obj)
+            except (TypeError, ValueError, KeyError) as e:
+                self._send(400, json.dumps({"error": str(e)}))
+                return
+            self._send(200, json.dumps({"ok": True}))
+
+        def do_POST(self):
+            self._ingest(delete=False)
+
+        def do_DELETE(self):
+            self._ingest(delete=True)
+
+    return Handler
+
+
+class AdminServer:
+    """The --listen-address listener (server.go:96-99)."""
+
+    def __init__(self, cache: SchedulerCache, host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(cache))
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="admin-http"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class RateLimitedBackend:
+    """Token-bucket throttle on egress writes — the client-side 50 QPS /
+    100-burst throttling of the reference (options.go:32-33, server.go:69-70)
+    applied to the Binder/Evictor seam."""
+
+    def __init__(self, backend, qps: float, burst: int):
+        import time as _time
+
+        self._backend = backend
+        self._qps = qps
+        self._burst = float(burst)
+        self._tokens = float(burst)
+        self._last = _time.monotonic()
+        self._lock = threading.Lock()
+        self._time = _time
+
+    def _take(self) -> None:
+        with self._lock:
+            now = self._time.monotonic()
+            self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
+            self._last = now
+            if self._tokens < 1.0:
+                wait = (1.0 - self._tokens) / self._qps
+                # the slept interval mints exactly the token consumed here
+                self._last = now + wait
+                self._tokens = 0.0
+                self._time.sleep(wait)
+            else:
+                self._tokens -= 1.0
+
+    def bind(self, pod, hostname):
+        self._take()
+        return self._backend.bind(pod, hostname)
+
+    def evict(self, pod):
+        self._take()
+        return self._backend.evict(pod)
+
+
+def run(opt: ServerOption) -> None:
+    """app.Run (server.go:76-151): metrics/admin listener up front, then the
+    scheduling loop — behind leader election when enabled. Option validation
+    and --version live in cmd/main.py."""
+    from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor
+
+    cache = SchedulerCache(
+        scheduler_name=opt.scheduler_name,
+        default_queue=opt.default_queue,
+        binder=RateLimitedBackend(FakeBinder(), opt.kube_api_qps, opt.kube_api_burst),
+        evictor=RateLimitedBackend(FakeEvictor(), opt.kube_api_qps, opt.kube_api_burst),
+        resolve_priority=opt.enable_priority_class,
+    )
+    sched = Scheduler(
+        cache,
+        conf_path=opt.scheduler_conf or None,
+        schedule_period=opt.schedule_period,
+    )
+    host, port = opt.listen_host_port
+    admin = AdminServer(cache, host, port)
+    admin.start()
+    logger.info("admin/metrics listening on %s:%d", host, admin.port)
+    try:
+        if opt.enable_leader_election:
+            elector = LeaderElector(opt.lock_object_namespace)
+            # on lease loss the elector stops the loop so run() can raise —
+            # the crash-on-loss contract (server.go:145); a supervisor restarts
+            # the process as a standby
+            elector.run(sched.run_forever, on_stopped_leading=sched.stop)
+        else:
+            sched.run_forever()
+    finally:
+        admin.stop()

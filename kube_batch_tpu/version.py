@@ -1,0 +1,9 @@
+"""Version info (pkg/version/version.go; injected via LD_FLAGS in the
+reference's Makefile:7-10 — a plain constant here)."""
+
+VERSION = "0.1.0"
+GIT_SHA = "dev"
+
+
+def version_string() -> str:
+    return f"kube-batch-tpu {VERSION} ({GIT_SHA})"

@@ -1,0 +1,249 @@
+"""L0/L10 layer tests: options defaults (options_test.go:51), the HTTP
+admin/ingest API (the informer + CLI seam), leader election, serialization
+round-trips, and the queue CLI against a live server."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.api import serialize
+from kube_batch_tpu.api.pod import (
+    GROUP_NAME_ANNOTATION,
+    Affinity,
+    Node,
+    Pod,
+    PodGroup,
+    Queue,
+    Taint,
+    Toleration,
+)
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cli import queue as queue_cli
+from kube_batch_tpu.cmd import options
+from kube_batch_tpu.cmd.leader_election import LeaderElector
+from kube_batch_tpu.cmd.server import AdminServer
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.scheduler import Scheduler
+from tests.fixtures import build_node, build_pod
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+        return json.loads(body) if "json" in ctype else body.decode()
+
+
+def _post(port: int, path: str, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+class TestOptions:
+    def test_defaults(self):
+        opt = options.parse([])
+        assert opt.scheduler_name == "volcano"
+        assert opt.schedule_period == 1.0
+        assert opt.default_queue == "default"
+        assert opt.enable_leader_election is False
+        assert opt.listen_address == ":8080"
+        assert opt.enable_priority_class is True
+        assert opt.kube_api_qps == 50.0
+        assert opt.kube_api_burst == 100
+
+    def test_leader_election_requires_namespace(self):
+        opt = options.parse(["--leader-elect"])
+        with pytest.raises(ValueError):
+            opt.check_option_or_die()
+
+    def test_flag_parse(self):
+        opt = options.parse(
+            ["--scheduler-name", "kb", "--schedule-period", "0.5",
+             "--listen-address", "127.0.0.1:9999"]
+        )
+        assert opt.scheduler_name == "kb"
+        assert opt.schedule_period == 0.5
+        assert opt.listen_host_port == ("127.0.0.1", 9999)
+
+    def test_malformed_listen_address_rejected(self):
+        opt = options.parse(["--listen-address", "localhost"])
+        with pytest.raises(ValueError):
+            opt.check_option_or_die()
+        opt = options.parse(["--listen-address", "[::]:8080"])
+        assert opt.listen_host_port == ("::", 8080)
+
+    def test_priority_class_toggle(self):
+        from kube_batch_tpu.api.pod import PriorityClass
+        cache = SchedulerCache(resolve_priority=False)
+        cache.add_priority_class(PriorityClass(name="high", value=100))
+        assert cache.priority_classes == {}
+        pod = build_pod("default", "p", None, PodPhase.PENDING,
+                        {"cpu": 100.0}, priority_class="high")
+        cache.add_pod(pod)
+        assert pod.priority == 0
+
+
+class TestSerialize:
+    def test_pod_round_trip(self):
+        pod = Pod(
+            name="p1", requests={"cpu": 1000, "memory": 1 << 30},
+            annotations={GROUP_NAME_ANNOTATION: "pg1"},
+            tolerations=[Toleration(key="k", operator="Exists")],
+            affinity=Affinity(node_terms=[[("zone", "In", ("a", "b"))]]),
+            host_ports=(8080,),
+        )
+        back = serialize.pod_from_dict(serialize.pod_to_dict(pod))
+        assert back.key() == pod.key()
+        assert back.requests == pod.requests
+        assert back.group_name == "pg1"
+        assert back.tolerations[0].operator == "Exists"
+        assert back.affinity.node_terms == [[("zone", "In", ("a", "b"))]]
+        assert back.host_ports == (8080,)
+
+    def test_node_round_trip(self):
+        node = Node(name="n1", allocatable={"cpu": 4000},
+                    taints=[Taint(key="t", effect="NoSchedule")],
+                    labels={"zone": "a"})
+        back = serialize.node_from_dict(serialize.node_to_dict(node))
+        assert back.name == "n1" and back.taints[0].key == "t"
+        assert back.labels == {"zone": "a"}
+
+    def test_pod_group_round_trip(self):
+        pg = PodGroup(name="pg1", min_member=3, queue="q1")
+        back = serialize.pod_group_from_dict(serialize.pod_group_to_dict(pg))
+        assert back.min_member == 3 and back.queue == "q1"
+        assert back.phase is None
+
+
+class TestAdminServer:
+    @pytest.fixture()
+    def server(self):
+        cache = SchedulerCache()
+        srv = AdminServer(cache, port=0)
+        srv.start()
+        yield cache, srv
+        srv.stop()
+
+    def test_health_version_metrics(self, server):
+        _, srv = server
+        assert _get(srv.port, "/healthz") == "ok"
+        assert "kube-batch-tpu" in _get(srv.port, "/version")
+        assert "volcano_e2e_scheduling_latency_milliseconds" in _get(srv.port, "/metrics")
+
+    def test_ingest_schedule_and_read_back(self, server):
+        cache, srv = server
+        _post(srv.port, "/v1/queues", {"name": "default", "weight": 1})
+        _post(srv.port, "/v1/nodes", serialize.node_to_dict(build_node("n1")))
+        _post(srv.port, "/v1/podgroups",
+              serialize.pod_group_to_dict(PodGroup(name="pg1", min_member=1)))
+        _post(srv.port, "/v1/pods", serialize.pod_to_dict(
+            build_pod("default", "p1", None, PodPhase.PENDING,
+                      {"cpu": 1000.0}, group_name="pg1")))
+        # one scheduling cycle over the ingested state
+        Scheduler(cache, conf=load_scheduler_conf(None)).run_once()
+        bindings = _get(srv.port, "/v1/bindings")
+        assert bindings == [{"pod": "default/p1", "node": "n1", "status": "BINDING"}]
+        jobs = _get(srv.port, "/v1/jobs")
+        assert jobs[0]["phase"] == "Running"
+        queues = _get(srv.port, "/v1/queues")
+        assert queues[0]["name"] == "default" and queues[0]["running"] == 1
+
+    def test_pod_repost_is_upsert(self, server):
+        cache, srv = server
+        _post(srv.port, "/v1/queues", {"name": "default", "weight": 1})
+        pod = serialize.pod_to_dict(
+            build_pod("default", "p1", None, PodPhase.PENDING, {"cpu": 500.0}))
+        _post(srv.port, "/v1/pods", pod)
+        pod["requests"] = {"cpu": 700.0}
+        _post(srv.port, "/v1/pods", pod)  # re-POST: update, not duplicate
+        job = next(iter(cache.jobs.values()))
+        assert len(job.tasks) == 1
+        assert job.total_request.milli_cpu == 700.0
+
+    def test_delete_and_errors(self, server):
+        cache, srv = server
+        _post(srv.port, "/v1/queues", {"name": "q2", "weight": 3})
+        assert "q2" in cache.queues
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/queues",
+            data=json.dumps({"name": "q2"}).encode(), method="DELETE",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5)
+        assert "q2" not in cache.queues
+        with pytest.raises(urllib.error.HTTPError):
+            _post(srv.port, "/v1/widgets", {})
+        with pytest.raises(urllib.error.HTTPError):
+            _post(srv.port, "/v1/pods", {"bogus_field": 1})
+
+
+class TestQueueCLI:
+    def test_create_and_list(self, capsys):
+        cache = SchedulerCache()
+        srv = AdminServer(cache, port=0)
+        srv.start()
+        try:
+            server = f"http://127.0.0.1:{srv.port}"
+            assert queue_cli.main(["--server", server, "create",
+                                   "--name", "gold", "--weight", "5"]) == 0
+            assert cache.queues["gold"].weight == 5
+            assert queue_cli.main(["--server", server, "list"]) == 0
+            out = capsys.readouterr().out
+            assert "gold" in out and "Weight" in out
+        finally:
+            srv.stop()
+
+
+class TestRateLimiter:
+    def test_bind_throttled_to_qps(self):
+        from kube_batch_tpu.cache.fake import FakeBinder
+        from kube_batch_tpu.cmd.server import RateLimitedBackend
+
+        rl = RateLimitedBackend(FakeBinder(), qps=100.0, burst=5)
+        pods = [build_pod("default", f"p{i}", None, PodPhase.PENDING, {})
+                for i in range(15)]
+        t0 = time.perf_counter()
+        for p in pods:
+            rl.bind(p, "n1")
+        elapsed = time.perf_counter() - t0
+        # 15 binds, burst 5 → ≥10 token waits at 100/s ≈ ≥0.1s
+        assert elapsed >= 0.08
+        assert len(rl._backend.binds) == 15
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self, tmp_path):
+        a = LeaderElector(str(tmp_path), identity="a",
+                          lease_duration=0.4, renew_deadline=0.3, retry_period=0.05)
+        b = LeaderElector(str(tmp_path), identity="b",
+                          lease_duration=0.4, renew_deadline=0.3, retry_period=0.05)
+        order = []
+
+        def lead(elector, name, hold):
+            def body():
+                order.append(name)
+                time.sleep(hold)
+            elector.run(body)
+
+        ta = threading.Thread(target=lead, args=(a, "a", 0.3), daemon=True)
+        ta.start()
+        time.sleep(0.1)
+        assert a.is_leader() and not b.is_leader()
+        tb = threading.Thread(target=lead, args=(b, "b", 0.1), daemon=True)
+        tb.start()
+        time.sleep(0.1)
+        assert order == ["a"]  # b blocked while a's lease is valid
+        ta.join(2)
+        tb.join(2)
+        assert order == ["a", "b"]  # release → standby takes over
