@@ -1,0 +1,155 @@
+"""Benchmark matrix — the kubemark density-test successor (SURVEY.md §4.3).
+
+The reference measures pod-startup latency percentiles on a simulated GCE
+cluster (test/e2e/benchmark.go:53-285, p50/p90/p99 via metric_util.go). Here
+every BASELINE.json config runs as a synthetic scheduling-cycle benchmark
+with the same percentile reporting — no apiserver, the snapshot feeds the
+device directly:
+
+  gang_allocate_kubemark      3k pods × 100 nodes, minMember=4 (the kubemark
+                              density target, kubemark-benchmarking.md:40-42)
+  drf_proportion_3_queues     50k × 5k, 3 weighted queues, mixed CPU/mem
+  binpack_nodeorder_10k_1k    10k × 1k with the binpack score row enabled
+  preempt_reclaim_overcommit  full action pipeline over an overcommitted
+                              2-queue cluster (host actions + device solve)
+  hetero_gpu_gangs_50k_5k     heterogeneous GPU gangs at full scale
+
+Run: python -m kube_batch_tpu.testing.benchmark [--quick]
+Prints one JSON line per config plus a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, NamedTuple
+
+import numpy as np
+
+TARGET_MS = 1000.0  # <1s/cycle north star
+
+
+def _percentiles(ms: List[float]) -> Dict[str, float]:
+    return {
+        "p50_ms": round(float(np.percentile(ms, 50)), 2),
+        "p90_ms": round(float(np.percentile(ms, 90)), 2),
+        "p99_ms": round(float(np.percentile(ms, 99)), 2),
+    }
+
+
+class BenchCase(NamedTuple):
+    name: str
+    run: Callable[[int], Dict]  # cycles → result dict
+
+
+def _device_case(name, n_tasks, n_nodes, gang_size=4, n_queues=3,
+                 gpu_task_frac=0.0, gpu_node_frac=0.25, weights=None):
+    """A device-solve cycle benchmark: host→device ship, compiled allocate
+    solve, assignment back (the one-in/one-out transfer budget, §7.3)."""
+
+    def run(cycles: int) -> Dict:
+        import jax
+
+        from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+        from kube_batch_tpu.ops.scoring import ScoreWeights
+        from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
+
+        config = AllocateConfig(weights=weights or ScoreWeights())
+        snap_np, meta = synthetic_device_snapshot(
+            n_tasks=n_tasks, n_nodes=n_nodes, gang_size=gang_size,
+            n_queues=n_queues, gpu_task_frac=gpu_task_frac,
+            gpu_node_frac=gpu_node_frac,
+        )
+
+        def cycle():
+            snap = jax.device_put(snap_np)
+            result = allocate_solve(snap, config)
+            return np.asarray(result.assigned)
+
+        assigned = cycle()  # warmup/compile
+        placed = int((assigned[: meta.n_tasks] >= 0).sum())
+        times = []
+        for _ in range(cycles):
+            t0 = time.perf_counter()
+            cycle()
+            times.append((time.perf_counter() - t0) * 1e3)
+        return {
+            "tasks": meta.n_tasks, "nodes": meta.n_nodes, "placed": placed,
+            **_percentiles(times),
+            "pods_per_sec": round(placed / (np.percentile(times, 50) / 1e3), 0),
+        }
+
+    return BenchCase(name, run)
+
+
+def _overcommit_case(name, n_running=800, n_pending=400, n_nodes=100):
+    """preempt + reclaim under queue overcommit: queue q1 (weight 3) has
+    pending gangs while queue q0 (weight 1) holds every node — the full
+    enqueue→reclaim→allocate→backfill→preempt pipeline runs each cycle."""
+
+    def run(cycles: int) -> Dict:
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.scheduler import Scheduler
+        from kube_batch_tpu.testing.synthetic import synthetic_overcommit_cluster
+
+        conf = load_scheduler_conf(None)
+        conf.actions = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+        times = []
+        evicted = placed = 0
+        for _ in range(cycles):
+            cache = synthetic_overcommit_cluster(
+                n_running=n_running, n_pending=n_pending, n_nodes=n_nodes
+            )
+            sched = Scheduler(cache, conf=conf)
+            t0 = time.perf_counter()
+            sched.run_once()
+            times.append((time.perf_counter() - t0) * 1e3)
+            evicted = len(cache.evictor.evicts)
+            placed = len(cache.binder.binds)
+        return {
+            "running": n_running, "pending": n_pending, "nodes": n_nodes,
+            "evicted": evicted, "placed": placed, **_percentiles(times),
+        }
+
+    return BenchCase(name, run)
+
+
+def build_cases() -> List[BenchCase]:
+    from kube_batch_tpu.ops.scoring import ScoreWeights
+
+    return [
+        _device_case("gang_allocate_kubemark", 3_000, 100),
+        _device_case("drf_proportion_3_queues", 50_000, 5_000),
+        _device_case("binpack_nodeorder_10k_1k", 10_000, 1_000,
+                     weights=ScoreWeights(binpack=1.0)),
+        _overcommit_case("preempt_reclaim_overcommit"),
+        _device_case("hetero_gpu_gangs_50k_5k", 50_000, 5_000,
+                     gpu_task_frac=0.2, gpu_node_frac=0.25),
+    ]
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cycles", type=int, default=5)
+    parser.add_argument("--quick", action="store_true",
+                        help="2 cycles per config")
+    args = parser.parse_args(argv)
+    cycles = 2 if args.quick else args.cycles
+
+    results = {}
+    for case in build_cases():
+        r = case.run(cycles)
+        results[case.name] = r
+        print(json.dumps({"config": case.name, **r}), flush=True)
+    worst = max(r["p99_ms"] for r in results.values())
+    print(json.dumps({
+        "summary": "baseline_config_matrix",
+        "configs": len(results),
+        "worst_p99_ms": worst,
+        "all_under_target": worst < TARGET_MS,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -157,6 +157,75 @@ def synthetic_device_snapshot(
     return snap, meta
 
 
+def synthetic_overcommit_cluster(
+    n_running: int = 800,
+    n_pending: int = 400,
+    n_nodes: int = 100,
+    gang_size: int = 4,
+    seed: int = 0,
+):
+    """Overcommitted 2-queue cluster for preempt/reclaim benchmarks: queue q0
+    (weight 1) runs gangs that fill most of every node; queue q1 (weight 3)
+    has pending gangs that can only start by reclaiming cross-queue — the
+    BASELINE.json "preempt + reclaim actions under queue overcommit" config."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+    from kube_batch_tpu.cache.cache import SchedulerCache
+
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache()
+    cache.add_queue(Queue(name="q0", weight=1))
+    cache.add_queue(Queue(name="q1", weight=3))
+    for i in range(n_nodes):
+        cache.add_node(
+            Node(
+                name=f"n{i}",
+                allocatable={"cpu": NODE_CPU, "memory": NODE_MEM, "pods": NODE_PODS},
+            )
+        )
+    # running workload in q0, round-robin across nodes sized to fill them
+    per_node = max(1, n_running // n_nodes)
+    cpu_each = NODE_CPU / per_node  # saturates cpu exactly
+    n_run_jobs = -(-n_running // gang_size)
+    for j in range(n_run_jobs):
+        cache.add_pod_group(
+            PodGroup(name=f"run{j}", namespace="bench", min_member=1,
+                     queue="q0", creation_index=j)
+        )
+    for i in range(n_running):
+        cache.add_pod(
+            Pod(
+                name=f"r{i}", namespace="bench",
+                requests={"cpu": cpu_each, "memory": 1 * GiB},
+                annotations={GROUP_NAME_ANNOTATION: f"run{i // gang_size}"},
+                phase=PodPhase.RUNNING,
+                node_name=f"n{i % n_nodes}",
+                creation_index=i,
+            )
+        )
+    # pending gangs in the heavier queue
+    n_pend_jobs = -(-n_pending // gang_size)
+    for j in range(n_pend_jobs):
+        cache.add_pod_group(
+            PodGroup(name=f"pend{j}", namespace="bench",
+                     min_member=min(gang_size, n_pending - j * gang_size),
+                     queue="q1", creation_index=n_run_jobs + j)
+        )
+    for i in range(n_pending):
+        cache.add_pod(
+            Pod(
+                name=f"p{i}", namespace="bench",
+                requests={
+                    "cpu": float(rng.choice(CPU_CHOICES)),
+                    "memory": float(rng.choice(MEM_CHOICES)),
+                },
+                annotations={GROUP_NAME_ANNOTATION: f"pend{i // gang_size}"},
+                phase=PodPhase.PENDING,
+                creation_index=n_running + i,
+            )
+        )
+    return cache
+
+
 def synthetic_cluster(
     n_tasks: int = 200,
     n_nodes: int = 20,

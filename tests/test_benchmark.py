@@ -1,0 +1,23 @@
+"""Smoke tests for the benchmark matrix (testing/benchmark.py) at tiny sizes."""
+
+from __future__ import annotations
+
+from kube_batch_tpu.testing.benchmark import _device_case, _overcommit_case, _percentiles
+
+
+def test_percentiles():
+    p = _percentiles([1.0, 2.0, 3.0, 4.0])
+    assert p["p50_ms"] == 2.5 and p["p99_ms"] <= 4.0
+
+
+def test_device_case_tiny():
+    r = _device_case("tiny", 64, 16).run(1)
+    assert r["placed"] > 0
+    assert r["p50_ms"] > 0
+
+
+def test_overcommit_case_tiny():
+    r = _overcommit_case("tiny", n_running=40, n_pending=16, n_nodes=8).run(1)
+    # q1's pending gangs must trigger cross-queue reclaim of q0's running pods
+    assert r["evicted"] > 0
+    assert r["p50_ms"] > 0
