@@ -31,16 +31,14 @@ class AllocateAction(Action):
 
     def execute(self, ssn) -> None:
         # session → ClusterInfo view (the session's jobs/nodes/queues ARE the
-        # snapshot clone; invalid jobs were already dropped at open)
+        # snapshot clone; invalid jobs were already dropped at open). ALL jobs
+        # are included so fairness state (queue_alloc/job_allocated) counts
+        # Pending-phase jobs' allocations; the Pending-phase gate
+        # (allocate.go:50-52) is the snapshot's job_schedulable flag
         cluster = ClusterInfo(ssn.spec)
         cluster.nodes = ssn.nodes
         cluster.queues = ssn.queues
-        # the Pending-phase gate (allocate.go:50-52)
-        cluster.jobs = {
-            uid: j
-            for uid, j in ssn.jobs.items()
-            if not (j.pod_group and j.pod_group.phase == PodGroupPhase.PENDING)
-        }
+        cluster.jobs = ssn.jobs
         if not cluster.jobs or not cluster.nodes:
             return
 

@@ -1,108 +1,134 @@
-"""preempt action (actions/preempt/preempt.go) — same-queue preemption.
+"""preempt action (actions/preempt/preempt.go) — same-queue preemption,
+device-solved phase 1 + host phase 2.
 
-Phase 1: between jobs in a queue — starved (pending-task) jobs pipeline onto
-resources freed by evicting Running victims of *other* jobs in the same
-queue; the Statement commits only once the preemptor job is Pipelined
-(preempt.go:110-137). Phase 2: within a job — task-priority rebalancing,
-committed unconditionally (preempt.go:145-174).
+Phase 1 (inter-job within a queue, preempt.go:110-137): ops/eviction's
+preempt-mode solve proposes (preemptor → node, victims) honoring conformance,
+gang slack, and DRF share dominance; the host replays each preemptor job
+through a Statement — evictions + pipelines commit only when the job reaches
+Pipelined, mirroring the reference's commit gate.
 
-Victim choice per node: filter → ssn.Preemptable (tier-intersection of
-conformance ∩ gang ∩ drf) → validate total covers the request → evict
-lowest-task-order first until covered (preempt.go:180-277)."""
+Phase 2 (intra-job task-priority rebalancing, preempt.go:145-174) stays a
+host loop but only runs for jobs where a pending task outranks a running one
+— the common all-equal-priority case short-circuits to nothing."""
 
 from __future__ import annotations
 
-from typing import Callable, List
+import logging
+from collections import defaultdict
+from typing import Callable, Dict, List, Tuple
 
+from kube_batch_tpu.actions.reclaim import find_task, solve_claims
 from kube_batch_tpu.api.task_info import TaskInfo
 from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import FitFailure
 from kube_batch_tpu.utils.priority_queue import PriorityQueue
 
+logger = logging.getLogger("kube_batch_tpu")
+
 
 class PreemptAction(Action):
     name = "preempt"
 
     def execute(self, ssn) -> None:
-        preemptors_map = {}
-        preemptor_tasks = {}
-        under_request = []
-        queues = {}
+        self._phase1(ssn)
+        self._phase2(ssn)
 
+    # ---- phase 1: inter-job within queue (device-solved) ---------------
+    def _phase1(self, ssn) -> None:
+        claims, _ = solve_claims(ssn, "preempt")
+        # group claims by preemptor job — the Statement boundary
+        by_job: Dict[str, List[Tuple[TaskInfo, str, List[tuple]]]] = defaultdict(list)
+        for claimant_ref, node_name, victim_refs in claims:
+            task = find_task(ssn, claimant_ref)
+            if task is not None and victim_refs:
+                by_job[task.job].append((task, node_name, victim_refs))
+
+        for job_uid, job_claims in by_job.items():
+            job = ssn.jobs.get(job_uid)
+            if job is None:
+                continue
+            stmt = ssn.statement()
+            for task, node_name, victim_refs in job_claims:
+                # host predicate re-check (preempt.go:191): device mask is a
+                # sound approximation of the full predicate set
+                node = ssn.nodes.get(node_name)
+                try:
+                    if node is not None:
+                        ssn.predicate(task, node)
+                except FitFailure:
+                    continue
+                preemptees = [
+                    v.clone() for v in (find_task(ssn, r) for r in victim_refs)
+                    if v is not None and v.status == TaskStatus.RUNNING
+                ]
+                victims = ssn.preemptable(task, preemptees)
+                if not victims:
+                    continue
+                total = ssn.spec.empty()
+                for v in victims:
+                    total.add_(v.resreq)
+                if not task.init_resreq.less_equal(total):
+                    continue  # victims must cover every dimension
+                # evict lowest-task-order first (preempt.go:219-237)
+                vq = PriorityQueue(less=lambda l, r: not ssn.task_order_fn(l, r))
+                for v in victims:
+                    vq.push(v)
+                preempted = ssn.spec.empty()
+                while vq:
+                    victim = vq.pop()
+                    stmt.evict(victim, "preempt")
+                    preempted.add_(victim.resreq)
+                    if task.init_resreq.less_equal(preempted):
+                        break
+                stmt.pipeline(task, node_name)
+            if ssn.job_pipelined(job):
+                stmt.commit()
+            else:
+                stmt.discard()
+
+    # ---- phase 2: intra-job (host, guarded) ----------------------------
+    def _phase2(self, ssn) -> None:
         for job in ssn.jobs.values():
+            # claimant gates (preempt.go:59-63): enqueued jobs in known queues
             if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
                 continue
-            if ssn.job_valid(job) is not None:
+            if job.queue not in ssn.queues:
                 continue
-            queue = ssn.queues.get(job.queue)
-            if queue is None:
-                continue
-            queues[queue.name] = queue
             pending = job.task_status_index.get(TaskStatus.PENDING, {})
-            if pending:
-                preemptors_map.setdefault(
-                    job.queue, PriorityQueue(less=ssn.job_order_fn)
-                ).push(job)
-                under_request.append(job)
-                tq = PriorityQueue(less=ssn.task_order_fn)
-                for task in pending.values():
-                    tq.push(task)
-                preemptor_tasks[job.uid] = tq
+            running = job.task_status_index.get(TaskStatus.RUNNING, {})
+            if not pending or not running:
+                continue
+            if max(t.priority for t in pending.values()) <= min(
+                t.priority for t in running.values()
+            ):
+                continue  # nothing to rebalance
+            tq = PriorityQueue(less=ssn.task_order_fn)
+            for task in pending.values():
+                tq.push(task)
+            while tq:
+                preemptor = tq.pop()
 
-        for queue in queues.values():
-            # Phase 1: inter-job within queue
-            preemptors = preemptors_map.get(queue.name)
-            while preemptors:
-                preemptor_job = preemptors.pop()
+                def intra_job_filter(task: TaskInfo) -> bool:
+                    return (
+                        task.status == TaskStatus.RUNNING
+                        and preemptor.job == task.job
+                    )
+
                 stmt = ssn.statement()
-                assigned = False
-                while preemptor_tasks[preemptor_job.uid]:
-                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+                assigned = self._preempt_host(ssn, stmt, preemptor, intra_job_filter)
+                stmt.commit()  # phase 2 commits unconditionally (preempt.go:168)
+                if not assigned:
+                    break
 
-                    def inter_job_filter(task: TaskInfo) -> bool:
-                        if task.status != TaskStatus.RUNNING:
-                            return False
-                        job = ssn.jobs.get(task.job)
-                        if job is None:
-                            return False
-                        return job.queue == preemptor_job.queue and preemptor.job != task.job
-
-                    if self._preempt(ssn, stmt, preemptor, inter_job_filter):
-                        assigned = True
-                    if ssn.job_pipelined(preemptor_job):
-                        break
-                if ssn.job_pipelined(preemptor_job):
-                    stmt.commit()
-                    if assigned:
-                        preemptors.push(preemptor_job)
-                else:
-                    stmt.discard()
-
-            # Phase 2: intra-job task-priority preemption
-            for job in under_request:
-                tq = preemptor_tasks.get(job.uid)
-                while tq:
-                    preemptor = tq.pop()
-
-                    def intra_job_filter(task: TaskInfo) -> bool:
-                        return task.status == TaskStatus.RUNNING and preemptor.job == task.job
-
-                    stmt = ssn.statement()
-                    assigned = self._preempt(ssn, stmt, preemptor, intra_job_filter)
-                    stmt.commit()
-                    if not assigned:
-                        break
-
-    def _preempt(
+    def _preempt_host(
         self,
         ssn,
         stmt,
         preemptor: TaskInfo,
         victim_filter: Callable[[TaskInfo], bool],
     ) -> bool:
-        """(preempt.go:180-260)"""
-        # predicate + score + sort nodes descending
+        """Sequential preemption for one task (preempt.go:180-260)."""
         candidates = []
         for node in ssn.nodes.values():
             try:
@@ -120,9 +146,8 @@ class PreemptAction(Action):
             total = ssn.spec.empty()
             for v in victims:
                 total.add_(v.resreq)
-            if total.less(preemptor.init_resreq):
-                continue  # not enough even with every victim
-            # evict lowest-task-order first (victimsQueue uses !TaskOrderFn)
+            if not preemptor.init_resreq.less_equal(total):
+                continue  # victims must cover every dimension
             vq = PriorityQueue(less=lambda l, r: not ssn.task_order_fn(l, r))
             for v in victims:
                 vq.push(v)

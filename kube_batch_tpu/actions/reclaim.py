@@ -1,92 +1,160 @@
-"""reclaim action (actions/reclaim/reclaim.go) — cross-queue eviction.
+"""reclaim action (actions/reclaim/reclaim.go) — cross-queue eviction,
+device-solved.
 
-For each non-overused queue in order: pop job/task with Pending tasks, scan
-nodes; collect Running tasks *from other queues* as reclaimees, ask
-ssn.Reclaimable (proportion: victim's queue must stay ≥ deserved; gang:
-victim's gang must survive), evict immediately (no Statement) until the
-request is covered, then Pipeline the reclaimer (reclaim.go:107-199)."""
+The reference scans every node per starved task serially (reclaim.go:107-199).
+Here ops/eviction.evict_solve proposes (claimant → node, victims) on device;
+the host replays each claim through the real plugin callbacks
+(ssn.reclaimable tier-intersection) so semantics stay authoritative: victims
+are evicted (immediately — reclaim holds no Statement, reclaim.go:166-179)
+only when the validated set still covers the claimant, then the claimant
+pipelines onto the freed resources."""
 
 from __future__ import annotations
 
-from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+import logging
+from collections import defaultdict
+from typing import Dict, List
+
+import numpy as np
+
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.snapshot import build_snapshot
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import FitFailure
-from kube_batch_tpu.utils.priority_queue import PriorityQueue
+from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+def _cluster_view(ssn) -> ClusterInfo:
+    """Session → ClusterInfo. ALL jobs are included — the Pending-phase gate
+    (reclaim.go:58-62 / preempt.go:59-63) applies to claimants only, via the
+    snapshot's job_schedulable flag; Pending-phase jobs' Running tasks remain
+    in the victim pool and their allocations in the fairness state."""
+    cluster = ClusterInfo(ssn.spec)
+    cluster.nodes = ssn.nodes
+    cluster.queues = ssn.queues
+    cluster.jobs = ssn.jobs
+    return cluster
+
+
+# plugins registering each Evictable fn kind (SURVEY.md §2.4)
+_VICTIM_REGISTRANTS = {
+    "reclaim": ("gang", "conformance", "proportion"),
+    "preempt": ("gang", "conformance", "drf"),
+}
+
+
+def victim_gates(ssn, mode: str):
+    """The set of plugins whose victim veto binds: the reference's Evictable
+    dispatch takes the FIRST tier with any voting plugin
+    (session_plugins.go:100-182) — later tiers never constrain victims."""
+    registrants = _VICTIM_REGISTRANTS[mode]
+    flag = "enabled_reclaimable" if mode == "reclaim" else "enabled_preemptable"
+    for tier in ssn.tiers:
+        voters = {
+            opt.name
+            for opt in tier.plugins
+            if opt.name in registrants and getattr(opt, flag)
+        }
+        if voters:
+            return voters
+    return set()
+
+
+def solve_claims(ssn, mode: str):
+    """Run the eviction solve and decode to [(claimant_key, node_name,
+    [victim_keys...])] in device claim order."""
+    cluster = _cluster_view(ssn)
+    if not cluster.jobs or not cluster.nodes:
+        return [], None
+    snap, meta = build_snapshot(cluster)
+    gates = victim_gates(ssn, mode)
+    config = EvictConfig(
+        mode=mode,
+        gang=ssn.plugin_enabled("gang"),
+        drf=ssn.plugin_enabled("drf"),
+        proportion=ssn.plugin_enabled("proportion"),
+        victim_gang="gang" in gates,
+        victim_conformance="conformance" in gates,
+        victim_proportion="proportion" in gates,
+        victim_drf="drf" in gates,
+        weights=ssn.score_weights,
+    )
+    result = evict_solve(snap, config)
+    claim_node = np.asarray(result.claim_node)[: meta.n_tasks]
+    evicted = np.asarray(result.evicted)[: meta.n_tasks]
+    victim_claimant = np.asarray(result.victim_claimant)[: meta.n_tasks]
+
+    task_job = np.asarray(snap.task_job)[: meta.n_tasks]
+
+    def ref(ti: int):
+        return (meta.job_uids[int(task_job[ti])], meta.task_keys[int(ti)])
+
+    victims_by_claim: Dict[int, List[tuple]] = defaultdict(list)
+    for vi in np.flatnonzero(evicted):
+        ci = int(victim_claimant[vi])
+        if ci >= 0:
+            victims_by_claim[ci].append(ref(vi))
+    claims = []
+    for ti in np.flatnonzero(claim_node >= 0):
+        claims.append(
+            (ref(ti), meta.node_names[int(claim_node[ti])],
+             victims_by_claim.get(int(ti), []))
+        )
+    return claims, meta
+
+
+def find_task(ssn, ref: tuple):
+    """(job_uid, task_key) → session TaskInfo, O(1)."""
+    job = ssn.jobs.get(ref[0])
+    return job.tasks.get(ref[1]) if job is not None else None
 
 
 class ReclaimAction(Action):
     name = "reclaim"
 
     def execute(self, ssn) -> None:
-        queues = PriorityQueue(less=ssn.queue_order_fn)
-        queue_set = set()
-        preemptors_map = {}
-        preemptor_tasks = {}
-
-        for job in ssn.jobs.values():
-            if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
+        claims, _ = solve_claims(ssn, "reclaim")
+        for claimant_ref, node_name, victim_refs in claims:
+            task = find_task(ssn, claimant_ref)
+            if task is None or not victim_refs:
                 continue
-            if ssn.job_valid(job) is not None:
-                continue
-            queue = ssn.queues.get(job.queue)
-            if queue is None:
-                continue
-            if queue.name not in queue_set:
-                queue_set.add(queue.name)
-                queues.push(queue)
-            pending = job.task_status_index.get(TaskStatus.PENDING, {})
-            if pending:
-                preemptors_map.setdefault(
-                    job.queue, PriorityQueue(less=ssn.job_order_fn)
-                ).push(job)
-                tq = PriorityQueue(less=ssn.task_order_fn)
-                for task in pending.values():
-                    tq.push(task)
-                preemptor_tasks[job.uid] = tq
-
-        while queues:
-            queue = queues.pop()
-            if ssn.overused(queue):
-                continue
-            jobs = preemptors_map.get(queue.name)
-            if not jobs:
-                continue
-            job = jobs.pop()
-            tasks = preemptor_tasks.get(job.uid)
-            if not tasks:
-                continue
-            task = tasks.pop()
-
-            assigned = False
-            for node in ssn.nodes.values():
-                try:
+            # host predicate re-check (reclaim.go:124): the device mask is a
+            # sound approximation — rich affinity / host ports are host-only
+            node = ssn.nodes.get(node_name)
+            try:
+                if node is not None:
                     ssn.predicate(task, node)
-                except FitFailure:
-                    continue
-                reclaimees = []
-                for t in node.tasks.values():
-                    if t.status != TaskStatus.RUNNING:
-                        continue
-                    j = ssn.jobs.get(t.job)
-                    if j is not None and j.queue != job.queue:
-                        reclaimees.append(t.clone())
-                victims = ssn.reclaimable(task, reclaimees)
-                if not victims:
-                    continue
-                total = ssn.spec.empty()
-                for v in victims:
-                    total.add_(v.resreq)
-                if total.less(task.init_resreq):
-                    continue
-                reclaimed = ssn.spec.empty()
-                for victim in victims:
-                    ssn.evict(victim, "reclaim")
-                    reclaimed.add_(victim.resreq)
-                    if task.init_resreq.less_equal(reclaimed):
-                        break
+            except FitFailure as e:
+                logger.info("reclaim claim %s→%s rejected by host predicate: %s",
+                            claimant_ref, node_name, e.reason)
+                continue
+            preemptees = [
+                v.clone() for v in (find_task(ssn, r) for r in victim_refs)
+                if v is not None
+            ]
+            # host validation net: the real tier-intersected verdict
+            # (proportion deserved, gang survival, conformance) on the
+            # device-selected set only — O(claims), not O(T × N)
+            victims = ssn.reclaimable(task, preemptees)
+            if not victims:
+                continue
+            total = ssn.spec.empty()
+            for v in victims:
+                total.add_(v.resreq)
+            # sufficiency: victims must cover the claimant in EVERY dimension
+            # (reclaim.go:150-163) — checked before any eviction happens
+            if not task.init_resreq.less_equal(total):
+                logger.info(
+                    "reclaim claim %s→%s lost victims to host validation, skipped",
+                    claimant_ref, node_name,
+                )
+                continue
+            reclaimed = ssn.spec.empty()
+            for victim in victims:  # immediate evict, no Statement
+                ssn.evict(victim, "reclaim")
+                reclaimed.add_(victim.resreq)
                 if task.init_resreq.less_equal(reclaimed):
-                    ssn.pipeline(task, node.name)
-                    assigned = True
                     break
-            if assigned:
-                queues.push(queue)
+            ssn.pipeline(task, node_name)

@@ -28,7 +28,13 @@ import numpy as np
 
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.resources import ResourceSpec
-from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus, is_allocated
+from kube_batch_tpu.api.types import (
+    CRITICAL_NAMESPACE,
+    CRITICAL_PRIORITY_CLASSES,
+    PodGroupPhase,
+    TaskStatus,
+    is_allocated,
+)
 
 BITS = 32
 # Effects that hard-exclude a node (PreferNoSchedule is a soft preference the
@@ -66,6 +72,9 @@ class DeviceSnapshot(NamedTuple):
     task_sel_bits: "np.ndarray"     # [T, W] u32 — required label bits
     task_sel_impossible: "np.ndarray"  # [T] bool — selector wants a pair no node has
     task_tol_bits: "np.ndarray"     # [T, Wt] u32 — tolerated taint bits
+    task_node: "np.ndarray"         # [T] i32 — bound node index, -1 unbound
+    task_critical: "np.ndarray"     # [T] bool — conformance-protected
+    #                                 (conformance.go:42-59)
     # nodes [N, ...]
     node_idle: "np.ndarray"         # [N, R] f32
     node_releasing: "np.ndarray"    # [N, R] f32
@@ -183,6 +192,8 @@ def build_snapshot(
     task_sel_bits = np.zeros((T, W), np.uint32)
     task_sel_impossible = np.zeros(T, bool)
     task_tol_bits = np.zeros((T, Wt), np.uint32)
+    task_node = np.full(T, -1, np.int32)
+    task_critical = np.zeros(T, bool)
     task_keys: List[str] = []
 
     taint_list = list(taint_bit.items())  # [((k,v,effect), bit)]
@@ -197,6 +208,12 @@ def build_snapshot(
         task_valid[i] = True
         task_best_effort[i] = t.best_effort
         task_pending[i] = t.status == TaskStatus.PENDING and not t.best_effort
+        if t.node_name is not None:
+            task_node[i] = node_idx.get(t.node_name, -1)
+        task_critical[i] = (
+            t.pod.priority_class in CRITICAL_PRIORITY_CLASSES
+            or t.namespace == CRITICAL_NAMESPACE
+        )
         # required label pairs → bits: node-selector terms (MatchNodeSelector,
         # predicates.go:194-205) plus single-term node-affinity whose
         # In-requirements carry one value (necessary AND sufficient for that
@@ -322,6 +339,8 @@ def build_snapshot(
         task_sel_bits=task_sel_bits,
         task_sel_impossible=task_sel_impossible,
         task_tol_bits=task_tol_bits,
+        task_node=task_node,
+        task_critical=task_critical,
         node_idle=node_idle,
         node_releasing=node_releasing,
         node_used=node_used,

@@ -66,6 +66,12 @@ def pod_phase_to_status(phase: "PodPhase", node_name: str | None, deleting: bool
     return TaskStatus.UNKNOWN
 
 
+# conformance's critical-pod rule (conformance.go:42-59) — shared by the
+# host plugin and the device snapshot's task_critical bit
+CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+CRITICAL_NAMESPACE = "kube-system"
+
+
 class PodGroupPhase(str, enum.Enum):
     """PodGroup lifecycle (apis/scheduling/v1alpha1/types.go:28-43)."""
 

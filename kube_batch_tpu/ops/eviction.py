@@ -1,0 +1,366 @@
+"""Device-resident eviction solve — reclaim + preempt as compiled auctions.
+
+The reference's reclaim (actions/reclaim/reclaim.go:107-199) and preempt
+phase 1 (actions/preempt/preempt.go:110-137,180-260) are host loops:
+per pending "claimant" task, scan every node, collect Running victims passing
+the tier-intersected Evictable verdicts (conformance ∩ gang ∩ drf/proportion,
+session_plugins.go:100-182), evict until the claimant's request is covered,
+then pipeline the claimant onto the freed (Releasing) resources.
+
+Here both run as bidding rounds on device, sharing one kernel:
+
+  round:  eligible claimants bid for their best feasible node, where
+          "feasible" means the node carries enough evictable victim resource
+          for the claimant's queue (cross-queue victims for reclaim,
+          same-queue/other-job for preempt). One claimant — the lowest
+          virtual-rank bidder — wins each node per round (evictions are far
+          sparser than allocations, so per-round node exclusivity costs
+          little wall-clock and keeps victim accounting exact).
+  pick:   per node, victims are taken in reverse task order (the reference's
+          victimsQueue pops !TaskOrderFn, preempt.go:219-224) until the
+          winner's InitResreq is covered — a segmented prefix scan.
+  caps:   global constraints are then enforced exactly: gang slack (a job
+          never drops below MinAvailable, gang.go:71-94), proportion queue
+          budget (a victim queue never drops below deserved,
+          proportion.go:171-196), and DRF share dominance for preempt
+          (drf.go:85-110). Victims dropped by a cap can break a claim's
+          coverage; such claims cancel entirely — evictions never happen
+          without a covered placement (reclaim.go:150-163 validates victim
+          sufficiency before evicting).
+
+The host action replays the result through session verbs, re-validating each
+claim with the real plugin callbacks on the (small) selected sets — the
+device narrows O(tasks × nodes × victims) to O(claims), the host stays
+authoritative for semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kube_batch_tpu.api.snapshot import DeviceSnapshot
+from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.ops import fairness, ordering
+from kube_batch_tpu.ops.assignment import _best_node, _tie_break_hash
+from kube_batch_tpu.ops.feasibility import fits, static_predicates
+from kube_batch_tpu.ops.ordering import segmented_prefix
+from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
+
+NEG = jnp.float32(-3.0e38)
+BIG = jnp.int32(1 << 30)
+SHARE_DELTA = 1e-6  # drf.go:23 shareDelta
+
+
+class EvictConfig(NamedTuple):
+    """Static eviction-solve configuration (jit cache key).
+
+    Victim gates mirror the reference's TIERED Evictable dispatch
+    (session_plugins.go:100-182): only plugins in the first tier containing
+    any voting plugin constrain victims — under the default two-tier conf
+    (gang+conformance in tier 1, drf/proportion in tier 2) the drf/proportion
+    victim vetoes never bind. Ordering flags are independent: they shape the
+    claimant rank / overused gate / commit gate like the allocate solve."""
+
+    mode: str = "reclaim"     # "reclaim" (cross-queue) | "preempt" (same-queue)
+    rounds: int = 8
+    # ordering / gating (claimant side)
+    gang: bool = True
+    drf: bool = True
+    proportion: bool = True
+    # victim gates (first voting tier only)
+    victim_gang: bool = True
+    victim_conformance: bool = True
+    victim_proportion: bool = False
+    victim_drf: bool = False
+    weights: ScoreWeights = ScoreWeights()
+
+
+class EvictResult(NamedTuple):
+    claim_node: jnp.ndarray       # [T] i32 — node the claimant pipelines onto, -1
+    evicted: jnp.ndarray          # [T] bool — task chosen as victim
+    victim_claimant: jnp.ndarray  # [T] i32 — claimant task index a victim serves, -1
+
+
+@partial(jax.jit, static_argnames=("config",))
+def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
+    T, R = snap.task_req.shape
+    N = snap.node_alloc.shape[0]
+    J = snap.job_min_avail.shape[0]
+    Q = snap.queue_weight.shape[0]
+    preempt = config.mode == "preempt"
+
+    task_queue = snap.job_queue[snap.task_job]                      # [T]
+    running = (
+        snap.task_valid
+        & (snap.task_status == int(TaskStatus.RUNNING))
+        & (snap.task_node >= 0)
+    )
+    static_ok = static_predicates(snap)
+    score = score_matrix(snap, config.weights)
+    tie_hash = _tie_break_hash(T, N)
+    subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
+    # victims pop in reverse task order (!TaskOrderFn, preempt.go:219-224)
+    victim_rank = ordering.multisort_ranks([snap.task_prio, -snap.task_creation])
+
+    deserved = fairness.proportion_deserved(
+        snap.total, snap.queue_weight, snap.queue_request, snap.queue_valid
+    )
+    # gang slack: evictions a job can absorb while staying ≥ MinAvailable;
+    # MinAvailable ≤ 1 jobs are not gangs — always evictable (gang.go:71-94)
+    if config.victim_gang:
+        slack0 = jnp.where(
+            snap.job_min_avail > 1, snap.job_ready - snap.job_min_avail, BIG
+        )
+    else:
+        slack0 = jnp.full(J, BIG)
+    # proportion budget: resource a queue can lose while staying ≥ deserved
+    qbudget0 = jnp.maximum(snap.queue_alloc - deserved, 0.0)        # [Q, R]
+
+    claimant_base = (
+        snap.task_pending
+        & snap.task_valid
+        & snap.job_valid[snap.task_job]
+        & snap.job_schedulable[snap.task_job]
+    )
+
+    def round_body(state):
+        claim_node, evicted, victim_claimant, i, _ = state
+        placed = claim_node >= 0
+
+        # ---- live fairness state -------------------------------------
+        placed_req = jnp.where(placed[:, None], snap.task_resreq, 0.0)
+        evicted_req = jnp.where(evicted[:, None], snap.task_resreq, 0.0)
+        job_delta = jax.ops.segment_sum(
+            placed_req - evicted_req, snap.task_job, num_segments=J
+        )
+        job_alloc_now = snap.job_allocated + job_delta
+        queue_alloc_now = snap.queue_alloc + jax.ops.segment_sum(
+            job_delta, snap.job_queue, num_segments=Q
+        )
+        evict_cnt = jax.ops.segment_sum(
+            evicted.astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        slack_rem = slack0 - evict_cnt                               # [J]
+        q_evicted = jax.ops.segment_sum(
+            evicted_req, task_queue, num_segments=Q
+        )
+        qbudget_rem = qbudget0 - q_evicted                           # [Q, R]
+        pipe_cnt = jax.ops.segment_sum(
+            placed.astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        job_pipelined_now = (snap.job_ready + pipe_cnt) >= snap.job_min_avail
+        job_need = jnp.maximum(
+            snap.job_min_avail - (snap.job_ready + pipe_cnt), 0
+        )
+
+        # ---- victim eligibility --------------------------------------
+        victim_ok = running & ~evicted
+        if config.victim_conformance:
+            victim_ok &= ~snap.task_critical
+        if config.victim_gang:
+            victim_ok &= slack_rem[snap.task_job] > 0
+        if config.victim_proportion and not preempt:
+            # victim's full resreq must fit its queue's remaining budget
+            victim_ok &= jnp.all(
+                snap.task_resreq <= qbudget_rem[task_queue] + snap.quanta, axis=-1
+            )
+        if preempt and config.victim_drf:
+            # victim-job share after eviction must stay ≥ some preemptor's
+            # share; the exact pairwise test happens at selection time —
+            # here only the per-victim post-eviction share is prepared
+            victim_post_share = fairness.dominant_share(
+                job_alloc_now[snap.task_job] - snap.task_resreq, snap.total
+            )
+        else:
+            victim_post_share = jnp.zeros(T, jnp.float32)
+
+        # ---- claimant eligibility + rank -----------------------------
+        claimant_ok = claimant_base & ~placed
+        if config.proportion and not preempt:
+            # reclaim skips overused claimant queues (reclaim.go:112-116)
+            q_overused = fairness.overused(deserved, queue_alloc_now, snap.quanta)
+            claimant_ok &= ~q_overused[task_queue]
+        rank = ordering.virtual_task_ranks(
+            claimant_ok,
+            snap.task_resreq,
+            snap.task_job,
+            task_queue,
+            subrank,
+            snap.job_prio,
+            job_pipelined_now,
+            snap.job_creation,
+            job_alloc_now,
+            queue_alloc_now,
+            deserved,
+            snap.total,
+            job_need,
+            gang_enabled=config.gang,
+            drf_enabled=config.drf,
+            proportion_enabled=config.proportion,
+        )
+
+        # ---- per-(queue, node) evictable capacity --------------------
+        vreq = jnp.where(victim_ok[:, None], snap.task_resreq, 0.0)
+        vnode = jnp.where(victim_ok, snap.task_node, N)
+        tot_v = jax.ops.segment_sum(vreq, vnode, num_segments=N + 1)[:N]  # [N, R]
+        per_qn = jnp.zeros((Q, N, R), jnp.float32).at[
+            task_queue, jnp.clip(snap.task_node, 0, N - 1)
+        ].add(vreq)
+        if preempt:
+            cap = per_qn                      # same-queue victims (own job
+            #                                   over-counted; corrected below)
+        else:
+            cap = tot_v[None] - per_qn        # cross-queue victims
+
+        # ---- bids ----------------------------------------------------
+        # feasible[t, n] iff claimant t's InitResreq fits cap[queue_t, n]
+        feas = jnp.zeros((T, N), bool)
+        for q in range(Q):  # Q is a small static bucket
+            feas |= (task_queue == q)[:, None] & fits(
+                snap.task_req, cap[q], snap.quanta
+            )
+        feas &= static_ok & claimant_ok[:, None]
+        masked = jnp.where(feas, score, NEG)
+        # tie-hash spread: without it every equal-score claimant bids the
+        # same argmax node and only one claim lands per round
+        best, has = _best_node(masked, tie_hash)
+        has &= claimant_ok
+
+        # ---- one winner per node: lowest claimant rank ---------------
+        bid_node = jnp.where(has, best, N)
+        win_rank = (
+            jnp.full(N + 1, BIG, jnp.int32).at[bid_node].min(jnp.where(has, rank, BIG))
+        )[:N]
+        is_winner = has & (rank == win_rank[jnp.clip(best, 0, N - 1)])
+        winner_task = (
+            jnp.full(N, -1, jnp.int32)
+            .at[jnp.where(is_winner, best, 0)]
+            .max(jnp.where(is_winner, jnp.arange(T, dtype=jnp.int32), -1))
+        )
+        node_has_claim = winner_task >= 0
+        node_req = jnp.where(
+            node_has_claim[:, None], snap.task_req[jnp.maximum(winner_task, 0)], jnp.inf
+        )                                                            # [N, R]
+        winner_job = jnp.where(
+            node_has_claim, snap.task_job[jnp.maximum(winner_task, 0)], -1
+        )                                                            # [N]
+        winner_queue = jnp.where(
+            node_has_claim, task_queue[jnp.maximum(winner_task, 0)], -1
+        )
+        if preempt and config.victim_drf:
+            winner_post_share = fairness.dominant_share(
+                job_alloc_now[jnp.maximum(winner_job, 0)]
+                + snap.task_resreq[jnp.maximum(winner_task, 0)],
+                snap.total,
+            )                                                        # [N]
+
+        # ---- victim selection per node (reverse task order) ----------
+        vn = jnp.clip(snap.task_node, 0, N - 1)
+        vmask = victim_ok & node_has_claim[vn]
+        if preempt:
+            # same queue, different job (preempt.go:113-121)
+            vmask &= (task_queue == winner_queue[vn]) & (snap.task_job != winner_job[vn])
+            if config.victim_drf:
+                # preemptor's post-allocation share must stay ≤ victim's
+                # post-eviction share (drf.go:85-110)
+                vmask &= winner_post_share[vn] <= victim_post_share + SHARE_DELTA
+        else:
+            vmask &= task_queue != winner_queue[vn]                  # cross-queue
+
+        seg = jnp.where(vmask, snap.task_node, N)
+        order = ordering.sort_by_segment_then_rank(seg, victim_rank, N + 1)
+        seg_s = seg[order]
+        req_s = jnp.where(vmask[order, None], snap.task_resreq[order], 0.0)
+        is_start = jnp.concatenate([jnp.array([True]), seg_s[1:] != seg_s[:-1]])
+        prefix = segmented_prefix(req_s, is_start)                   # exclusive
+        need_s = node_req[jnp.clip(seg_s, 0, N - 1)]
+        covered_before = jnp.all(prefix >= need_s - snap.quanta, axis=-1)
+        take_s = vmask[order] & (seg_s < N) & ~covered_before
+        take = jnp.zeros(T, bool).at[order].set(take_s)
+
+        # ---- exact global caps ---------------------------------------
+        if config.victim_gang:
+            # position among taken victims of the same job < remaining slack
+            jorder = ordering.sort_by_segment_then_rank(
+                jnp.where(take, snap.task_job, J), victim_rank, J + 1
+            )
+            js = jnp.where(take, snap.task_job, J)[jorder]
+            j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
+            pos = segmented_prefix(
+                take[jorder].astype(jnp.float32)[:, None], j_start
+            )[:, 0].astype(jnp.int32)
+            keep_j = take[jorder] & (pos < slack_rem[jnp.clip(js, 0, J - 1)])
+            take = jnp.zeros(T, bool).at[jorder].set(keep_j)
+        if config.victim_proportion and not preempt:
+            # cumulative eviction per victim queue ≤ remaining budget
+            qorder = ordering.sort_by_segment_then_rank(
+                jnp.where(take, task_queue, Q), victim_rank, Q + 1
+            )
+            qs = jnp.where(take, task_queue, Q)[qorder]
+            q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
+            qreq_s = jnp.where(take[qorder, None], snap.task_resreq[qorder], 0.0)
+            qprefix = segmented_prefix(qreq_s, q_start)
+            fits_budget = jnp.all(
+                qprefix + qreq_s
+                <= qbudget_rem[jnp.clip(qs, 0, Q - 1)] + snap.quanta,
+                axis=-1,
+            )
+            take = jnp.zeros(T, bool).at[qorder].set(take[qorder] & fits_budget)
+
+        # ---- coverage recheck after caps; cancel uncovered claims ----
+        got = jax.ops.segment_sum(
+            jnp.where(take[:, None], snap.task_resreq, 0.0),
+            jnp.where(take, snap.task_node, N),
+            num_segments=N + 1,
+        )[:N]
+        covered = node_has_claim & jnp.all(got >= node_req - snap.quanta, axis=-1)
+        final_take = take & covered[vn]
+
+        # ---- apply ---------------------------------------------------
+        new_claim = is_winner & covered[jnp.clip(best, 0, N - 1)]
+        claim_node = jnp.where(new_claim, best, claim_node)
+        evicted = evicted | final_take
+        victim_claimant = jnp.where(
+            final_take, winner_task[vn], victim_claimant
+        )
+        return (claim_node, evicted, victim_claimant, i + 1, jnp.any(new_claim))
+
+    def round_cond(state):
+        *_, i, progress = state
+        return (i < config.rounds) & progress
+
+    claim_node, evicted, victim_claimant, _, _ = jax.lax.while_loop(
+        round_cond,
+        round_body,
+        (
+            jnp.full(T, -1, jnp.int32),
+            jnp.zeros(T, bool),
+            jnp.full(T, -1, jnp.int32),
+            jnp.int32(0),
+            jnp.bool_(True),
+        ),
+    )
+
+    if preempt and config.gang:
+        # commit gate: the preemptor job must reach Pipelined
+        # (ready + pipelined ≥ MinAvailable, preempt.go:127-137); claims of
+        # failing jobs revert, and their victims un-evict (Statement.Discard)
+        pipe_cnt = jax.ops.segment_sum(
+            (claim_node >= 0).astype(jnp.int32), snap.task_job, num_segments=J
+        )
+        job_ok = (snap.job_ready + pipe_cnt) >= snap.job_min_avail
+        revert = (claim_node >= 0) & ~job_ok[snap.task_job]
+        claim_node = jnp.where(revert, -1, claim_node)
+        victim_revert = (victim_claimant >= 0) & revert[
+            jnp.clip(victim_claimant, 0, T - 1)
+        ]
+        evicted &= ~victim_revert
+        victim_claimant = jnp.where(victim_revert, -1, victim_claimant)
+
+    return EvictResult(
+        claim_node=claim_node, evicted=evicted, victim_claimant=victim_claimant
+    )

@@ -60,6 +60,8 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         task_sel_bits=repl,
         task_sel_impossible=repl,
         task_tol_bits=repl,
+        task_node=repl,
+        task_critical=repl,
         node_idle=node2,
         node_releasing=node2,
         node_used=node2,

@@ -7,10 +7,9 @@ from __future__ import annotations
 from typing import List
 
 from kube_batch_tpu.api.task_info import TaskInfo
+from kube_batch_tpu.api.types import CRITICAL_NAMESPACE, CRITICAL_PRIORITY_CLASSES
 from kube_batch_tpu.framework.interface import Plugin
 from kube_batch_tpu.framework import session as fw
-
-CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
 
 
 class ConformancePlugin(Plugin):
@@ -22,7 +21,7 @@ class ConformancePlugin(Plugin):
             for ee in evictees:
                 if (
                     ee.pod.priority_class in CRITICAL_PRIORITY_CLASSES
-                    or ee.namespace == "kube-system"
+                    or ee.namespace == CRITICAL_NAMESPACE
                 ):
                     continue
                 victims.append(ee)

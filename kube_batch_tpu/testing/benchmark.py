@@ -95,6 +95,13 @@ def _overcommit_case(name, n_running=800, n_pending=400, n_nodes=100):
 
         conf = load_scheduler_conf(None)
         conf.actions = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+        # warmup: compile the reclaim/preempt/allocate solves at these shapes
+        Scheduler(
+            synthetic_overcommit_cluster(
+                n_running=n_running, n_pending=n_pending, n_nodes=n_nodes
+            ),
+            conf=conf,
+        ).run_once()
         times = []
         evicted = placed = 0
         for _ in range(cycles):

@@ -10,6 +10,8 @@ import os
 
 # Force-override: the image exports JAX_PLATFORMS=axon (the real-TPU tunnel);
 # tests must run on the virtual 8-device CPU backend deterministically.
+# If the axon tunnel is wedged (backend init hangs at import), run pytest with
+# PALLAS_AXON_POOL_IPS= (empty) so sitecustomize skips axon registration.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
