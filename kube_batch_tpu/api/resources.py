@@ -24,7 +24,10 @@ from typing import Dict, Iterable, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kube_batch_tpu.native import fast as _native
 from kube_batch_tpu.utils.assertions import graft_assert
+
+_LIB = _native.resource_lib  # None → numpy fallback (semantics identical)
 
 # Minimum comparison quanta, resource_info.go:66-72.
 MIN_MILLI_CPU = 10.0
@@ -57,7 +60,8 @@ class ResourceSpec:
         self._index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
         quanta = [MIN_MILLI_CPU, MIN_MEMORY, MIN_PODS]
         quanta += [MIN_MILLI_SCALAR] * len(scalar_names)
-        self.quanta: np.ndarray = np.asarray(quanta, dtype=np.float64)
+        self.quanta: np.ndarray = np.ascontiguousarray(quanta, dtype=np.float64)
+        self._quanta_addr = self.quanta.ctypes.data
         # "pods" is a capacity-only dimension we add on top of the reference's
         # model (its MaxTaskNum field); it participates in fit arithmetic
         # (add/sub/less_equal) but not in the semantic comparisons the
@@ -65,6 +69,7 @@ class ResourceSpec:
         # Share), where an always-equal dimension would change the answer.
         self.semantic_mask: np.ndarray = np.ones(len(names), dtype=bool)
         self.semantic_mask[2] = False
+        self._mask_addr = self.semantic_mask.ctypes.data
 
     @property
     def n(self) -> int:
@@ -84,6 +89,10 @@ class ResourceSpec:
 
     def __repr__(self) -> str:
         return f"ResourceSpec({self.names})"
+
+    def __reduce__(self):
+        # rebuild through __init__ so cached buffer addresses are fresh
+        return (ResourceSpec, (self.names[3:],))
 
     # -- constructors -----------------------------------------------------
     def empty(self) -> "Resource":
@@ -130,11 +139,27 @@ class Resource:
     receivers.
     """
 
-    __slots__ = ("vec", "spec")
+    __slots__ = ("_vec", "spec", "_addr")
 
     def __init__(self, vec: np.ndarray, spec: ResourceSpec):
-        self.vec = np.asarray(vec, dtype=np.float64)
+        self.vec = vec
         self.spec = spec
+
+    @property
+    def vec(self) -> np.ndarray:
+        return self._vec
+
+    @vec.setter
+    def vec(self, value) -> None:
+        # contiguous float64 — the native fast path reads the raw buffer via
+        # the cached address, which this setter keeps in sync on rebinding
+        self._vec = np.ascontiguousarray(value, dtype=np.float64)
+        self._addr = self._vec.ctypes.data
+
+    def __reduce__(self):
+        # pickle/deepcopy rebuild through __init__ so _addr points at the
+        # new process/copy's buffer, never the original's
+        return (Resource, (self._vec.copy(), self.spec))
 
     # -- accessors --------------------------------------------------------
     @property
@@ -170,7 +195,8 @@ class Resource:
 
     # -- arithmetic -------------------------------------------------------
     def _check(self, other: "Resource") -> None:
-        graft_assert(self.spec == other.spec, "resource spec mismatch")
+        if self.spec is not other.spec:  # identity fast path — specs are shared
+            graft_assert(self.spec == other.spec, "resource spec mismatch")
 
     def add(self, other: "Resource") -> "Resource":
         self._check(other)
@@ -178,22 +204,26 @@ class Resource:
 
     def add_(self, other: "Resource") -> "Resource":
         self._check(other)
-        self.vec = self.vec + other.vec
+        if _LIB is not None:
+            _LIB.kb_add_(self._addr, other._addr, self.vec.size)
+        else:
+            np.add(self.vec, other.vec, out=self.vec)
         return self
 
     def sub(self, other: "Resource") -> "Resource":
         """Subtract, asserting no dimension underflows (resource_info.go:180-190:
         Sub panics via assert when left < right)."""
-        self._check(other)
-        graft_assert(
-            other.less_equal(self),
-            f"resource underflow: {other} not <= {self}",
-        )
-        return Resource(np.maximum(self.vec - other.vec, 0.0), self.spec)
+        return self.clone().sub_(other)
 
     def sub_(self, other: "Resource") -> "Resource":
-        r = self.sub(other)
-        self.vec = r.vec
+        self._check(other)
+        if not other.less_equal(self):  # message built only on failure
+            graft_assert(False, f"resource underflow: {other} not <= {self}")
+        if _LIB is not None:
+            _LIB.kb_sub_clamped_(self._addr, other._addr, self.vec.size)
+        else:
+            np.subtract(self.vec, other.vec, out=self.vec)
+            np.maximum(self.vec, 0.0, out=self.vec)
         return self
 
     def multi(self, ratio: float) -> "Resource":
@@ -203,7 +233,10 @@ class Resource:
     def set_max_(self, other: "Resource") -> "Resource":
         """Elementwise max, in place (resource_info.go:205-221 SetMaxResource)."""
         self._check(other)
-        self.vec = np.maximum(self.vec, other.vec)
+        if _LIB is not None:
+            _LIB.kb_set_max_(self._addr, other._addr, self.vec.size)
+        else:
+            np.maximum(self.vec, other.vec, out=self.vec)
         return self
 
     def min(self, other: "Resource") -> "Resource":
@@ -250,10 +283,20 @@ class Resource:
         (resource_info.go:269-284 LessEqual: a dim passes if value <= other's
         or the difference is below the minimum quantum)."""
         self._check(other)
+        if _LIB is not None:
+            return bool(
+                _LIB.kb_less_equal(
+                    self._addr, other._addr, self.spec._quanta_addr, self.vec.size
+                )
+            )
         return bool(np.all((self.vec <= other.vec) | (self.vec - other.vec < self.spec.quanta)))
 
     def less_equal_strict(self, other: "Resource") -> bool:
         self._check(other)
+        if _LIB is not None:
+            return bool(
+                _LIB.kb_less_equal_strict(self._addr, other._addr, self.vec.size)
+            )
         return bool(np.all(self.vec <= other.vec))
 
     def share(self, total: "Resource") -> float:
@@ -261,6 +304,12 @@ class Resource:
         totals (helpers/helpers.go:28-60 GetShare + drf.go:161-171)."""
         self._check(total)
         m = self.spec.semantic_mask
+        if _LIB is not None:
+            return float(
+                _LIB.kb_share(
+                    self._addr, total._addr, self.spec._mask_addr, self.vec.size
+                )
+            )
         with np.errstate(divide="ignore", invalid="ignore"):
             ratios = np.where(total.vec[m] > 0, self.vec[m] / total.vec[m], 0.0)
         return float(np.max(ratios)) if ratios.size else 0.0

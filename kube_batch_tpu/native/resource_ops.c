@@ -1,0 +1,69 @@
+/* Native fast path for Resource-vector arithmetic.
+ *
+ * The host replay path (Statement verbs + plugin event handlers) performs
+ * thousands of tiny R-dimensional vector ops per scheduling cycle
+ * (node_info AddTask/RemoveTask algebra, job/queue aggregate updates —
+ * resource_info.go:130-360 in the reference). numpy dispatch overhead
+ * (~2-5 us per op, several ops per verb) dominates at that size; these
+ * routines do the same arithmetic in one C call over the numpy buffers.
+ *
+ * Build: `make -C kube_batch_tpu/native` (or the auto-build in fast.py).
+ * The Python layer falls back to numpy when the library is unavailable —
+ * semantics are identical; tests/test_native.py runs every op on both paths.
+ */
+
+#include <stddef.h>
+
+#define KB_API __attribute__((visibility("default")))
+
+/* a += b */
+KB_API void kb_add_(double *a, const double *b, ptrdiff_t n) {
+    for (ptrdiff_t i = 0; i < n; i++) a[i] += b[i];
+}
+
+/* a = max(a - b, 0). Underflow validation (assert semantics,
+ * resource_info.go:180-190) happens in the Python caller via kb_less_equal
+ * BEFORE mutating, so the pre-mutation state is available for the error. */
+KB_API void kb_sub_clamped_(double *a, const double *b, ptrdiff_t n) {
+    for (ptrdiff_t i = 0; i < n; i++) {
+        double v = a[i] - b[i];
+        a[i] = v > 0.0 ? v : 0.0;
+    }
+}
+
+/* tolerant a <= b (resource_info.go:269-284) */
+KB_API int kb_less_equal(const double *a, const double *b,
+                         const double *quanta, ptrdiff_t n) {
+    for (ptrdiff_t i = 0; i < n; i++) {
+        if (!(a[i] <= b[i] || a[i] - b[i] < quanta[i])) return 0;
+    }
+    return 1;
+}
+
+/* strict a <= b in every dim */
+KB_API int kb_less_equal_strict(const double *a, const double *b,
+                                ptrdiff_t n) {
+    for (ptrdiff_t i = 0; i < n; i++)
+        if (a[i] > b[i]) return 0;
+    return 1;
+}
+
+/* a = max(a, b) elementwise (SetMaxResource, resource_info.go:205-221) */
+KB_API void kb_set_max_(double *a, const double *b, ptrdiff_t n) {
+    for (ptrdiff_t i = 0; i < n; i++)
+        if (b[i] > a[i]) a[i] = b[i];
+}
+
+/* dominant share: max over masked dims of a[i]/total[i] (helpers.go:28-60).
+ * mask is one byte per dim (numpy bool buffer; semantic dims only). */
+KB_API double kb_share(const double *a, const double *total,
+                       const unsigned char *mask, ptrdiff_t n) {
+    double best = 0.0;
+    for (ptrdiff_t i = 0; i < n; i++) {
+        if (mask[i] && total[i] > 0.0) {
+            double r = a[i] / total[i];
+            if (r > best) best = r;
+        }
+    }
+    return best;
+}
