@@ -53,6 +53,8 @@ class AllocateAction(Action):
         assigned = np.asarray(result.assigned)[: meta.n_tasks]
         pipelined = np.asarray(result.pipelined)[: meta.n_tasks]
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
+        pending = np.asarray(snap.task_pending)[: meta.n_tasks]
+        self._record_fit_errors(ssn, meta, result, assigned, task_job, pending)
 
         # group placements by job, in device task order
         by_job: Dict[int, List[Tuple[str, int, bool]]] = defaultdict(list)
@@ -110,6 +112,36 @@ class AllocateAction(Action):
                     len(placements),
                 )
                 stmt.discard()
+
+    def _record_fit_errors(self, ssn, meta, result, assigned, task_job, pending) -> None:
+        """FitErrors for unplaced pending tasks (allocate.go:151-155). The
+        reason histogram comes out of the solve itself (AllocateResult
+        .fail_hist) — diagnostics add no extra [T, N] dispatch."""
+        from kube_batch_tpu.api.job_info import FitErrors
+        from kube_batch_tpu.ops.feasibility import REASON_MESSAGES
+
+        unplaced = np.flatnonzero(pending & (assigned < 0))
+        if unplaced.size == 0:
+            return
+        hist = np.asarray(result.fail_hist)[: meta.n_tasks]
+        for ti in unplaced:
+            job = ssn.jobs.get(meta.job_uids[int(task_job[ti])])
+            if job is None:
+                continue
+            task = job.tasks.get(meta.task_keys[int(ti)])
+            if task is None:
+                continue
+            counts = dict(zip(REASON_MESSAGES, hist[ti].tolist()))
+            if not any(counts.values()):
+                # task was feasible at cycle start but lost the contention —
+                # capacity went to other tasks this cycle
+                counts = {
+                    "node(s) resources were consumed by other tasks this cycle":
+                        meta.n_nodes
+                }
+            fe = FitErrors()
+            fe.set_histogram(counts, meta.n_nodes)
+            job.nodes_fit_errors[task.uid] = fe
 
     def _host_place(self, ssn, stmt, task) -> bool:
         """Sequential placement for a task the device model couldn't encode:

@@ -32,21 +32,30 @@ class FitError:
 
 class FitErrors:
     """Per-task node→FitError map with a reason histogram rendering
-    (unschedule_info.go:74-112)."""
+    (unschedule_info.go:74-112). Two fill paths: per-node errors from host
+    predicate loops, or a pre-aggregated reason histogram straight from the
+    device solve (ops/feasibility.failure_histogram)."""
 
     def __init__(self):
         self.nodes: Dict[str, FitError] = {}
+        self._hist: Dict[str, int] = {}
+        self._n_nodes = 0
 
     def set_node_error(self, node_name: str, err: FitError) -> None:
         self.nodes[node_name] = err
 
+    def set_histogram(self, counts: Dict[str, int], n_nodes: int) -> None:
+        self._hist = {r: int(n) for r, n in counts.items() if n}
+        self._n_nodes = n_nodes
+
     def error(self) -> str:
-        hist: Dict[str, int] = defaultdict(int)
+        hist: Dict[str, int] = defaultdict(int, self._hist)
         for fe in self.nodes.values():
             for r in fe.reasons:
                 hist[r] += 1
-        reasons = "; ".join(f"{n} {r}" for r, n in sorted(hist.items(), key=lambda kv: kv[0]))
-        return f"0/{len(self.nodes)} nodes are available, {reasons}." if self.nodes else ""
+        n = max(len(self.nodes), self._n_nodes)
+        reasons = "; ".join(f"{n_} {r}" for r, n_ in sorted(hist.items(), key=lambda kv: kv[0]))
+        return f"0/{n} nodes are available, {reasons}." if hist else ""
 
 
 class JobInfo:

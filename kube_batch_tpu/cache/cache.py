@@ -67,6 +67,11 @@ class SchedulerCache:
         # from (the apiserver analog)
         self.pods: Dict[str, Pod] = {}
         self.events: List[tuple] = []  # (kind, object_key, message) record
+        # last written PodScheduled condition per pod key (dedup,
+        # cache.go:151-173 podConditionHaveUpdate)
+        self.pod_conditions: Dict[str, dict] = {}
+        # per-job earliest next condition-only status write (job_updater.go:20-31)
+        self._status_next_write: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # ingest: pods (event_handlers.go:42-200)
@@ -143,6 +148,7 @@ class SchedulerCache:
 
     def _delete_pod_locked(self, pod: Pod) -> None:
         self.pods.pop(pod.key(), None)
+        self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
         job_id = job_id_for_pod(pod)
         job = self.jobs.get(job_id)
         if job is not None:
@@ -159,6 +165,7 @@ class SchedulerCache:
         has no tasks and no (non-shadow) PodGroup."""
         if not job.tasks and (job.pod_group is None or job.pod_group.shadow):
             self.jobs.pop(job.uid, None)
+            self._status_next_write.pop(job.uid, None)
 
     # ------------------------------------------------------------------
     # ingest: nodes (event_handlers.go:261-360)
@@ -202,6 +209,7 @@ class SchedulerCache:
                 job.pod_group = None
                 if not job.tasks:
                     self.jobs.pop(key, None)
+            self._status_next_write.pop(key, None)
 
     # ------------------------------------------------------------------
     # ingest: queues / priority classes (event_handlers.go:597-785)
@@ -310,21 +318,75 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     # status egress (cache.go:688-736)
     # ------------------------------------------------------------------
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """PodScheduled=False condition + FailedScheduling event for one task
+        (cache.go:500-525), deduplicated like podConditionHaveUpdate
+        (cache.go:151-173)."""
+        cond = {
+            "type": "PodScheduled",
+            "status": "False",
+            "reason": "Unschedulable",
+            "message": message,
+        }
+        key = task.key()
+        with self._lock:
+            if self.pod_conditions.get(key) == cond:
+                return  # no-op update suppressed
+            self.pod_conditions[key] = cond
+            pod = self.pods.get(key)
+        if pod is not None:
+            self.status_updater.update_pod_condition(pod, cond)
+        self.events.append(("FailedScheduling", key, message))
+
     def record_job_status_event(self, job: JobInfo) -> None:
-        self.events.append(("Unschedulable", job.uid, job.fit_error()))
+        """Unschedulable PodGroup event + per-pending-task fit-error
+        conditions (cache.go:688-711)."""
+        base = job.fit_error()
+        self.events.append(("Unschedulable", job.uid, base))
+        for task in job.tasks.values():
+            if task.status != TaskStatus.PENDING:
+                continue
+            fe = job.nodes_fit_errors.get(task.uid)
+            self.task_unschedulable(task, fe.error() if fe is not None else base)
 
     def update_job_status(self, job: JobInfo) -> None:
         """Write the session's derived PodGroup status back to the
-        authoritative store (UpdatePodGroup, cache.go:722-736)."""
+        authoritative store (UpdatePodGroup, cache.go:722-736).
+
+        Condition-only updates (phase and counts unchanged) are rate-limited
+        to one write per minute plus jitter, like the jobUpdater
+        (job_updater.go:20-31,55-100) — conditions churn every cycle for a
+        stuck job, and the write stream must not."""
+        import random
+        import time as _time
+
+        pg = job.pod_group
+        if pg is None:
+            return
         with self._lock:
             own = self.jobs.get(job.uid)
-            if own is not None and own.pod_group is not None and job.pod_group is not None:
-                own.pod_group.phase = job.pod_group.phase
-                own.pod_group.conditions = list(job.pod_group.conditions)
-                own.pod_group.running = job.pod_group.running
-                own.pod_group.failed = job.pod_group.failed
-                own.pod_group.succeeded = job.pod_group.succeeded
-        self.status_updater.update_pod_group(job.pod_group)
+            if own is None:
+                return  # job deleted mid-cycle — nothing to write status for
+            own_pg = own.pod_group if own is not None else None
+            condition_only = (
+                own_pg is not None
+                and own_pg.phase == pg.phase
+                and (own_pg.running, own_pg.failed, own_pg.succeeded)
+                == (pg.running, pg.failed, pg.succeeded)
+            )
+            now = _time.monotonic()
+            if condition_only:
+                next_ok = self._status_next_write.get(job.uid, 0.0)
+                if now < next_ok:
+                    return  # rate-limited; session state is already updated
+            self._status_next_write[job.uid] = now + 60.0 + random.uniform(0, 30.0)
+            if own_pg is not None:
+                own_pg.phase = pg.phase
+                own_pg.conditions = list(pg.conditions)
+                own_pg.running = pg.running
+                own_pg.failed = pg.failed
+                own_pg.succeeded = pg.succeeded
+        self.status_updater.update_pod_group(pg)
 
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)

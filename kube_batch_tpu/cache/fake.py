@@ -33,11 +33,15 @@ class FakeEvictor:
 
 
 class FakeStatusUpdater:
+    def __init__(self):
+        self.pod_conditions: List[tuple] = []
+        self.pod_groups: List[object] = []
+
     def update_pod_condition(self, pod, condition) -> None:
-        pass
+        self.pod_conditions.append((f"{pod.namespace}/{pod.name}", condition))
 
     def update_pod_group(self, pod_group) -> None:
-        pass
+        self.pod_groups.append(pod_group)
 
 
 class FakeVolumeBinder:

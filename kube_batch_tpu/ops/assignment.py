@@ -87,6 +87,8 @@ class AllocateResult(NamedTuple):
     node_releasing: jnp.ndarray  # [N, R] post-solve
     node_used: jnp.ndarray      # [N, R] post-solve
     deserved: jnp.ndarray       # [Q, R] proportion deserved (diagnostics)
+    fail_hist: jnp.ndarray      # [T, N_REASONS] i32 — cycle-start fit-error
+    #                             histogram (FitErrors diagnostics)
 
 
 def _queue_gate(
@@ -175,6 +177,18 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     Q = snap.queue_weight.shape[0]
 
     static_ok = static_predicates(snap)           # [T, N]
+    # cycle-start fit-error histogram — inside the same compiled program so
+    # diagnostics never cost a second [T, N] dispatch (allocate.go:151-155)
+    from kube_batch_tpu.ops.feasibility import FeasibilityMasks, failure_histogram
+
+    fit0_idle = fits(snap.task_req, snap.node_idle, snap.quanta)
+    fit0_rel = fits(snap.task_req, snap.node_releasing, snap.quanta)
+    fail_hist = failure_histogram(
+        snap,
+        FeasibilityMasks(
+            static_ok, fit0_idle, fit0_rel, static_ok & (fit0_idle | fit0_rel)
+        ),
+    )
     score = score_matrix(snap, config.weights)
     tie_hash = _tie_break_hash(T, N)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
@@ -360,4 +374,5 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         node_releasing=releasing,
         node_used=used,
         deserved=deserved,
+        fail_hist=fail_hist,
     )

@@ -82,6 +82,14 @@ REASON_TAINT = 2
 REASON_RESOURCE = 3
 N_REASONS = 4
 
+# canonical message per reason class (unschedule_info.go:11-19 style)
+REASON_MESSAGES = (
+    "node(s) were not ready or unschedulable",
+    "node(s) didn't match node selector",
+    "node(s) had taints that the pod didn't tolerate",
+    "Insufficient resources",
+)
+
 
 def failure_histogram(snap: DeviceSnapshot, masks: FeasibilityMasks) -> jnp.ndarray:
     """[T, N_REASONS] i32: per task, how many valid nodes failed each
@@ -98,9 +106,13 @@ def failure_histogram(snap: DeviceSnapshot, masks: FeasibilityMasks) -> jnp.ndar
         axis=-1,
     )
     fit = masks.fit_idle | masks.fit_releasing
+    T = snap.task_req.shape[0]
+    unhealthy = jnp.broadcast_to(
+        jnp.sum(snap.node_valid & ~node_ok), (T,)
+    )  # task-independent
     return jnp.stack(
         [
-            jnp.sum(nodes & ~node_ok[None, :], axis=1),
+            unhealthy,
             jnp.sum(nodes & node_ok[None, :] & ~sel_ok, axis=1),
             jnp.sum(nodes & node_ok[None, :] & sel_ok & ~taints_ok, axis=1),
             jnp.sum(nodes & masks.static_ok & ~fit, axis=1),

@@ -112,6 +112,7 @@ def sharded_allocate_solve(
             node_releasing=node2,
             node_used=node2,
             deserved=repl,
+            fail_hist=repl,
         )
         fn = jax.jit(
             partial(_solve, config=config),

@@ -252,3 +252,46 @@ class TestSchedulerLoop:
         conf = parse_scheduler_conf('actions: "bogus"\ntiers: []')
         with pytest.raises(KeyError):
             Scheduler(cache, conf=conf)
+
+
+class TestFitErrorDiagnostics:
+    def test_unplaced_task_gets_fit_errors_and_pod_condition(self):
+        """allocate.go:151-155 FitErrors + cache.go:500-525,688-711
+        taskUnschedulable: an unplaceable pending task ends the cycle with a
+        reason histogram, a PodScheduled=False condition, and a
+        FailedScheduling event."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n1", cpu=1000, mem=GiB, pods=10)],
+            pods=[build_pod("c1", "big", None, PodPhase.PENDING,
+                            {"cpu": 8000, "memory": GiB}, group_name="pg1")],
+        )
+        run_actions(cache, action_names=["allocate"])
+        job = cache.jobs["c1/pg1"]
+        assert len(cache.binder.binds) == 0
+        # FitErrors histogram recorded on the session clone and surfaced as a
+        # pod condition through record_job_status_event at gang close
+        assert cache.pod_conditions["c1/big"]["reason"] == "Unschedulable"
+        msg = cache.pod_conditions["c1/big"]["message"]
+        assert "Insufficient resources" in msg and "/1 nodes are available" in msg
+        assert any(e[0] == "FailedScheduling" for e in cache.events)
+        # PodGroup got the Unschedulable condition (gang.go:132-175)
+        assert any(c.type == "Unschedulable" and c.status == "True"
+                   for c in job.pod_group.conditions)
+
+    def test_condition_update_deduplicated(self):
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n1", cpu=1000, mem=GiB, pods=10)],
+            pods=[build_pod("c1", "big", None, PodPhase.PENDING,
+                            {"cpu": 8000, "memory": GiB}, group_name="pg1")],
+        )
+        run_actions(cache, action_names=["allocate"])
+        n_events = len([e for e in cache.events if e[0] == "FailedScheduling"])
+        run_actions(cache, action_names=["allocate"])  # second cycle, same state
+        n_events2 = len([e for e in cache.events if e[0] == "FailedScheduling"])
+        assert n_events2 == n_events  # no-op condition writes suppressed

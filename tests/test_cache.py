@@ -153,3 +153,27 @@ class TestResyncRepair:
         task = next(iter(cache.jobs["ns/p1"].tasks.values()))
         assert task.status == TaskStatus.PENDING
         assert task.node_name is None
+
+
+class TestStatusRateLimit:
+    def test_condition_only_updates_rate_limited(self):
+        """job_updater.go:20-31: condition-only PodGroup writes throttle to
+        one per minute; phase changes always write."""
+        from kube_batch_tpu.api.types import PodGroupPhase
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        cache = SchedulerCache()
+        cache.add_queue(Queue(name="default"))
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns", min_member=1))
+        job = cache.jobs["ns/pg"].clone()
+        job.pod_group.phase = PodGroupPhase.PENDING
+        cache.update_job_status(job)
+        n0 = len(cache.status_updater.pod_groups)
+        # same phase, new condition → rate-limited, no write
+        from kube_batch_tpu.api.pod import PodGroupCondition
+        job.pod_group.conditions.append(PodGroupCondition(type="Unschedulable"))
+        cache.update_job_status(job)
+        assert len(cache.status_updater.pod_groups) == n0
+        # phase change → writes through immediately
+        job.pod_group.phase = PodGroupPhase.RUNNING
+        cache.update_job_status(job)
+        assert len(cache.status_updater.pod_groups) == n0 + 1
