@@ -52,12 +52,31 @@ class Taint:
     effect: str = "NoSchedule"
 
 
+HOSTNAME_TOPOLOGY = "kubernetes.io/hostname"
+
+
+@dataclasses.dataclass
+class PodAffinityTerm:
+    """Required inter-pod (anti-)affinity term (the
+    InterPodAffinityMatches predicate's input, predicates.go:278-296):
+    match_labels select existing pods; topology_key partitions nodes into
+    domains (hostname ⇒ per-node; any other key ⇒ nodes sharing that node
+    label's value)."""
+
+    match_labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    topology_key: str = HOSTNAME_TOPOLOGY
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.match_labels.items())
+
+
 @dataclasses.dataclass
 class Affinity:
-    """Required-node-affinity as match-expression terms.
+    """Required-node-affinity as match-expression terms, plus required
+    inter-pod affinity/anti-affinity.
 
-    Each term is a list of (key, operator, values) requirements; terms are
-    OR'd, requirements within a term are AND'd — the same shape as
+    Each node term is a list of (key, operator, values) requirements; terms
+    are OR'd, requirements within a term are AND'd — the same shape as
     v1.NodeSelectorTerms consumed by the vendored MatchNodeSelector predicate
     (predicates.go:194-205).
     """
@@ -65,6 +84,8 @@ class Affinity:
     node_terms: List[List[Tuple[str, str, Tuple[str, ...]]]] = dataclasses.field(
         default_factory=list
     )
+    pod_affinity: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
+    pod_anti_affinity: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

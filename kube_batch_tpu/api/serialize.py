@@ -15,6 +15,7 @@ from kube_batch_tpu.api.pod import (
     Affinity,
     Node,
     Pod,
+    PodAffinityTerm,
     PodGroup,
     PodGroupCondition,
     PriorityClass,
@@ -37,7 +38,11 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
             "node_terms": [
                 [[k, op, list(vals)] for (k, op, vals) in term]
                 for term in pod.affinity.node_terms
-            ]
+            ],
+            "pod_affinity": [dataclasses.asdict(t) for t in pod.affinity.pod_affinity],
+            "pod_anti_affinity": [
+                dataclasses.asdict(t) for t in pod.affinity.pod_anti_affinity
+            ],
         }
     d["host_ports"] = list(pod.host_ports)
     return _clean(d)
@@ -54,7 +59,14 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
             node_terms=[
                 [(k, op, tuple(vals)) for (k, op, vals) in term]
                 for term in d["affinity"].get("node_terms", [])
-            ]
+            ],
+            pod_affinity=[
+                PodAffinityTerm(**t) for t in d["affinity"].get("pod_affinity", [])
+            ],
+            pod_anti_affinity=[
+                PodAffinityTerm(**t)
+                for t in d["affinity"].get("pod_anti_affinity", [])
+            ],
         )
     if "host_ports" in d:
         d["host_ports"] = tuple(d["host_ports"])

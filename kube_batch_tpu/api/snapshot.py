@@ -75,6 +75,12 @@ class DeviceSnapshot(NamedTuple):
     task_node: "np.ndarray"         # [T] i32 — bound node index, -1 unbound
     task_critical: "np.ndarray"     # [T] bool — conformance-protected
     #                                 (conformance.go:42-59)
+    # sparse inter-pod-affinity correction (predicates.go:278-296): rows of
+    # a [K, N] allow mask for the K tasks carrying required pod
+    # (anti-)affinity terms, evaluated against snapshot-time placements;
+    # the host predicate re-validates against live state at replay
+    task_aff_idx: "np.ndarray"      # [K] i32 — task index, -1 padding
+    task_aff_mask: "np.ndarray"     # [K, N] bool — allowed nodes (padding: True)
     # nodes [N, ...]
     node_idle: "np.ndarray"         # [N, R] f32
     node_releasing: "np.ndarray"    # [N, R] f32
@@ -194,6 +200,7 @@ def build_snapshot(
     task_tol_bits = np.zeros((T, Wt), np.uint32)
     task_node = np.full(T, -1, np.int32)
     task_critical = np.zeros(T, bool)
+    aff_tasks: List[int] = []  # tasks needing an inter-pod-affinity row
     task_keys: List[str] = []
 
     taint_list = list(taint_bit.items())  # [((k,v,effect), bit)]
@@ -214,6 +221,10 @@ def build_snapshot(
             t.pod.priority_class in CRITICAL_PRIORITY_CLASSES
             or t.namespace == CRITICAL_NAMESPACE
         )
+        if t.pod.affinity is not None and (
+            t.pod.affinity.pod_affinity or t.pod.affinity.pod_anti_affinity
+        ):
+            aff_tasks.append(i)
         # required label pairs → bits: node-selector terms (MatchNodeSelector,
         # predicates.go:194-205) plus single-term node-affinity whose
         # In-requirements carry one value (necessary AND sufficient for that
@@ -324,6 +335,21 @@ def build_snapshot(
             if t.status == TaskStatus.PENDING or is_allocated(t.status):
                 queue_request[qi] += t.resreq.vec
 
+    # sparse inter-pod-affinity rows, evaluated host-side at snapshot time
+    # (the string/label matching stays host-precompiled, SURVEY.md §7.3)
+    K = max(1, len(aff_tasks))
+    task_aff_idx = np.full(K, -1, np.int32)
+    task_aff_mask = np.ones((K, N), bool)
+    if aff_tasks:
+        from kube_batch_tpu.plugins.predicates import pod_affinity_ok
+
+        node_objs = list(nodes)
+        for k, ti in enumerate(aff_tasks):
+            task_aff_idx[k] = ti
+            t = tasks[ti][0]
+            for ni, n in enumerate(node_objs):
+                task_aff_mask[k, ni] = pod_affinity_ok(t, n, node_objs)
+
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32) if nN else np.zeros(R, np.float32)
 
     snap = DeviceSnapshot(
@@ -341,6 +367,8 @@ def build_snapshot(
         task_tol_bits=task_tol_bits,
         task_node=task_node,
         task_critical=task_critical,
+        task_aff_idx=task_aff_idx,
+        task_aff_mask=task_aff_mask,
         node_idle=node_idle,
         node_releasing=node_releasing,
         node_used=node_used,

@@ -63,7 +63,13 @@ def static_predicates(snap: DeviceSnapshot) -> jnp.ndarray:
         axis=-1,
     )  # [T, N]
 
-    return node_ok[None, :] & sel_ok & taints_ok
+    ok = node_ok[None, :] & sel_ok & taints_ok
+    # sparse inter-pod-affinity correction rows (snapshot.task_aff_*):
+    # unique task indices, padding rows (-1) clip to row 0 with an all-True
+    # mask, so the scatter-min is a no-op there
+    T = ok.shape[0]
+    upd = jnp.where((snap.task_aff_idx >= 0)[:, None], snap.task_aff_mask, True)
+    return ok.at[jnp.clip(snap.task_aff_idx, 0, T - 1)].min(upd)
 
 
 def feasibility(snap: DeviceSnapshot) -> FeasibilityMasks:

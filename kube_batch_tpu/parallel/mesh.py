@@ -62,6 +62,8 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         task_tol_bits=repl,
         task_node=repl,
         task_critical=repl,
+        task_aff_idx=repl,
+        task_aff_mask=NamedSharding(mesh, P(None, NODE_AXIS)),
         node_idle=node2,
         node_releasing=node2,
         node_used=node2,

@@ -9,8 +9,12 @@ Checks, mirroring predicates.go:154-298:
   MatchNodeSelector incl. required node-affinity terms (:194-205),
   PodFitsHostPorts (:207-218), PodToleratesNodeTaints (:220-231), and the
   optional Memory/Disk/PID pressure gates driven by plugin arguments
-  (:233-276; arg keys :34-41). Inter-pod affinity is not yet modeled (the
-  snapshot carries no pod-affinity terms); tracked for a later round.
+  (:233-276; arg keys :34-41), and required inter-pod affinity/anti-affinity
+  with the affinity-only fast path (:278-296). The device mask carries a
+  snapshot-time approximation of the inter-pod terms (build_snapshot's
+  correction mask); this host predicate re-validates every proposed
+  placement against LIVE session state, so two anti-affine tasks placed in
+  one device round can't both commit.
 """
 
 from __future__ import annotations
@@ -62,6 +66,55 @@ def tolerates_taints(task: TaskInfo, node: NodeInfo) -> bool:
     return True
 
 
+def _topology_domain(node: NodeInfo, topology_key: str, all_nodes) -> list:
+    """Nodes in `node`'s topology domain (hostname ⇒ just the node)."""
+    from kube_batch_tpu.api.pod import HOSTNAME_TOPOLOGY
+
+    if topology_key == HOSTNAME_TOPOLOGY:
+        return [node]
+    labels = node.node.labels if node.node else {}
+    value = labels.get(topology_key)
+    if value is None:
+        return [node]
+    return [
+        n for n in all_nodes
+        if n.node is not None and n.node.labels.get(topology_key) == value
+    ]
+
+
+def pod_affinity_ok(task: TaskInfo, node: NodeInfo, all_nodes) -> bool:
+    """InterPodAffinityMatches (predicates.go:278-296): required affinity
+    terms need a matching existing pod in the node's topology domain —
+    unless NO pod matches anywhere (the affinity-only fast path, letting a
+    group's first pod land); anti-affinity terms must have no match in the
+    domain. Placements made earlier in this session count — node.tasks is
+    live session state."""
+    aff = task.pod.affinity
+    if aff is None:
+        return True
+    for term in aff.pod_affinity:
+        domain = _topology_domain(node, term.topology_key, all_nodes)
+        if any(
+            term.matches(t.pod.labels)
+            for n in domain for t in n.tasks.values()
+        ):
+            continue
+        # fast path: a term no pod satisfies cluster-wide doesn't block
+        if any(
+            term.matches(t.pod.labels)
+            for n in all_nodes for t in n.tasks.values()
+        ):
+            return False
+    for term in aff.pod_anti_affinity:
+        domain = _topology_domain(node, term.topology_key, all_nodes)
+        if any(
+            term.matches(t.pod.labels) and t.key() != task.key()
+            for n in domain for t in n.tasks.values()
+        ):
+            return False
+    return True
+
+
 def fits_host_ports(task: TaskInfo, node: NodeInfo) -> bool:
     wanted = set(task.pod.host_ports)
     if not wanted:
@@ -93,6 +146,10 @@ class PredicatesPlugin(Plugin):
                 raise fw.FitFailure("node(s) didn't have free ports")
             if not tolerates_taints(task, node):
                 raise fw.FitFailure("node(s) had taints that the pod didn't tolerate")
+            if not pod_affinity_ok(task, node, ssn.nodes.values()):
+                raise fw.FitFailure(
+                    "node(s) didn't satisfy inter-pod affinity/anti-affinity"
+                )
             conds = node.node.conditions
             if check_mem and conds.get("MemoryPressure"):
                 raise fw.FitFailure("node(s) had memory pressure")

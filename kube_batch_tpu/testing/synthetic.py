@@ -119,6 +119,8 @@ def synthetic_device_snapshot(
         task_tol_bits=np.zeros((T, 1), np.uint32),
         task_node=np.full(T, -1, np.int32),
         task_critical=np.zeros(T, bool),
+        task_aff_idx=np.full(1, -1, np.int32),
+        task_aff_mask=np.ones((1, N), bool),
         node_idle=node_alloc.copy(),
         node_releasing=np.zeros((N, R), np.float32),
         node_used=np.zeros((N, R), np.float32),

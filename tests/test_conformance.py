@@ -456,3 +456,99 @@ class TestReclaimScenario:
         run_actions(cache, action_names=["reclaim"])
         # qb deserves 1000m (its request caps it); exactly one eviction
         assert len(cache.evictor.evicts) == 1
+
+
+class TestInterPodAffinity:
+    def test_pod_affinity_co_locates(self):
+        """e2e predicates.go:112 "Pod Affinity": a pod with required pod
+        affinity lands in the same topology domain as the matching pod;
+        the group's first pod passes via the affinity-only fast path."""
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pga", namespace="c1", min_member=1,
+                                 queue="default"),
+                        PodGroup(name="pgb", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node(f"n{i}", cpu=8000, mem=16 * GiB) for i in range(4)],
+            pods=[
+                build_pod("c1", "anchor", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pga",
+                          labels={"app": "db"}),
+                build_pod("c1", "follower", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pgb",
+                          affinity=Affinity(pod_affinity=[
+                              PodAffinityTerm(match_labels={"app": "db"})])),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        binds = cache.binder.binds
+        assert binds["c1/anchor"] == binds["c1/follower"]
+
+    def test_pod_anti_affinity_spreads(self):
+        """e2e-style anti-affinity: two pods with the same label and
+        hostname-scope anti-affinity must land on different nodes."""
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        anti = Affinity(pod_anti_affinity=[PodAffinityTerm(match_labels={"app": "w"})])
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=2,
+                                 queue="default")],
+            nodes=[build_node(f"n{i}", cpu=8000, mem=16 * GiB) for i in range(3)],
+            pods=[
+                build_pod("c1", "w-0", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          labels={"app": "w"}, affinity=anti),
+                build_pod("c1", "w-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          labels={"app": "w"}, affinity=anti),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        binds = cache.binder.binds
+        assert len(binds) == 2
+        assert binds["c1/w-0"] != binds["c1/w-1"]
+
+    def test_anti_affinity_against_running_pod(self):
+        """Anti-affinity vs an already-running pod in the same domain."""
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n0", cpu=8000, mem=16 * GiB),
+                   build_node("n1", cpu=8000, mem=16 * GiB)],
+            pods=[
+                build_pod("c1", "existing", "n0", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, labels={"app": "x"}),
+                build_pod("c1", "new", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          affinity=Affinity(pod_anti_affinity=[
+                              PodAffinityTerm(match_labels={"app": "x"})])),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/new"] == "n1"
+
+    def test_zone_topology_affinity(self):
+        """Non-hostname topology key: domain = nodes sharing the zone label."""
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n0", cpu=8000, mem=16 * GiB, labels={"zone": "a"}),
+                   build_node("n1", cpu=8000, mem=16 * GiB, labels={"zone": "a"}),
+                   build_node("n2", cpu=8000, mem=16 * GiB, labels={"zone": "b"})],
+            pods=[
+                build_pod("c1", "anchor", "n0", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, labels={"app": "db"}),
+                build_pod("c1", "near", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          affinity=Affinity(pod_affinity=[
+                              PodAffinityTerm(match_labels={"app": "db"},
+                                              topology_key="zone")])),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/near"] in ("n0", "n1")  # zone a only

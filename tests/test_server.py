@@ -112,6 +112,21 @@ class TestSerialize:
         assert back.affinity.node_terms == [[("zone", "In", ("a", "b"))]]
         assert back.host_ports == (8080,)
 
+    def test_pod_affinity_round_trip(self):
+        from kube_batch_tpu.api.pod import PodAffinityTerm
+        pod = Pod(
+            name="p2",
+            affinity=Affinity(
+                pod_affinity=[PodAffinityTerm(match_labels={"app": "db"})],
+                pod_anti_affinity=[
+                    PodAffinityTerm(match_labels={"app": "w"}, topology_key="zone")
+                ],
+            ),
+        )
+        back = serialize.pod_from_dict(serialize.pod_to_dict(pod))
+        assert back.affinity.pod_affinity[0].match_labels == {"app": "db"}
+        assert back.affinity.pod_anti_affinity[0].topology_key == "zone"
+
     def test_node_round_trip(self):
         node = Node(name="n1", allocatable={"cpu": 4000},
                     taints=[Taint(key="t", effect="NoSchedule")],
