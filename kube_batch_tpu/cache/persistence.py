@@ -1,0 +1,66 @@
+"""Cache state persistence — the standalone durable-state story.
+
+The reference keeps ALL durable state in the API server/etcd and rebuilds its
+cache by re-list + re-watch on restart (cache.go:342-384, SURVEY.md §5.4);
+the `Inqueue` phase persisted on PodGroup.Status survives restarts
+(enqueue.go:115). Standalone there is no etcd, so the cache itself snapshots
+to a JSON state file: save after each cycle (atomic tmp+rename), load at
+startup. Shadow PodGroups are skipped — add_pod regenerates them."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from kube_batch_tpu.api import serialize
+from kube_batch_tpu.api.pod import PriorityClass
+
+
+def save_state(cache, path: str) -> None:
+    with cache._lock:
+        state = {
+            "pods": [serialize.pod_to_dict(p) for p in cache.pods.values()],
+            "nodes": [
+                serialize.node_to_dict(n.node)
+                for n in cache.nodes.values()
+                if n.node is not None
+            ],
+            "pod_groups": [
+                serialize.pod_group_to_dict(j.pod_group)
+                for j in cache.jobs.values()
+                if j.pod_group is not None and not j.pod_group.shadow
+            ],
+            "queues": [serialize.queue_to_dict(q.queue) for q in cache.queues.values()],
+            "priority_classes": [
+                {"name": pc.name, "value": pc.value, "global_default": pc.global_default}
+                for pc in cache.priority_classes.values()
+            ],
+            "pod_conditions": cache.pod_conditions,
+        }
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
+    with os.fdopen(fd, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def load_state(cache, path: str) -> bool:
+    """Replay a saved state file through the cache's ingest handlers (the
+    re-list analog). Returns False when no state file exists."""
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except FileNotFoundError:
+        return False
+    for q in state.get("queues", []):
+        cache.add_queue(serialize.queue_from_dict(q))
+    for pc in state.get("priority_classes", []):
+        cache.add_priority_class(PriorityClass(**pc))
+    for n in state.get("nodes", []):
+        cache.add_node(serialize.node_from_dict(n))
+    for pg in state.get("pod_groups", []):
+        cache.add_pod_group(serialize.pod_group_from_dict(pg))
+    for p in state.get("pods", []):
+        cache.add_pod(serialize.pod_from_dict(p))
+    cache.pod_conditions.update(state.get("pod_conditions", {}))
+    return True

@@ -27,6 +27,9 @@ class ServerOption:
     kube_api_qps: float = 50.0
     kube_api_burst: int = 100
     print_version: bool = False
+    # standalone-only: durable-state file (the etcd analog, SURVEY.md §5.4);
+    # empty = in-memory only
+    state_file: str = ""
 
     def check_option_or_die(self) -> None:
         """(options.go:84-90): leader election requires a lock namespace;
@@ -86,6 +89,9 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="egress write burst")
     parser.add_argument("--version", action="store_true", default=False,
                         help="print version and exit")
+    parser.add_argument("--state-file", default=d.state_file,
+                        help="durable cluster-state JSON (standalone etcd "
+                             "analog); loaded at startup, saved each cycle")
 
 
 def parse(argv: Optional[List[str]] = None) -> ServerOption:
@@ -106,6 +112,7 @@ def parse(argv: Optional[List[str]] = None) -> ServerOption:
         kube_api_qps=ns.kube_api_qps,
         kube_api_burst=ns.kube_api_burst,
         print_version=ns.version,
+        state_file=ns.state_file,
     )
     global server_opts
     server_opts = opt

@@ -127,6 +127,34 @@ def make_handler(cache: SchedulerCache):
                 self._send(200, "ok", "text/plain")
             elif self.path == "/version":
                 self._send(200, version_string(), "text/plain")
+            elif self.path == "/debug/stacks":
+                # pprof goroutine-dump analog (main.go:25 net/http/pprof)
+                import sys
+                import traceback
+
+                frames = sys._current_frames()
+                out = []
+                for tid, frame in frames.items():
+                    out.append(f"--- thread {tid} ---")
+                    out.extend(l.rstrip() for l in traceback.format_stack(frame))
+                self._send(200, "\n".join(out), "text/plain")
+            elif self.path.startswith("/debug/pprof"):
+                # CPU-profile analog: ?seconds=N profiles the process
+                import cProfile
+                import io as _io
+                import pstats
+                import time as _time
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                seconds = min(float(q.get("seconds", ["5"])[0]), 60.0)
+                prof = cProfile.Profile()
+                prof.enable()
+                _time.sleep(seconds)
+                prof.disable()
+                buf = _io.StringIO()
+                pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(50)
+                self._send(200, buf.getvalue(), "text/plain")
             elif self.path == "/v1/queues":
                 self._send(200, json.dumps(_queue_status(cache)))
             elif self.path == "/v1/jobs":
@@ -235,10 +263,18 @@ def run(opt: ServerOption) -> None:
         evictor=RateLimitedBackend(FakeEvictor(), opt.kube_api_qps, opt.kube_api_burst),
         resolve_priority=opt.enable_priority_class,
     )
+    on_cycle_end = None
+    if opt.state_file:
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+
+        if load_state(cache, opt.state_file):
+            logger.info("restored cluster state from %s", opt.state_file)
+        on_cycle_end = lambda: save_state(cache, opt.state_file)  # noqa: E731
     sched = Scheduler(
         cache,
         conf_path=opt.scheduler_conf or None,
         schedule_period=opt.schedule_period,
+        on_cycle_end=on_cycle_end,
     )
     host, port = opt.listen_host_port
     admin = AdminServer(cache, host, port)

@@ -29,12 +29,14 @@ class Scheduler:
         conf: Optional[SchedulerConfiguration] = None,
         conf_path: Optional[str] = None,
         schedule_period: float = 1.0,
+        on_cycle_end=None,
     ):
         self.cache = cache
         self.conf = conf if conf is not None else load_scheduler_conf(conf_path)
         # resolve actions at construction — unknown names raise (util.go:63-70)
         self.actions: List[Action] = [get_action(n) for n in self.conf.actions]
         self.schedule_period = schedule_period
+        self.on_cycle_end = on_cycle_end  # e.g. state-file save (persistence.py)
         self._stop = False
 
     def run_once(self) -> None:
@@ -51,6 +53,8 @@ class Scheduler:
         finally:
             close_session(ssn)
         metrics.observe_e2e_latency((time.perf_counter() - start) * 1e3)
+        if self.on_cycle_end is not None:
+            self.on_cycle_end()
 
     def run_forever(self) -> None:
         while not self._stop:

@@ -262,3 +262,56 @@ class TestLeaderElection:
         ta.join(2)
         tb.join(2)
         assert order == ["a", "b"]  # release → standby takes over
+
+
+class TestPersistence:
+    def test_save_load_round_trip(self, tmp_path):
+        """SURVEY.md §5.4: restart = reload durable state; the Inqueue phase
+        survives (enqueue.go:115)."""
+        from kube_batch_tpu.api.types import PodGroupPhase
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+
+        cache = SchedulerCache()
+        cache.add_queue(serialize.queue_from_dict({"name": "gold", "weight": 3}))
+        cache.add_node(build_node("n1"))
+        pg = PodGroup(name="pg1", min_member=2, queue="gold",
+                      phase=PodGroupPhase.INQUEUE)
+        cache.add_pod_group(pg)
+        cache.add_pod(build_pod("default", "p1", "n1", PodPhase.RUNNING,
+                                {"cpu": 500.0}, group_name="pg1"))
+        path = str(tmp_path / "state.json")
+        save_state(cache, path)
+
+        fresh = SchedulerCache()
+        assert load_state(fresh, path)
+        assert fresh.queues["gold"].weight == 3
+        assert fresh.jobs["default/pg1"].pod_group.phase == PodGroupPhase.INQUEUE
+        # bound pod replays node accounting
+        node = fresh.nodes["n1"]
+        assert node.used.milli_cpu == 500.0
+        assert not load_state(SchedulerCache(), str(tmp_path / "missing.json"))
+
+    def test_shadow_pod_groups_not_persisted(self, tmp_path):
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+        cache = SchedulerCache()
+        cache.add_queue(serialize.queue_from_dict({"name": "default"}))
+        cache.add_pod(build_pod("default", "solo", None, PodPhase.PENDING,
+                                {"cpu": 100.0}))  # plain pod → shadow PG
+        path = str(tmp_path / "state.json")
+        save_state(cache, path)
+        fresh = SchedulerCache()
+        load_state(fresh, path)
+        job = next(iter(fresh.jobs.values()))
+        assert job.pod_group is not None and job.pod_group.shadow
+
+
+class TestDebugEndpoints:
+    def test_stacks(self):
+        cache = SchedulerCache()
+        srv = AdminServer(cache, port=0)
+        srv.start()
+        try:
+            body = _get(srv.port, "/debug/stacks")
+            assert "thread" in body
+        finally:
+            srv.stop()
