@@ -76,6 +76,7 @@ class AllocateConfig(NamedTuple):
     gang: bool = True        # gang plugin (JobReady commit gate)
     drf: bool = True         # drf job ordering
     proportion: bool = True  # queue overused gating + queue order
+    use_pallas: bool = False  # fused round-head kernel (ops/pallas_kernels)
     weights: ScoreWeights = ScoreWeights()
 
 
@@ -259,11 +260,21 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             )
             pending = eligible & ~placed & ~job_failed[snap.task_job]
 
-            fit_idle = fits(snap.task_req, idle, snap.quanta)
-            fit_rel = fits(snap.task_req, releasing, snap.quanta)
-            feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
-            masked = jnp.where(feas, score, NEG)
-            best, has = _best_node(masked, tie_hash)
+            if config.use_pallas:
+                from kube_batch_tpu.ops.pallas_kernels import masked_best_node
+
+                best, has, chose_idle_k = masked_best_node(
+                    score, static_ok, snap.task_req, idle, releasing,
+                    pending, snap.quanta,
+                    interpret=jax.default_backend() != "tpu",
+                )
+                fit_idle = None
+            else:
+                fit_idle = fits(snap.task_req, idle, snap.quanta)
+                fit_rel = fits(snap.task_req, releasing, snap.quanta)
+                feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
+                masked = jnp.where(feas, score, NEG)
+                best, has = _best_node(masked, tie_hash)
             if config.proportion:
                 new_alloc_cnt = jax.ops.segment_sum(
                     (placed & ~pipelined).astype(jnp.int32),
@@ -288,7 +299,10 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             # allocate if the chosen node fits Idle, else pipeline onto
             # Releasing (allocate.go:161-184: the idle-vs-releasing decision
             # happens on the already-selected best-score node)
-            chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
+            if config.use_pallas:
+                chose_idle = chose_idle_k
+            else:
+                chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
             alloc_cand = has & chose_idle
             pipe_cand = has & ~chose_idle
 

@@ -1,0 +1,125 @@
+"""Pallas TPU kernels for the auction round's hot op.
+
+`masked_best_node` fuses the per-round feasibility test + score masking +
+two-key tie-broken argmax (ops/assignment.py round_body's first half) into
+one VMEM pass per task tile: the [T, N] fit matrices are never materialized
+in HBM — req/idle/releasing live in VMEM and the fit predicate is computed
+on the fly per node tile; only the score and static-predicate matrices
+stream in, and three [T] vectors stream out.
+
+The XLA path computes the same values with fused broadcasts; this kernel
+exists to cut the intermediate [T, N] bool traffic on real TPU. It is
+opt-in (AllocateConfig.use_pallas / env KB_PALLAS=1) and falls back to
+interpret mode off-TPU so the parity tests run everywhere.
+
+Reference semantics carried over: epsilon-tolerant fit (resource_info.go:
+269-284 LessEqual), SelectBestNode's uniform tie-break among max-score nodes
+(scheduler_helper.go:147-158) via the same per-(task, node) hash as
+ops/assignment._tie_break_hash.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# plain Python float — a jnp scalar would be a captured constant, which
+# pallas_call rejects
+NEG = -3.0e38
+
+TASK_TILE = 256
+
+
+def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
+            quanta_ref, best_ref, has_ref, chose_idle_ref):
+    TM = score_ref.shape[0]
+    N = score_ref.shape[1]
+    R = req_ref.shape[1]
+
+    req = req_ref[:]                      # [TM, R]
+    quanta = quanta_ref[:]                # [1, R]
+
+    # fit[t, n] = all_r req[t, r] <= budget[n, r] + quanta[r]  (tolerant
+    # LessEqual); R is tiny and static — unrolled, no [TM, N, R] tensor
+    def fit_matrix(budget_ref):
+        fit = jnp.ones((TM, N), dtype=jnp.bool_)
+        for r in range(R):
+            fit &= req[:, r][:, None] <= budget_ref[:, r][None, :] + quanta[0, r]
+        return fit
+
+    fit_idle = fit_matrix(idle_ref)
+    fit_rel = fit_matrix(rel_ref)
+    pending = pending_ref[:]              # [TM]
+    feas = static_ref[:].astype(jnp.bool_) & (fit_idle | fit_rel) & pending[:, None]
+    masked = jnp.where(feas, score_ref[:], NEG)
+
+    # two-key argmax: exact max score, then per-(task, node) hash among ties
+    # (ops/assignment._tie_break_hash — same constants)
+    ti = (
+        jax.lax.broadcasted_iota(jnp.uint32, (TM, N), 0)
+        + jnp.uint32(pl.program_id(0) * TM)
+    )
+    ni = jax.lax.broadcasted_iota(jnp.uint32, (TM, N), 1)
+    h = ti * jnp.uint32(0x9E3779B1) + ni * jnp.uint32(0x85EBCA77)
+    h = (h ^ (h >> 15)) * jnp.uint32(0xCA87C3EB)
+    tie_hash = (h >> 16).astype(jnp.float32) / 65536.0
+
+    best_val = jnp.max(masked, axis=1)    # [TM]
+    tie = masked >= best_val[:, None]
+    best = jnp.argmax(jnp.where(tie, tie_hash, -1.0), axis=1).astype(jnp.int32)
+    col = jax.lax.broadcasted_iota(jnp.int32, (TM, N), 1)
+    chose_idle = jnp.any(fit_idle & (col == best[:, None]), axis=1)
+
+    best_ref[:] = best
+    has_ref[:] = best_val > NEG
+    chose_idle_ref[:] = chose_idle
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_best_node(
+    score: jnp.ndarray,       # [T, N] f32
+    static_ok: jnp.ndarray,   # [T, N] bool
+    task_req: jnp.ndarray,    # [T, R] f32 — InitResreq
+    idle: jnp.ndarray,        # [N, R] f32
+    releasing: jnp.ndarray,   # [N, R] f32
+    pending: jnp.ndarray,     # [T] bool
+    quanta: jnp.ndarray,      # [R] f32
+    interpret: bool = False,
+):
+    """(best [T] i32, has [T] bool, chose_idle [T] bool) — the fused round
+    head. T must be a multiple of TASK_TILE (snapshot buckets guarantee it
+    at scale; callers pad otherwise)."""
+    T, N = score.shape
+    R = task_req.shape[1]
+    tile = min(TASK_TILE, T)
+    grid = (T // tile,)
+    q2 = quanta.reshape(1, R)
+
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, N), lambda i: (i, 0)),                 # score
+            pl.BlockSpec((tile, N), lambda i: (i, 0)),                 # static_ok
+            pl.BlockSpec((tile, R), lambda i: (i, 0)),                 # req
+            pl.BlockSpec((N, R), lambda i: (0, 0)),                    # idle
+            pl.BlockSpec((N, R), lambda i: (0, 0)),                    # releasing
+            pl.BlockSpec((tile,), lambda i: (i,)),                     # pending
+            pl.BlockSpec((1, R), lambda i: (0, 0)),                    # quanta
+        ],
+        out_specs=[
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T,), jnp.int32),
+            jax.ShapeDtypeStruct((T,), jnp.bool_),
+            jax.ShapeDtypeStruct((T,), jnp.bool_),
+        ],
+        interpret=interpret,
+    )(score, static_ok, task_req, idle, releasing, pending, q2)
