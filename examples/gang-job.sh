@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The example/job.yaml analog: a 6-replica gang (PodGroup minMember=6)
+# submitted to a running scheduler's ingest API.
+#
+#   python -m kube_batch_tpu.cmd.main --listen-address 127.0.0.1:8080 &
+#   ./examples/gang-job.sh
+set -euo pipefail
+SERVER=${SERVER:-http://127.0.0.1:8080}
+
+curl -sf -XPOST "$SERVER/v1/queues" -d '{"name":"default","weight":1}' > /dev/null
+curl -sf -XPOST "$SERVER/v1/podgroups" -d '{
+  "name": "qj-1", "namespace": "default", "min_member": 6
+}' > /dev/null
+for i in $(seq 0 5); do
+  curl -sf -XPOST "$SERVER/v1/pods" -d '{
+    "name": "qj-1-'"$i"'", "namespace": "default",
+    "requests": {"cpu": 1000, "memory": 1073741824},
+    "annotations": {"scheduling.k8s.io/group-name": "qj-1"}
+  }' > /dev/null
+done
+echo "submitted gang qj-1 (minMember=6); bindings:"
+sleep 2
+curl -sf "$SERVER/v1/bindings"
+echo

@@ -94,7 +94,9 @@ class AllocateResult(NamedTuple):
 
 def _queue_gate(
     cand: jnp.ndarray,        # [T] bool — bid this round
-    rank: jnp.ndarray,        # [T] i32
+    order: jnp.ndarray,       # [T] i32 — queue-major rank-minor sort, hoisted
+    #                           out of the round loop (the (queue, rank) key
+    #                           is static per outer pass)
     task_job: jnp.ndarray,    # [T] i32
     task_queue: jnp.ndarray,  # [T] i32
     resreq: jnp.ndarray,      # [T, R]
@@ -112,9 +114,8 @@ def _queue_gate(
     wasn't overused when the chunk head arrived — the whole Statement commits
     even if it overshoots deserved, exactly like a popped gang job."""
     T, R = resreq.shape
-    # queue-major, rank-minor sort; a job's bidders are contiguous inside its
-    # queue segment because rank orders by (job_rank, subrank)
-    order = ordering.sort_by_segment_then_rank(task_queue, rank, qalloc.shape[0])
+    # a job's bidders are contiguous inside its queue segment because the
+    # hoisted order sorts by (queue, rank) and rank orders by (job, subrank)
     cs = cand[order]
     qs = task_queue[order]
     js = task_job[order]
@@ -245,6 +246,10 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             drf_enabled=config.drf,
             proportion_enabled=config.proportion,
         )
+        task_queue = snap.job_queue[snap.task_job]
+        # queue-major rank-minor sort for the proportion gate — static per
+        # outer pass, hoisted out of the rounds (one 50k-sort per round saved)
+        qgate_order = ordering.sort_by_segment_then_rank(task_queue, rank, Q)
 
         def round_cond(state):
             *_, i, progress = state
@@ -286,9 +291,9 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
                 )
                 has &= _queue_gate(
                     has,
-                    rank,
+                    qgate_order,
                     snap.task_job,
-                    snap.job_queue[snap.task_job],
+                    task_queue,
                     snap.task_resreq,
                     queue_alloc,
                     deserved,

@@ -122,6 +122,75 @@ def _overcommit_case(name, n_running=800, n_pending=400, n_nodes=100):
     return BenchCase(name, run)
 
 
+def _startup_latency_case(name, n_latency_pods=3_000, n_nodes=100, batch=100,
+                          gang_size=100, period=0.05):
+    """Pod-startup latency decomposition — the kubemark density test
+    (test/e2e/benchmark.go:53-285, doc/design kubemark target of 3k pods on
+    100 hollow nodes): start the scheduler loop, land a 100-pod gang, then
+    feed 1-milliCPU latency pods in node-count batches and report
+    create→schedule p50/p90/p99 from binder timestamps."""
+
+    def run(cycles: int) -> Dict:  # cycles unused — one density sweep
+        import threading
+        import time as _time
+
+        from kube_batch_tpu.api.pod import (
+            GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue,
+        )
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.cache.fake import FakeBinder
+        from kube_batch_tpu.scheduler import Scheduler
+
+        created: Dict[str, float] = {}
+        scheduled: Dict[str, float] = {}
+
+        class TimestampingBinder(FakeBinder):
+            def bind(self, pod, hostname):
+                scheduled[f"{pod.namespace}/{pod.name}"] = _time.perf_counter()
+                super().bind(pod, hostname)
+
+        cache = SchedulerCache(binder=TimestampingBinder())
+        cache.add_queue(Queue(name="default", weight=1))
+        for i in range(n_nodes):
+            cache.add_node(Node(name=f"n{i}", allocatable={
+                "cpu": 32000.0, "memory": float(128 << 30), "pods": 110.0}))
+        sched = Scheduler(cache, schedule_period=period)
+        t = threading.Thread(target=sched.run_forever, daemon=True)
+        t.start()
+        try:
+            # the 100-pod gang (benchmark.go:50,61-71)
+            cache.add_pod_group(PodGroup(name="density-gang", min_member=gang_size))
+            for i in range(gang_size):
+                key = f"default/gang-{i}"
+                created[key] = _time.perf_counter()
+                cache.add_pod(Pod(
+                    name=f"gang-{i}", requests={"cpu": 100.0},
+                    annotations={GROUP_NAME_ANNOTATION: "density-gang"},
+                ))
+            # latency pods in node-count batches (benchmark.go:93-140)
+            for start in range(0, n_latency_pods, batch):
+                for i in range(start, min(start + batch, n_latency_pods)):
+                    key = f"default/lat-{i}"
+                    created[key] = _time.perf_counter()
+                    cache.add_pod(Pod(name=f"lat-{i}", requests={"cpu": 1.0}))
+                _time.sleep(period)
+            deadline = _time.perf_counter() + 60
+            while len(scheduled) < len(created) and _time.perf_counter() < deadline:
+                _time.sleep(period)
+        finally:
+            sched.stop()
+            t.join(5)
+        lat_ms = [
+            (scheduled[k] - created[k]) * 1e3 for k in created if k in scheduled
+        ]
+        return {
+            "pods": len(created), "scheduled": len(lat_ms), "nodes": n_nodes,
+            **(_percentiles(lat_ms) if lat_ms else {}),
+        }
+
+    return BenchCase(name, run)
+
+
 def build_cases() -> List[BenchCase]:
     from kube_batch_tpu.ops.scoring import ScoreWeights
 
@@ -133,6 +202,7 @@ def build_cases() -> List[BenchCase]:
         _overcommit_case("preempt_reclaim_overcommit"),
         _device_case("hetero_gpu_gangs_50k_5k", 50_000, 5_000,
                      gpu_task_frac=0.2, gpu_node_frac=0.25),
+        _startup_latency_case("pod_startup_latency_kubemark"),
     ]
 
 

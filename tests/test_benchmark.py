@@ -21,3 +21,11 @@ def test_overcommit_case_tiny():
     # q1's pending gangs must trigger cross-queue reclaim of q0's running pods
     assert r["evicted"] > 0
     assert r["p50_ms"] > 0
+
+
+def test_startup_latency_case_tiny():
+    from kube_batch_tpu.testing.benchmark import _startup_latency_case
+    r = _startup_latency_case("tiny", n_latency_pods=30, n_nodes=4, batch=10,
+                              gang_size=4, period=0.02).run(1)
+    assert r["scheduled"] == r["pods"] == 34
+    assert r["p50_ms"] > 0
