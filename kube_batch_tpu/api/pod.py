@@ -86,6 +86,25 @@ class Affinity:
     )
     pod_affinity: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
     pod_anti_affinity: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
+    # preferred (soft) terms, each (weight, term) — the Priority-function
+    # inputs (CalculateNodeAffinityPriorityMap / InterPodAffinityPriority,
+    # nodeorder.go:188-247): matching terms add weight to the node's score
+    preferred_node_terms: List[
+        Tuple[float, List[Tuple[str, str, Tuple[str, ...]]]]
+    ] = dataclasses.field(default_factory=list)
+    preferred_pod_affinity: List[Tuple[float, PodAffinityTerm]] = dataclasses.field(
+        default_factory=list
+    )
+    preferred_pod_anti_affinity: List[Tuple[float, PodAffinityTerm]] = (
+        dataclasses.field(default_factory=list)
+    )
+
+    def has_preferences(self) -> bool:
+        return bool(
+            self.preferred_node_terms
+            or self.preferred_pod_affinity
+            or self.preferred_pod_anti_affinity
+        )
 
 
 @dataclasses.dataclass

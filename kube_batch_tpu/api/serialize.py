@@ -43,6 +43,18 @@ def pod_to_dict(pod: Pod) -> Dict[str, Any]:
             "pod_anti_affinity": [
                 dataclasses.asdict(t) for t in pod.affinity.pod_anti_affinity
             ],
+            "preferred_node_terms": [
+                [w, [[k, op, list(vals)] for (k, op, vals) in term]]
+                for (w, term) in pod.affinity.preferred_node_terms
+            ],
+            "preferred_pod_affinity": [
+                [w, dataclasses.asdict(t)]
+                for (w, t) in pod.affinity.preferred_pod_affinity
+            ],
+            "preferred_pod_anti_affinity": [
+                [w, dataclasses.asdict(t)]
+                for (w, t) in pod.affinity.preferred_pod_anti_affinity
+            ],
         }
     d["host_ports"] = list(pod.host_ports)
     return _clean(d)
@@ -66,6 +78,18 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
             pod_anti_affinity=[
                 PodAffinityTerm(**t)
                 for t in d["affinity"].get("pod_anti_affinity", [])
+            ],
+            preferred_node_terms=[
+                (w, [(k, op, tuple(vals)) for (k, op, vals) in term])
+                for (w, term) in d["affinity"].get("preferred_node_terms", [])
+            ],
+            preferred_pod_affinity=[
+                (w, PodAffinityTerm(**t))
+                for (w, t) in d["affinity"].get("preferred_pod_affinity", [])
+            ],
+            preferred_pod_anti_affinity=[
+                (w, PodAffinityTerm(**t))
+                for (w, t) in d["affinity"].get("preferred_pod_anti_affinity", [])
             ],
         )
     if "host_ports" in d:

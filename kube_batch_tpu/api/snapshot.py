@@ -81,6 +81,11 @@ class DeviceSnapshot(NamedTuple):
     # the host predicate re-validates against live state at replay
     task_aff_idx: "np.ndarray"      # [K] i32 — task index, -1 padding
     task_aff_mask: "np.ndarray"     # [K, N] bool — allowed nodes (padding: True)
+    # sparse preferred-affinity score rows (nodeorder.go:188-247 priorities)
+    # for the Kp tasks carrying preferred node/pod terms
+    task_pref_idx: "np.ndarray"     # [Kp] i32 — task index, -1 padding
+    task_pref_node: "np.ndarray"    # [Kp, N] f32 — preferred-node-affinity score
+    task_pref_pod: "np.ndarray"     # [Kp, N] f32 — preferred-pod-(anti)affinity score
     # nodes [N, ...]
     node_idle: "np.ndarray"         # [N, R] f32
     node_releasing: "np.ndarray"    # [N, R] f32
@@ -200,7 +205,8 @@ def build_snapshot(
     task_tol_bits = np.zeros((T, Wt), np.uint32)
     task_node = np.full(T, -1, np.int32)
     task_critical = np.zeros(T, bool)
-    aff_tasks: List[int] = []  # tasks needing an inter-pod-affinity row
+    aff_tasks: List[int] = []   # tasks needing an inter-pod-affinity row
+    pref_tasks: List[int] = []  # tasks with preferred (soft) affinity terms
     task_keys: List[str] = []
 
     taint_list = list(taint_bit.items())  # [((k,v,effect), bit)]
@@ -225,6 +231,8 @@ def build_snapshot(
             t.pod.affinity.pod_affinity or t.pod.affinity.pod_anti_affinity
         ):
             aff_tasks.append(i)
+        if t.pod.affinity is not None and t.pod.affinity.has_preferences():
+            pref_tasks.append(i)
         # required label pairs → bits: node-selector terms (MatchNodeSelector,
         # predicates.go:194-205) plus single-term node-affinity whose
         # In-requirements carry one value (necessary AND sufficient for that
@@ -350,6 +358,24 @@ def build_snapshot(
             for ni, n in enumerate(node_objs):
                 task_aff_mask[k, ni] = pod_affinity_ok(t, n, node_objs)
 
+    Kp = max(1, len(pref_tasks))
+    task_pref_idx = np.full(Kp, -1, np.int32)
+    task_pref_node = np.zeros((Kp, N), np.float32)
+    task_pref_pod = np.zeros((Kp, N), np.float32)
+    if pref_tasks:
+        from kube_batch_tpu.plugins.nodeorder import (
+            preferred_node_affinity_score,
+            preferred_pod_affinity_score,
+        )
+
+        node_objs = list(nodes)
+        for k, ti in enumerate(pref_tasks):
+            task_pref_idx[k] = ti
+            t = tasks[ti][0]
+            for ni, n in enumerate(node_objs):
+                task_pref_node[k, ni] = preferred_node_affinity_score(t, n)
+                task_pref_pod[k, ni] = preferred_pod_affinity_score(t, n, node_objs)
+
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32) if nN else np.zeros(R, np.float32)
 
     snap = DeviceSnapshot(
@@ -369,6 +395,9 @@ def build_snapshot(
         task_critical=task_critical,
         task_aff_idx=task_aff_idx,
         task_aff_mask=task_aff_mask,
+        task_pref_idx=task_pref_idx,
+        task_pref_node=task_pref_node,
+        task_pref_pod=task_pref_pod,
         node_idle=node_idle,
         node_releasing=node_releasing,
         node_used=node_used,

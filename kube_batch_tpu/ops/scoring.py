@@ -29,6 +29,7 @@ class ScoreWeights(NamedTuple):
     least_requested: float = 1.0
     balanced_resource: float = 1.0
     node_affinity: float = 1.0
+    pod_affinity: float = 1.0
     binpack: float = 0.0  # off by default, like the reference snapshot
 
 
@@ -72,12 +73,27 @@ def binpack(snap: DeviceSnapshot) -> jnp.ndarray:
     return jnp.clip(frac, 0.0, 1.0).mean(axis=-1) * MAX_PRIORITY
 
 
+def _scatter_pref(snap: DeviceSnapshot, rows: jnp.ndarray) -> jnp.ndarray:
+    """[T, N] from the sparse [Kp, N] preference rows: padding index (-1)
+    clips to row 0 with a zero update (rows are zeroed where idx < 0)."""
+    T = snap.task_req.shape[0]
+    N = snap.node_alloc.shape[0]
+    upd = jnp.where((snap.task_pref_idx >= 0)[:, None], rows, 0.0)
+    return jnp.zeros((T, N), jnp.float32).at[
+        jnp.clip(snap.task_pref_idx, 0, T - 1)
+    ].add(upd)
+
+
 def node_affinity_preferred(snap: DeviceSnapshot) -> jnp.ndarray:
-    """CalculateNodeAffinityPriorityMap analog (nodeorder.go:188-205): the
-    preferred-affinity score. Preferred terms are compiled host-side into the
-    same label-bit space; until the snapshot carries preferred-term weights
-    this contributes 0, matching a pod with no preferred affinity."""
-    return jnp.zeros((snap.task_req.shape[0], snap.node_alloc.shape[0]), jnp.float32)
+    """CalculateNodeAffinityPriorityMap analog (nodeorder.go:188-205), from
+    the host-precompiled sparse preference rows (snapshot.task_pref_node)."""
+    return _scatter_pref(snap, snap.task_pref_node)
+
+
+def pod_affinity_preferred(snap: DeviceSnapshot) -> jnp.ndarray:
+    """InterPodAffinityPriority analog — the BatchNodeOrderFn row
+    (nodeorder.go:229-247), from snapshot.task_pref_pod."""
+    return _scatter_pref(snap, snap.task_pref_pod)
 
 
 def score_matrix(snap: DeviceSnapshot, w: ScoreWeights) -> jnp.ndarray:
@@ -91,4 +107,6 @@ def score_matrix(snap: DeviceSnapshot, w: ScoreWeights) -> jnp.ndarray:
         s = s + w.binpack * binpack(snap)
     if w.node_affinity:
         s = s + w.node_affinity * node_affinity_preferred(snap)
+    if w.pod_affinity:
+        s = s + w.pod_affinity * pod_affinity_preferred(snap)
     return s

@@ -64,6 +64,9 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         task_critical=repl,
         task_aff_idx=repl,
         task_aff_mask=NamedSharding(mesh, P(None, NODE_AXIS)),
+        task_pref_idx=repl,
+        task_pref_node=NamedSharding(mesh, P(None, NODE_AXIS)),
+        task_pref_pod=NamedSharding(mesh, P(None, NODE_AXIS)),
         node_idle=node2,
         node_releasing=node2,
         node_used=node2,

@@ -40,6 +40,58 @@ def balanced_resource_score(task: TaskInfo, node: NodeInfo) -> float:
     return (1.0 - abs(fracs[0] - fracs[1])) * MAX_PRIORITY
 
 
+def _term_matches(term, labels) -> bool:
+    for key, op, values in term:
+        has = key in labels
+        if op == "In" and labels.get(key) not in values:
+            return False
+        if op == "NotIn" and labels.get(key) in values:
+            return False
+        if op == "Exists" and not has:
+            return False
+        if op == "DoesNotExist" and has:
+            return False
+    return True
+
+
+def preferred_node_affinity_score(task: TaskInfo, node: NodeInfo) -> float:
+    """CalculateNodeAffinityPriorityMap analog (nodeorder.go:188-205): sum of
+    weights of matching preferred terms. Raw weighted sum — the reference
+    normalizes to 0..10 over the batch, a monotone rescale that never changes
+    the argmax."""
+    aff = task.pod.affinity
+    if aff is None or not aff.preferred_node_terms:
+        return 0.0
+    labels = node.node.labels if node.node else {}
+    return float(sum(
+        w for w, term in aff.preferred_node_terms if _term_matches(term, labels)
+    ))
+
+
+def preferred_pod_affinity_score(task: TaskInfo, node: NodeInfo, all_nodes) -> float:
+    """InterPodAffinityPriority analog (nodeorder.go:229-247): each preferred
+    pod-affinity term adds its weight when a matching pod exists in the
+    node's topology domain; anti-affinity terms subtract."""
+    from kube_batch_tpu.plugins.predicates import _topology_domain
+
+    aff = task.pod.affinity
+    if aff is None:
+        return 0.0
+    score = 0.0
+    for sign, terms in (
+        (1.0, aff.preferred_pod_affinity),
+        (-1.0, aff.preferred_pod_anti_affinity),
+    ):
+        for w, term in terms:
+            domain = _topology_domain(node, term.topology_key, all_nodes)
+            if any(
+                term.matches(t.pod.labels)
+                for n in domain for t in n.tasks.values()
+            ):
+                score += sign * w
+    return score
+
+
 class NodeOrderPlugin(Plugin):
     name = "nodeorder"
 
@@ -47,17 +99,23 @@ class NodeOrderPlugin(Plugin):
         w_least = self.arguments.get_int(LEAST_REQUESTED_WEIGHT, 1)
         w_balanced = self.arguments.get_int(BALANCED_RESOURCE_WEIGHT, 1)
         w_affinity = self.arguments.get_int(NODE_AFFINITY_WEIGHT, 1)
+        w_pod_aff = self.arguments.get_int(POD_AFFINITY_WEIGHT, 1)
 
         ssn.score_weights = ssn.score_weights._replace(
             least_requested=float(w_least),
             balanced_resource=float(w_balanced),
             node_affinity=float(w_affinity),
+            pod_affinity=float(w_pod_aff),
         )
 
         def node_order(task: TaskInfo, node: NodeInfo) -> float:
             return (
                 w_least * least_requested_score(task, node)
                 + w_balanced * balanced_resource_score(task, node)
+                + w_affinity * preferred_node_affinity_score(task, node)
+                + w_pod_aff * preferred_pod_affinity_score(
+                    task, node, ssn.nodes.values()
+                )
             )
 
         ssn.add_fn(fw.NODE_ORDER, self.name, node_order)

@@ -121,6 +121,9 @@ def synthetic_device_snapshot(
         task_critical=np.zeros(T, bool),
         task_aff_idx=np.full(1, -1, np.int32),
         task_aff_mask=np.ones((1, N), bool),
+        task_pref_idx=np.full(1, -1, np.int32),
+        task_pref_node=np.zeros((1, N), np.float32),
+        task_pref_pod=np.zeros((1, N), np.float32),
         node_idle=node_alloc.copy(),
         node_releasing=np.zeros((N, R), np.float32),
         node_used=np.zeros((N, R), np.float32),

@@ -552,3 +552,64 @@ class TestInterPodAffinity:
         )
         run_actions(cache, action_names=["allocate"])
         assert cache.binder.binds["c1/near"] in ("n0", "n1")  # zone a only
+
+
+class TestPreferredAffinity:
+    def test_preferred_node_affinity_steers(self):
+        """e2e nodeorder.go "Node Affinity" (:29): a preferred term steers
+        placement toward the matching node without excluding others."""
+        from kube_batch_tpu.api.pod import Affinity
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("plain", cpu=8000, mem=16 * GiB),
+                   build_node("ssd", cpu=8000, mem=16 * GiB,
+                              labels={"disk": "ssd"})],
+            pods=[build_pod("c1", "p", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB}, group_name="pg",
+                            affinity=Affinity(preferred_node_terms=[
+                                (50.0, [("disk", "In", ("ssd",))])]))],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/p"] == "ssd"
+
+    def test_preferred_pod_affinity_co_locates(self):
+        """e2e nodeorder.go "Pod Affinity" (:74): soft co-location."""
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node(f"n{i}", cpu=8000, mem=16 * GiB) for i in range(4)],
+            pods=[
+                build_pod("c1", "anchor", "n2", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, labels={"app": "db"}),
+                build_pod("c1", "near", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          affinity=Affinity(preferred_pod_affinity=[
+                              (50.0, PodAffinityTerm(match_labels={"app": "db"}))])),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/near"] == "n2"
+
+    def test_preferred_pod_anti_affinity_avoids(self):
+        from kube_batch_tpu.api.pod import Affinity, PodAffinityTerm
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n0", cpu=8000, mem=16 * GiB),
+                   build_node("n1", cpu=8000, mem=16 * GiB)],
+            pods=[
+                build_pod("c1", "noisy", "n0", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, labels={"app": "noisy"}),
+                build_pod("c1", "quiet", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg",
+                          affinity=Affinity(preferred_pod_anti_affinity=[
+                              (50.0, PodAffinityTerm(match_labels={"app": "noisy"}))])),
+            ],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/quiet"] == "n1"
