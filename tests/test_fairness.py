@@ -1,0 +1,126 @@
+"""Property tests for the fairness tensors (ops/fairness.py) and their host
+twins — the cost-tensor rows SURVEY.md §4.1 calls out as trivially
+property-testable (the reference ships zero plugin unit tests)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.ops import fairness
+
+
+def _random_case(rng, Q=5, R=4):
+    total = rng.uniform(100, 10_000, R).astype(np.float32)
+    weight = rng.integers(1, 8, Q).astype(np.float32)
+    request = (total[None, :] * rng.uniform(0, 0.8, (Q, R))).astype(np.float32)
+    valid = np.ones(Q, bool)
+    return total, weight, request, valid
+
+
+class TestProportionDeserved:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_invariants(self, seed):
+        rng = np.random.default_rng(seed)
+        total, weight, request, valid = _random_case(rng)
+        d = np.asarray(
+            fairness.proportion_deserved(total, weight, request, valid)
+        )
+        # 1. never hand out more than the cluster has (per dim)
+        assert np.all(d.sum(axis=0) <= total * (1 + 1e-5) + 1e-3)
+        # 2. a met queue is capped at its request
+        met = np.all(request <= d + 1e-3, axis=-1)
+        assert np.all(d[met] <= request[met] + 1e-3)
+        # 3. non-negative
+        assert np.all(d >= 0)
+
+    def test_weighted_split_when_scarce(self):
+        """Two queues wanting everything split the cluster by weight."""
+        total = np.array([1000.0, 1000.0, 10.0, 0.0], np.float32)
+        weight = np.array([1.0, 3.0], np.float32)
+        request = np.tile(total, (2, 1)).astype(np.float32)
+        valid = np.ones(2, bool)
+        d = np.asarray(fairness.proportion_deserved(total, weight, request, valid))
+        np.testing.assert_allclose(d[0, 0], 250.0, rtol=1e-3)
+        np.testing.assert_allclose(d[1, 0], 750.0, rtol=1e-3)
+
+    def test_excess_redistributed(self):
+        """A small queue's unused share flows to the hungry queue
+        (proportion.go:101-154's cap-and-return loop)."""
+        total = np.array([1000.0, 1000.0, 10.0, 0.0], np.float32)
+        weight = np.array([1.0, 1.0], np.float32)
+        request = np.array(
+            [[100.0, 100.0, 1.0, 0.0], [1000.0, 1000.0, 9.0, 0.0]], np.float32
+        )
+        d = np.asarray(fairness.proportion_deserved(total, weight, request, valid=np.ones(2, bool)))
+        np.testing.assert_allclose(d[0, 0], 100.0, rtol=1e-3)   # capped
+        assert d[1, 0] >= 900.0 * (1 - 1e-3)                     # got the rest
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_host_twin_agrees(self, seed):
+        """plugins/proportion's numpy waterfill must match the device one."""
+        import kube_batch_tpu.plugins  # register builders
+        from kube_batch_tpu.api.pod import Node, PodGroup, Queue
+        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+        from kube_batch_tpu.api.types import PodPhase
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.framework.session import open_session
+
+        rng = np.random.default_rng(seed)
+        cache = SchedulerCache()
+        weights = [int(rng.integers(1, 5)) for _ in range(3)]
+        for q, w in enumerate(weights):
+            cache.add_queue(Queue(name=f"q{q}", weight=w))
+        for i in range(4):
+            cache.add_node(Node(name=f"n{i}", allocatable={
+                "cpu": 8000.0, "memory": float(16 << 30), "pods": 110.0}))
+        for j in range(12):
+            cache.add_pod_group(PodGroup(name=f"pg{j}", namespace="t",
+                                         min_member=1, queue=f"q{j % 3}"))
+            cache.add_pod(Pod(
+                name=f"p{j}", namespace="t",
+                requests={"cpu": float(rng.choice([500, 1000, 2000])),
+                          "memory": float(rng.choice([1, 2, 4])) * (1 << 30)},
+                annotations={GROUP_NAME_ANNOTATION: f"pg{j}"},
+                phase=PodPhase.PENDING,
+            ))
+        ssn = open_session(cache, load_scheduler_conf(None).tiers)
+        host = {
+            qn: attr.deserved.vec.astype(np.float32)
+            for p in ssn.plugins if p.name == "proportion"
+            for qn, attr in p.queue_attrs.items()
+        }
+        from kube_batch_tpu.actions.reclaim import _cluster_view
+        from kube_batch_tpu.api.snapshot import build_snapshot
+
+        snap, meta = build_snapshot(_cluster_view(ssn))
+        dev = np.asarray(fairness.proportion_deserved(
+            snap.total, snap.queue_weight, snap.queue_request, snap.queue_valid
+        ))
+        for qi, qn in enumerate(meta.queue_names):
+            np.testing.assert_allclose(dev[qi], host[qn], rtol=2e-3, atol=1.0)
+
+
+class TestShares:
+    def test_dominant_share(self):
+        alloc = np.array([[500.0, 0.0, 3.0, 0.0], [0.0, 800.0, 1.0, 0.0]], np.float32)
+        total = np.array([1000.0, 1000.0, 10.0, 0.0], np.float32)
+        s = np.asarray(fairness.dominant_share(alloc, total))
+        np.testing.assert_allclose(s, [0.5, 0.8], rtol=1e-5)
+
+    def test_queue_share_prefers_underserved(self):
+        deserved = np.array([[1000.0, 1000.0, 5.0, 0.0]] * 2, np.float32)
+        alloc = np.array(
+            [[100.0, 0.0, 1.0, 0.0], [900.0, 0.0, 1.0, 0.0]], np.float32
+        )
+        s = np.asarray(fairness.queue_share(alloc, deserved))
+        assert s[0] < s[1]
+
+    def test_overused(self):
+        deserved = np.array([[100.0, 100.0, 1.0, 0.0]], np.float32)
+        quanta = np.array([10.0, 10 << 20, 0.1, 10.0], np.float32)
+        assert bool(np.asarray(fairness.overused(
+            deserved, np.array([[200.0, 200.0, 2.0, 0.0]], np.float32), quanta))[0])
+        assert not bool(np.asarray(fairness.overused(
+            deserved, np.array([[50.0, 200.0, 2.0, 0.0]], np.float32), quanta))[0])
