@@ -75,13 +75,16 @@ class AllocateAction(Action):
                 if task is None:
                     continue
                 node_name = meta.node_names[ni]
-                # validation net: the device mask is a sound approximation of
-                # the full predicate set (rich affinity terms, host ports are
-                # host-only) — re-check each *proposed* placement, O(placed)
-                # not O(T×N)
+                # validation net: re-check a *proposed* placement only when
+                # the task carries host-only constraints (host ports, rich
+                # affinity — TaskInfo.needs_host_predicate); the device mask
+                # is exact for everything else, so the common case skips the
+                # per-placement predicate walk entirely
                 node = ssn.nodes.get(node_name)
                 try:
-                    if node is not None:
+                    if node is not None and (
+                        task.needs_host_predicate or ssn.host_only_predicates
+                    ):
                         ssn.predicate(task, node)
                     # live fit re-check: a host-fallback placement (below) may
                     # have consumed capacity the device solve promised to this

@@ -50,11 +50,13 @@ class PreemptAction(Action):
                 continue
             stmt = ssn.statement()
             for task, node_name, victim_refs in job_claims:
-                # host predicate re-check (preempt.go:191): device mask is a
-                # sound approximation of the full predicate set
+                # host predicate re-check (preempt.go:191), only for
+                # host-only constraints (see allocate replay)
                 node = ssn.nodes.get(node_name)
                 try:
-                    if node is not None:
+                    if node is not None and (
+                        task.needs_host_predicate or ssn.host_only_predicates
+                    ):
                         ssn.predicate(task, node)
                 except FitFailure:
                     continue

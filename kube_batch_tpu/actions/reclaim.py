@@ -120,11 +120,14 @@ class ReclaimAction(Action):
             task = find_task(ssn, claimant_ref)
             if task is None or not victim_refs:
                 continue
-            # host predicate re-check (reclaim.go:124): the device mask is a
-            # sound approximation — rich affinity / host ports are host-only
+            # host predicate re-check (reclaim.go:124), only for constraints
+            # the device mask approximates (rich affinity / host ports /
+            # pressure gates)
             node = ssn.nodes.get(node_name)
             try:
-                if node is not None:
+                if node is not None and (
+                    task.needs_host_predicate or ssn.host_only_predicates
+                ):
                     ssn.predicate(task, node)
             except FitFailure as e:
                 logger.info("reclaim claim %s→%s rejected by host predicate: %s",

@@ -91,6 +91,31 @@ class TaskInfo:
         placed by backfill (backfill.go:55-89)."""
         return self.init_resreq.is_empty()
 
+    @property
+    def needs_host_predicate(self) -> bool:
+        """True when the task carries constraints the device mask only
+        approximates (snapshot.py's encoding notes): host ports, inter-pod
+        (anti-)affinity, or node-affinity terms richer than one single-value
+        In term. The allocate replay re-validates only these — everything
+        else (ready/unschedulable nodes, selectors, taints, resource fit,
+        max-pods) is exact on device."""
+        pod = self.pod
+        if pod.host_ports:
+            return True
+        aff = pod.affinity
+        if aff is None:
+            return False
+        if aff.pod_affinity or aff.pod_anti_affinity:
+            return True
+        terms = aff.node_terms
+        if not terms:
+            return False
+        if len(terms) > 1:
+            return True
+        return any(
+            op != "In" or len(values) != 1 for (_, op, values) in terms[0]
+        )
+
     def clone(self) -> "TaskInfo":
         t = TaskInfo.__new__(TaskInfo)
         t.uid = self.uid

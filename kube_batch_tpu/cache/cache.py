@@ -268,6 +268,31 @@ class SchedulerCache:
             logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
             self.resync_task(task)
 
+    def bulk_bind(self, tasks_hosts) -> None:
+        """bind() for a batch under ONE lock acquisition — the Statement
+        commit of a large gang job takes this path; per-task semantics are
+        identical to bind()."""
+        with self._lock:
+            staged = []
+            for task, hostname in tasks_hosts:
+                own = self._own_task(task)
+                if own is not None:
+                    job = self.jobs[task.job]
+                    job.update_task_status(own, TaskStatus.BINDING)
+                    own.node_name = hostname
+                    node = self.nodes.get(hostname)
+                    if node is not None and own.key() not in node.tasks:
+                        node.add_task(own)
+                staged.append((task, hostname, self.pods.get(task.key())))
+        for task, hostname, pod in staged:
+            try:
+                if pod is not None:
+                    self.binder.bind(pod, hostname)
+                    self.events.append(("Scheduled", task.key(), hostname))
+            except Exception as e:  # noqa: BLE001 — resyncTask repair path
+                logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
+                self.resync_task(task)
+
     def evict(self, task: TaskInfo, reason: str) -> None:
         """(cache.go:404-444)"""
         with self._lock:

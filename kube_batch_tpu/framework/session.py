@@ -87,6 +87,9 @@ class Session:
         from kube_batch_tpu.ops.scoring import ScoreWeights
 
         self.score_weights = ScoreWeights()
+        # set by plugins whose predicates the device mask can't encode
+        # (e.g. pressure gates); forces per-placement host re-validation
+        self.host_only_predicates = False
         # PodGroup statuses as they stood at open (session.go:102-105), used
         # by the job updater to skip no-op writes
         self.pod_group_status_at_open: Dict[str, object] = {
@@ -314,6 +317,22 @@ class Statement:
 
     # -- terminal ---------------------------------------------------------
     def commit(self) -> None:
+        # eviction-free statements (the allocate action's gang commits) batch
+        # every bind under one cache lock; mixed statements replay in order
+        if not any(name == "evict" for name, _ in self.operations):
+            allocs = [args for name, args in self.operations if name == "allocate"]
+            if allocs:
+                for task, _ in allocs:
+                    self.ssn.cache.bind_volumes(task)
+                self.ssn.cache.bulk_bind(
+                    [(task, task.node_name) for task, _ in allocs]
+                )
+                for task, _ in allocs:
+                    job = self.ssn.jobs.get(task.job)
+                    if job is not None:
+                        job.update_task_status(task, TaskStatus.BINDING)
+            self.operations = []
+            return
         for name, args in self.operations:
             if name == "evict":
                 task, reason = args

@@ -132,6 +132,9 @@ class PredicatesPlugin(Plugin):
         check_mem = self.arguments.get_bool(MEMORY_PRESSURE_KEY, False)
         check_disk = self.arguments.get_bool(DISK_PRESSURE_KEY, False)
         check_pid = self.arguments.get_bool(PID_PRESSURE_KEY, False)
+        # pressure gates aren't in the device mask — when any is enabled the
+        # replay must host-validate every placement, not just flagged tasks
+        ssn.host_only_predicates = check_mem or check_disk or check_pid
 
         def predicate(task: TaskInfo, node: NodeInfo) -> None:
             if node.node is None or not node.node.ready:

@@ -182,8 +182,6 @@ class ProportionPlugin(Plugin):
 
 
 def _dominant(alloc: Resource, deserved: Resource) -> float:
-    m = alloc.spec.semantic_mask
-    d = deserved.vec[m]
-    with np.errstate(divide="ignore", invalid="ignore"):
-        r = np.where(d > 0, alloc.vec[m] / np.maximum(d, 1e-9), 0.0)
-    return float(r.max()) if r.size else 0.0
+    # max over semantic dims of alloc/deserved, 0 where deserved is 0 —
+    # exactly Resource.share's contract (native fast path)
+    return alloc.share(deserved)
