@@ -206,3 +206,12 @@ class TestPriorityAndFairness:
         ja = meta.job_uids.index("default/ja")
         assert (assigned[job_of == ja] >= 0).sum() == 3
         assert (assigned[job_of != ja] >= 0).sum() == 1
+
+
+class TestDistributed:
+    def test_initialize_noop_single_process(self):
+        from kube_batch_tpu.parallel.distributed import global_mesh, initialize
+        initialize()  # single-process: must not raise
+        mesh = global_mesh()
+        assert mesh.devices.size >= 1
+        assert mesh.axis_names == ("nodes",)
