@@ -178,7 +178,13 @@ class Resource:
         return float(self.vec[self.spec.index(name)])
 
     def clone(self) -> "Resource":
-        return Resource(self.vec.copy(), self.spec)
+        # hot in cache.snapshot's deep clone — bypass __init__'s
+        # ascontiguousarray (a copy of a contiguous f64 buffer already is one)
+        r = Resource.__new__(Resource)
+        r._vec = self._vec.copy()
+        r.spec = self.spec
+        r._addr = r._vec.ctypes.data
+        return r
 
     # -- predicates (resource_info.go:134-160) ----------------------------
     def is_empty(self) -> bool:
