@@ -34,12 +34,28 @@ def _backend_healthy(timeout_s: float = 120.0) -> bool:
         return False
 
 
+def _backend_healthy_with_retry() -> bool:
+    """Bounded retry with backoff: a wedged tunnel sometimes recovers; give it
+    two more (short) chances before falling back to a labeled CPU run.  The
+    retry probes are kept short so the worst case adds ~90s, not minutes —
+    the driver's own timeout has to cover the CPU-fallback run too."""
+    if _backend_healthy(timeout_s=120.0):
+        return True
+    for delay_s in (10.0, 20.0):
+        time.sleep(delay_s)
+        if _backend_healthy(timeout_s=30.0):
+            return True
+    return False
+
+
 if __name__ == "__main__" and os.environ.get("KB_BENCH_CHILD") != "1":
-    if not _backend_healthy():
+    if not _backend_healthy_with_retry():
         # TPU tunnel wedged: rerun ourselves on CPU so the driver still gets
         # a (clearly labeled) number instead of a hang
-        env = dict(os.environ, KB_BENCH_CHILD="1", JAX_PLATFORMS="cpu",
-                   PALLAS_AXON_POOL_IPS="", KB_BENCH_BACKEND_NOTE="cpu_fallback")
+        from kube_batch_tpu.envutil import hardened_cpu_env
+
+        env = hardened_cpu_env()
+        env.update(KB_BENCH_CHILD="1", KB_BENCH_BACKEND_NOTE="cpu_fallback")
         sys.exit(subprocess.call([sys.executable, __file__], env=env))
     os.environ["KB_BENCH_CHILD"] = "1"
 

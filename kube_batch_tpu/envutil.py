@@ -1,0 +1,61 @@
+"""Hardened-CPU environment recipe — the single owner of the axon workaround.
+
+With a wedged TPU tunnel, any jax dispatch in an unhardened process hangs
+inside axon backend init (make_c_api_client), even work that would run on
+CPU.  The recipe: JAX_PLATFORMS=cpu + PALLAS_AXON_POOL_IPS="" (so
+sitecustomize skips axon registration) + optionally a forced virtual CPU
+device count — all in place before the process's first jax import.
+
+Shared by bench.py, __graft_entry__.py and tests/conftest.py.  This module
+(and the package __init__) must stay jax-free so it can be imported before
+env hardening takes effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def hardened_cpu_env(n_devices: int | None = None, base: dict | None = None) -> dict:
+    """A copy of `base` (default os.environ) with the CPU hardening applied."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(_DEVCOUNT_FLAG)]
+        flags.append(f"{_DEVCOUNT_FLAG}={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def apply_hardened_cpu_env(n_devices: int | None = None) -> None:
+    """Mutate os.environ in place; call before the first jax import."""
+    os.environ.update(hardened_cpu_env(n_devices))
+
+
+def deregister_axon_backend() -> None:
+    """Force the CPU backend in a process whose interpreter already started
+    with the axon tunnel configured.  The env hardening above cannot help such
+    a process: sitecustomize runs before any user code, imports jax (so
+    JAX_PLATFORMS=axon is captured into jax's config defaults) and registers
+    the axon PJRT factory, whose init hangs when the tunnel is wedged.  Two
+    counter-measures, both only effective before jax's first backend init:
+    pop the axon factory, and point jax's (already-snapshotted) platform
+    config back at cpu."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        # Private API — kept separate so drift here can't disable the public
+        # config update above.
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass

@@ -1,0 +1,51 @@
+"""Multi-chip dryrun body — run as `python -m kube_batch_tpu.testing.dryrun N`.
+
+This module holds the actual mesh work for `__graft_entry__.dryrun_multichip`.
+It is designed to be executed in a *fresh child process* whose environment was
+hardened before any jax import (JAX_PLATFORMS=cpu, PALLAS_AXON_POOL_IPS="",
+XLA_FLAGS --xla_force_host_platform_device_count=N): with a wedged TPU tunnel,
+any jax dispatch in an unhardened process hangs inside axon backend init
+(make_c_api_client) — even work that would run on CPU.  Running here, after
+the env is set, is immune to that hang.
+
+Mirrors the reference's multi-core fan-out obligation (SURVEY.md §2.8, §5.7):
+the node axis is sharded over the device mesh the way scheduler_helper.go:34
+fans predicates over 16 workers.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def run(n_devices: int) -> None:
+    import jax
+
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import make_mesh, sharded_allocate_solve
+    from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
+
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())}"
+    )
+    mesh = make_mesh(n_devices)
+    snap, meta = synthetic_device_snapshot(
+        n_tasks=256, n_nodes=max(64, n_devices * 8), gang_size=4, n_queues=3,
+        gpu_task_frac=0.2,
+    )
+    result = sharded_allocate_solve(snap, AllocateConfig(), mesh)
+    assigned = np.asarray(result.assigned)[: meta.n_tasks]
+    placed = int((assigned >= 0).sum())
+    assert placed > 0, "multichip dryrun placed nothing"
+    # invariant: no node overcommitted
+    assert np.all(np.asarray(result.node_idle) >= -np.asarray(snap.quanta)[None, :])
+    print(
+        f"dryrun_multichip({n_devices}): placed {placed}/{meta.n_tasks} tasks "
+        f"across {meta.n_nodes} sharded nodes — OK"
+    )
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

@@ -7,15 +7,23 @@ before jax initializes, hence module scope here.
 """
 
 import os
+import sys
 
 # Force-override: the image exports JAX_PLATFORMS=axon (the real-TPU tunnel);
-# tests must run on the virtual 8-device CPU backend deterministically.
-# If the axon tunnel is wedged (backend init hangs at import), run pytest with
-# PALLAS_AXON_POOL_IPS= (empty) so sitecustomize skips axon registration.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# tests must run on the virtual 8-device CPU backend deterministically, and
+# with a wedged axon tunnel backend init hangs at first dispatch unless
+# PALLAS_AXON_POOL_IPS is cleared before jax import.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from kube_batch_tpu.envutil import apply_hardened_cpu_env, deregister_axon_backend  # noqa: E402
+
+# Honor a developer-supplied device count (e.g. XLA_FLAGS=...count=2 pytest
+# to reproduce a 2-device sharding bug); default to the 8-device mesh.
+_has_count = "--xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+apply_hardened_cpu_env(n_devices=None if _has_count else 8)
+# sitecustomize already ran (before conftest) — if the shell env had the axon
+# pool configured, the factory is registered and must be popped before jax's
+# first backend init or a wedged tunnel hangs even CPU work.
+deregister_axon_backend()
 
 import pytest  # noqa: E402
 
