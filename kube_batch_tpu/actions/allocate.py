@@ -3,31 +3,46 @@
 The reference's allocate (actions/allocate/allocate.go) is the
 O(tasks × nodes) host loop; here it becomes: build the device snapshot, run
 ops/assignment.allocate_solve (one compiled program: predicates, scoring,
-fairness, ordering, gang commit/discard), then replay the resulting
-assignment through the session's Statement verbs so host state, plugin event
-handlers, and the binder observe exactly the sequential semantics
-(statement.go:29-337)."""
+fairness, ordering, gang commit/discard), then apply the resulting
+assignment to host state.
+
+The apply is *vectorized*: jobs whose readiness gate is the gang arithmetic
+(JobReady ⊆ {gang}) and whose tasks carry no host-only constraints take a
+bulk path — readiness decided up front from the snapshot's ready counts
+(so discards never mutate anything), then per-job index moves and presummed
+per-node accounting (job_info/node_info bulk methods), batched event
+handlers, and one bulk_bind for every committed placement.  Jobs needing
+host-side predicate re-validation (ports, rich affinity, pressure gates) or
+nonstandard JobReady vetoes replay through the per-task Statement path with
+exactly the sequential semantics (statement.go:29-337).
+"""
 
 from __future__ import annotations
 
 import logging
-from collections import defaultdict
+import time
 from typing import Dict, List, Tuple
 
 import numpy as np
 
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.snapshot import build_snapshot
-from kube_batch_tpu.api.types import PodGroupPhase
+from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
-from kube_batch_tpu.framework.session import FitFailure
+from kube_batch_tpu.framework.session import FitFailure, JOB_READY
 from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
 logger = logging.getLogger("kube_batch_tpu")
 
+# phase breakdown of the most recent execute() on this process, milliseconds
+LAST_PHASE_MS: Dict[str, float] = {}
+
 
 class AllocateAction(Action):
     name = "allocate"
+
+    def __init__(self):
+        self.last_phase_ms: Dict[str, float] = {}
 
     def execute(self, ssn) -> None:
         # session → ClusterInfo view (the session's jobs/nodes/queues ARE the
@@ -42,7 +57,9 @@ class AllocateAction(Action):
         if not cluster.jobs or not cluster.nodes:
             return
 
+        t0 = time.perf_counter()
         snap, meta = build_snapshot(cluster)
+        t1 = time.perf_counter()
         config = AllocateConfig(
             gang=ssn.plugin_enabled("gang"),
             drf=ssn.plugin_enabled("drf"),
@@ -50,71 +67,227 @@ class AllocateAction(Action):
             weights=ssn.score_weights,
         )
         result = allocate_solve(snap, config)
-        assigned = np.asarray(result.assigned)[: meta.n_tasks]
+        assigned = np.asarray(result.assigned)[: meta.n_tasks]  # blocks on device
         pipelined = np.asarray(result.pipelined)[: meta.n_tasks]
+        t2 = time.perf_counter()
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
         pending = np.asarray(snap.task_pending)[: meta.n_tasks]
         self._record_fit_errors(ssn, meta, result, assigned, task_job, pending)
+        self._replay(ssn, snap, meta, assigned, pipelined, task_job)
+        t3 = time.perf_counter()
+        self.last_phase_ms = {
+            "snapshot_build": (t1 - t0) * 1e3,
+            "solve": (t2 - t1) * 1e3,
+            "replay": (t3 - t2) * 1e3,
+        }
+        LAST_PHASE_MS.clear()
+        LAST_PHASE_MS.update(self.last_phase_ms)
 
-        # group placements by job, in device task order
-        by_job: Dict[int, List[Tuple[str, int, bool]]] = defaultdict(list)
-        for ti in np.flatnonzero(assigned >= 0):
-            by_job[int(task_job[ti])].append(
-                (meta.task_keys[ti], int(assigned[ti]), bool(pipelined[ti]))
+    # ------------------------------------------------------------------
+    def _replay(self, ssn, snap, meta, assigned, pipelined, task_job) -> None:
+        placed = np.flatnonzero(assigned >= 0)
+        if placed.size == 0:
+            return
+        # group placements by job, preserving device task order within a job;
+        # groups are (job_idx, lo, hi) ranges over the sorted flat arrays
+        order = np.argsort(task_job[placed], kind="stable")
+        placed = placed[order]
+        pjobs = task_job[placed]
+        bounds = np.concatenate(
+            ([0], np.flatnonzero(np.diff(pjobs)) + 1, [placed.size])
+        ).tolist()
+
+        # the bulk path is sound only when the gang arithmetic is the whole
+        # JobReady gate (gang.go:122-129 delegates to job.ready(), which is
+        # exactly snapshot ready count + new allocations vs min_available)
+        gang_only_ready = ssn.enabled_plugin_names(JOB_READY) <= {"gang"}
+        nJ, nN = len(meta.job_objs), len(meta.node_names)
+        resreq64 = meta.task_resreq64
+        spec = ssn.spec
+        R = resreq64.shape[1] if resreq64.ndim == 2 else spec.n
+        pipe_flags = pipelined[placed].astype(bool)
+        n_alloc_per_job = np.bincount(pjobs[~pipe_flags], minlength=nJ)
+        committed = (
+            np.asarray(snap.job_ready)[:nJ] + n_alloc_per_job
+        ) >= np.asarray(snap.job_min_avail)[:nJ]
+        job_slow = np.zeros(nJ, bool)
+        if not gang_only_ready or ssn.host_only_predicates:
+            job_slow[:] = True
+        else:
+            np.logical_or.at(job_slow, pjobs, meta.task_needs_host[placed])
+
+        # ---- bulk path FIRST ------------------------------------------
+        # Bulk placements need no host state (the solve guarantee covers
+        # their fit, and readiness is snapshot arithmetic), while the slow
+        # path's host predicates must observe them live — an inter-pod
+        # affinity follower co-locates with an anchor this cycle only if the
+        # anchor is on the node when the follower is validated.  Host
+        # fallbacks, the one mutation the solve can't account for, then
+        # happen strictly after every bulk placement has landed.
+        #
+        # All resreq sums are computed globally up front (segment sums over
+        # the float64 resreq matrix) and the apply loop runs over plain
+        # python lists — gangs are small, so per-group numpy would pay call
+        # overhead 10k+ times for 4-row reductions.
+        placed_l = placed.tolist()
+        pjobs_l = pjobs.tolist()
+        pipe_l = pipe_flags.tolist()
+        node_l = assigned[placed].tolist()
+        slow_l = job_slow.tolist()
+        committed_l = committed.tolist()
+        task_objs = meta.task_objs
+        node_names = meta.node_names
+        n_groups = len(bounds) - 1
+
+        # volume pre-check (AllocateVolumes, session.go:252-257): a rejected
+        # group demotes to the sequential path BEFORE anything is mutated or
+        # summed, so the bulk apply below has no failure path.  Skipped
+        # wholesale when the volume binder declares itself a no-op.
+        demoted_jobs: set = set()
+        volume_noop = getattr(ssn.cache.volume_binder, "noop", False)
+        if not volume_noop:
+            allocate_volumes = ssn.cache.allocate_volumes
+            for g in range(n_groups):
+                lo = bounds[g]
+                ji = pjobs_l[lo]
+                if slow_l[ji] or not committed_l[ji]:
+                    continue
+                try:
+                    for i in range(lo, bounds[g + 1]):
+                        if not pipe_l[i]:
+                            allocate_volumes(
+                                task_objs[placed_l[i]], node_names[node_l[i]]
+                            )
+                except FitFailure:
+                    demoted_jobs.add(ji)
+
+        apply_job = np.asarray(
+            [committed[j] and not job_slow[j] and j not in demoted_jobs
+             for j in range(nJ)], bool,
+        ) if demoted_jobs else (committed & ~job_slow)
+        apply_mask = apply_job[pjobs]          # placements to bulk-apply
+        alloc_sel = apply_mask & ~pipe_flags
+        pipe_sel = apply_mask & pipe_flags
+        placed_rows = resreq64[placed]
+        node_of = assigned[placed]
+        job_alloc_sum = np.zeros((nJ, R))
+        np.add.at(job_alloc_sum, pjobs[alloc_sel], placed_rows[alloc_sel])
+        job_total_sum = np.zeros((nJ, R))
+        np.add.at(job_total_sum, pjobs[apply_mask], placed_rows[apply_mask])
+        node_alloc_sum = np.zeros((nN, R))
+        np.add.at(node_alloc_sum, node_of[alloc_sel], placed_rows[alloc_sel])
+        node_pipe_sum = np.zeros((nN, R))
+        np.add.at(node_pipe_sum, node_of[pipe_sel], placed_rows[pipe_sel])
+
+        EMPTY = spec.empty()
+        apply_l = apply_job.tolist()
+        wrap_vec = spec.wrap_vec
+        binds: List[Tuple[object, str]] = []
+        by_node: Dict[int, Tuple[list, list]] = {}
+
+        for g in range(n_groups):
+            lo, hi = bounds[g], bounds[g + 1]
+            ji = pjobs_l[lo]
+            if not apply_l[ji]:
+                continue
+            job = meta.job_objs[ji]
+            alloc_tasks: list = []
+            pipe_tasks: list = []
+            for i in range(lo, hi):
+                t = task_objs[placed_l[i]]
+                ni = node_l[i]
+                t.node_name = node_names[ni]
+                slot = by_node.get(ni)
+                if slot is None:
+                    slot = by_node[ni] = ([], [])
+                if pipe_l[i]:
+                    pipe_tasks.append(t)
+                    slot[1].append(t)
+                else:
+                    alloc_tasks.append(t)
+                    slot[0].append(t)
+                    binds.append((t, t.node_name))
+            # committed & ready → every new allocation dispatches immediately
+            # (session.go:286-294); BINDING directly, skipping the
+            # ALLOCATED→BINDING index churn
+            job.bulk_transition(alloc_tasks, TaskStatus.BINDING,
+                                wrap_vec(job_alloc_sum[ji]))
+            job.bulk_transition(pipe_tasks, TaskStatus.PIPELINED, EMPTY)
+            ssn.fire_batch_allocations(job, alloc_tasks + pipe_tasks,
+                                       wrap_vec(job_total_sum[ji]))
+
+        # per-node accounting with the presummed rows (node_info.go:165-222
+        # algebra, two vector ops per node instead of two per task)
+        for ni, (allocs, pipes) in by_node.items():
+            node = ssn.nodes.get(node_names[ni])
+            if node is None:
+                continue
+            node.bulk_add_tasks(
+                allocs, pipes,
+                spec.wrap_vec(node_alloc_sum[ni]), spec.wrap_vec(node_pipe_sum[ni]),
             )
 
-        # replay through Statement per job — host is authoritative for the
-        # commit gate (JobReady, allocate.go:192-196)
-        for ji, placements in by_job.items():
-            job = ssn.jobs.get(meta.job_uids[ji])
-            if job is None:
-                continue
-            stmt = ssn.statement()
-            for task_key, ni, pipe in placements:
-                task = job.tasks.get(task_key)
-                if task is None:
-                    continue
-                node_name = meta.node_names[ni]
-                # validation net: re-check a *proposed* placement only when
-                # the task carries host-only constraints (host ports, rich
-                # affinity — TaskInfo.needs_host_predicate); the device mask
-                # is exact for everything else, so the common case skips the
-                # per-placement predicate walk entirely
-                node = ssn.nodes.get(node_name)
-                try:
-                    if node is not None and (
-                        task.needs_host_predicate or ssn.host_only_predicates
-                    ):
-                        ssn.predicate(task, node)
-                    # live fit re-check: a host-fallback placement (below) may
-                    # have consumed capacity the device solve promised to this
-                    # placement; node.add_task does not re-verify fit
-                    if node is not None and not (
-                        (not pipe and task.init_resreq.less_equal(node.idle))
-                        or (pipe and task.init_resreq.less_equal(node.releasing))
-                    ):
-                        raise FitFailure("node resources taken by host fallback")
-                except FitFailure as e:
-                    logger.info("device placement %s→%s rejected by host predicate: %s",
-                                task_key, node_name, e.reason)
-                    # the device would re-propose the same node next cycle
-                    # (the solve is deterministic), so fall back to the
-                    # reference's own sequential path for this task
-                    self._host_place(ssn, stmt, task)
-                    continue
-                if pipe:
-                    stmt.pipeline(task, node_name)
-                else:
-                    stmt.allocate(task, node_name)
-            if ssn.job_ready(job):
-                stmt.commit()
-            else:
-                logger.info(
-                    "job %s not ready after device solve (%d placements), discarding",
-                    job.uid,
-                    len(placements),
+        if binds:
+            # BindVolumes precedes every dispatch (statement.go:253-277)
+            if not volume_noop:
+                bind_volumes = ssn.cache.bind_volumes
+                for t, _ in binds:
+                    bind_volumes(t)
+            ssn.cache.bulk_bind(binds)
+
+        # slow path after every bulk placement has landed: host predicates
+        # observe them; jobs the bulk path demoted replay sequentially too
+        for g in range(n_groups):
+            ji = pjobs_l[bounds[g]]
+            if slow_l[ji] or ji in demoted_jobs:
+                self._slow_replay_job(
+                    ssn, meta, assigned, pipelined, ji, placed[bounds[g]:bounds[g + 1]]
                 )
-                stmt.discard()
+
+    # ------------------------------------------------------------------
+    def _slow_replay_job(self, ssn, meta, assigned, pipelined, ji, idxs) -> None:
+        """Per-task Statement replay — host is authoritative for the commit
+        gate (JobReady, allocate.go:192-196) and for every predicate."""
+        job = meta.job_objs[ji]
+        stmt = ssn.statement()
+        for ti in idxs:
+            task = meta.task_objs[int(ti)]
+            node_name = meta.node_names[int(assigned[ti])]
+            pipe = bool(pipelined[ti])
+            node = ssn.nodes.get(node_name)
+            try:
+                if node is not None and (
+                    task.needs_host_predicate or ssn.host_only_predicates
+                ):
+                    ssn.predicate(task, node)
+                # live fit re-check: a host-fallback placement may have
+                # consumed capacity the device solve promised to this
+                # placement; node.add_task does not re-verify fit
+                if node is not None and not (
+                    (not pipe and task.init_resreq.less_equal(node.idle))
+                    or (pipe and task.init_resreq.less_equal(node.releasing))
+                ):
+                    raise FitFailure("node resources taken by host fallback")
+            except FitFailure as e:
+                logger.info("device placement %s→%s rejected by host predicate: %s",
+                            task.key(), node_name, e.reason)
+                # the device would re-propose the same node next cycle
+                # (the solve is deterministic), so fall back to the
+                # reference's own sequential path for this task
+                self._host_place(ssn, stmt, task)
+                continue
+            if pipe:
+                stmt.pipeline(task, node_name)
+            else:
+                stmt.allocate(task, node_name)
+        if ssn.job_ready(job):
+            stmt.commit()
+        else:
+            logger.info(
+                "job %s not ready after device solve (%d placements), discarding",
+                job.uid, int(idxs.size),
+            )
+            stmt.discard()
 
     def _record_fit_errors(self, ssn, meta, result, assigned, task_job, pending) -> None:
         """FitErrors for unplaced pending tasks (allocate.go:151-155). The
@@ -128,11 +301,9 @@ class AllocateAction(Action):
             return
         hist = np.asarray(result.fail_hist)[: meta.n_tasks]
         for ti in unplaced:
-            job = ssn.jobs.get(meta.job_uids[int(task_job[ti])])
-            if job is None:
-                continue
-            task = job.tasks.get(meta.task_keys[int(ti)])
-            if task is None:
+            job = meta.job_objs[int(task_job[ti])]
+            task = meta.task_objs[int(ti)]
+            if job is None or task is None:
                 continue
             counts = dict(zip(REASON_MESSAGES, hist[ti].tolist()))
             if not any(counts.values()):

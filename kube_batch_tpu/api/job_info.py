@@ -130,6 +130,41 @@ class JobInfo:
         task.status = status
         self.add_task(task)
 
+    def bulk_transition(self, tasks, status: TaskStatus, resreq_sum) -> None:
+        """Batched update_task_status for the vectorized allocate replay:
+        move `tasks` (members of this job) to `status`, with `resreq_sum` the
+        presummed Resource over those whose allocated-ness flips.  End state
+        is identical to calling update_task_status per task; the per-task
+        Resource add_/sub_ churn (delete+add cancels on total_request, and
+        allocated changes only on the is_allocated flip) collapses into one
+        vector op."""
+        if not tasks:
+            return
+        new_alloc = is_allocated(status)
+        idx = self.task_status_index
+        new_bucket = idx[status]
+        flipped = 0
+        for task in tasks:
+            key = task._key
+            bucket = idx.get(task.status)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket and bucket is not new_bucket:
+                    del idx[task.status]
+            if is_allocated(task.status) != new_alloc:
+                flipped += 1
+            task.status = status
+            new_bucket[key] = task
+        if flipped:
+            graft_assert(
+                flipped == len(tasks),
+                f"bulk_transition: mixed allocated-ness flip in job {self.uid}",
+            )
+            if new_alloc:
+                self.allocated.add_(resreq_sum)
+            else:
+                self.allocated.sub_(resreq_sum)
+
     # -- gang predicates (job_info.go:367-418) ----------------------------
     def task_num(self, *statuses: TaskStatus) -> int:
         return sum(len(self.task_status_index.get(s, {})) for s in statuses)
@@ -189,8 +224,15 @@ class JobInfo:
         j.min_available = self.min_available
         j.creation_index = self.creation_index
         j.pod_group = self.pod_group.clone() if self.pod_group else None
-        for t in self.tasks.values():
-            j.add_task(t.clone())
+        # direct index rebuild: add_task's per-task aggregate arithmetic
+        # telescopes to a wholesale copy of the two ledgers (the clone is
+        # exact by construction — hot in cache.snapshot at 50k tasks)
+        for key, t in self.tasks.items():
+            c = t.clone()
+            j.tasks[key] = c
+            j.task_status_index[c.status][key] = c
+        j.allocated = self.allocated.clone()
+        j.total_request = self.total_request.clone()
         return j
 
     def __repr__(self) -> str:

@@ -126,6 +126,12 @@ class ResourceSpec:
     def from_vec(self, vec: np.ndarray) -> "Resource":
         return Resource(np.asarray(vec, dtype=np.float64).copy(), self)
 
+    def wrap_vec(self, vec: np.ndarray) -> "Resource":
+        """Resource over `vec` WITHOUT copying — for freshly-computed rows the
+        caller owns and will not mutate (the allocate replay's segment sums).
+        Use from_vec for foreign arrays."""
+        return Resource(vec, self)
+
 
 DEFAULT_SPEC = ResourceSpec()
 

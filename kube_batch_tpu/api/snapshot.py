@@ -130,6 +130,17 @@ class SnapshotMeta:
     n_nodes: int
     n_jobs: int
     n_queues: int
+    # direct object references in device-index order (the session's own
+    # objects) — the vectorized allocate replay addresses placements by index
+    # instead of per-placement dict lookups
+    task_objs: List = dataclasses.field(default_factory=list)
+    job_objs: List = dataclasses.field(default_factory=list)
+    node_objs: List = dataclasses.field(default_factory=list)
+    # [nT, R] float64 resreq (NOT init_resreq, and not the f32 device cast) —
+    # segment sums over this match the host Resource ledgers bit-exactly
+    task_resreq64: "np.ndarray" = None
+    # [nT] bool — task carries host-only constraints (ports, rich affinity)
+    task_needs_host: "np.ndarray" = None
 
     @property
     def shape(self) -> Tuple[int, int, int, int]:
@@ -212,11 +223,18 @@ def build_snapshot(
     taint_list = list(taint_bit.items())  # [((k,v,effect), bit)]
     # columnar bulk fill (list comprehensions + one numpy write per column —
     # ~5× faster than a per-task field loop at the 50k scale)
+    task_objs: List = []
+    task_resreq64 = np.zeros((nT, R), np.float64)
+    task_needs_host = np.zeros(nT, bool)
     if nT:
         task_objs = [t for t, _ in tasks]
         task_keys.extend(t.key() for t in task_objs)
         task_req[:nT] = np.stack([t.init_resreq.vec for t in task_objs])
-        task_resreq[:nT] = np.stack([t.resreq.vec for t in task_objs])
+        task_resreq64 = np.stack([t.resreq.vec for t in task_objs]).astype(np.float64)
+        task_resreq[:nT] = task_resreq64
+        task_needs_host = np.fromiter(
+            (t.needs_host_predicate for t in task_objs), bool, count=nT
+        )
         task_job[:nT] = [ji for _, ji in tasks]
         task_prio[:nT] = [t.priority for t in task_objs]
         task_creation[:nT] = [t.pod.creation_index for t in task_objs]
@@ -454,6 +472,11 @@ def build_snapshot(
         n_nodes=nN,
         n_jobs=nJ,
         n_queues=nQ,
+        task_objs=task_objs,
+        job_objs=list(jobs),
+        node_objs=list(nodes),
+        task_resreq64=task_resreq64,
+        task_needs_host=task_needs_host,
     )
     return snap, meta
 

@@ -64,6 +64,7 @@ class TaskInfo:
         "priority",
         "volume_ready",
         "pod",
+        "_key",
     )
 
     def __init__(self, pod: Pod, spec: ResourceSpec):
@@ -83,6 +84,7 @@ class TaskInfo:
         self.priority: int = pod.priority
         self.volume_ready: bool = False
         self.pod: Pod = pod
+        self._key: str = f"{pod.namespace}/{pod.name}"
 
     @property
     def best_effort(self) -> bool:
@@ -117,22 +119,30 @@ class TaskInfo:
         )
 
     def clone(self) -> "TaskInfo":
+        """Copy with value semantics for the mutable fields (status,
+        node_name).  resreq/init_resreq are SHARED, not copied: a task's
+        request vectors are frozen at ingest (nothing in the tree mutates
+        them in place — accounting always happens on node/job ledgers), and
+        cloning them was the dominant cost of the cache snapshot and of the
+        node-side task copies at the 50k scale.  Anyone adding in-place
+        mutation of task resreq must restore the deep copy here."""
         t = TaskInfo.__new__(TaskInfo)
         t.uid = self.uid
         t.job = self.job
         t.name = self.name
         t.namespace = self.namespace
-        t.resreq = self.resreq.clone()
-        t.init_resreq = self.init_resreq.clone()
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
         t.node_name = self.node_name
         t.status = self.status
         t.priority = self.priority
         t.volume_ready = self.volume_ready
         t.pod = self.pod
+        t._key = self._key
         return t
 
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        return self._key
 
     def __repr__(self) -> str:
         return (

@@ -16,6 +16,8 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from kube_batch_tpu.api.cluster_info import ClusterInfo
 from kube_batch_tpu.api.job_info import JobInfo
 from kube_batch_tpu.api.node_info import NodeInfo
@@ -23,7 +25,7 @@ from kube_batch_tpu.api.pod import Node, Pod, PodGroup, PriorityClass, Queue
 from kube_batch_tpu.api.queue_info import QueueInfo
 from kube_batch_tpu.api.resources import DEFAULT_SPEC, ResourceSpec
 from kube_batch_tpu.api.task_info import TaskInfo, job_id_for_pod
-from kube_batch_tpu.api.types import TaskStatus
+from kube_batch_tpu.api.types import TaskStatus, is_allocated
 from kube_batch_tpu.cache.fake import (
     FakeBinder,
     FakeEvictor,
@@ -72,6 +74,11 @@ class SchedulerCache:
         self.pod_conditions: Dict[str, dict] = {}
         # per-job earliest next condition-only status write (job_updater.go:20-31)
         self._status_next_write: Dict[str, float] = {}
+        # async dispatcher for binder calls (the `go func` at cache.go:478):
+        # cache bookkeeping stays under the lock, the API write happens off
+        # the scheduling cycle; failures re-enter via resync_task
+        self._dispatch_pool = None
+        self._dispatch_futures: List = []
 
     # ------------------------------------------------------------------
     # ingest: pods (event_handlers.go:42-200)
@@ -269,29 +276,81 @@ class SchedulerCache:
             self.resync_task(task)
 
     def bulk_bind(self, tasks_hosts) -> None:
-        """bind() for a batch under ONE lock acquisition — the Statement
-        commit of a large gang job takes this path; per-task semantics are
-        identical to bind()."""
+        """bind() for a batch under ONE lock acquisition — the allocate
+        replay's commit takes this path with every placement of the cycle;
+        per-task semantics are identical to bind().  Job and node accounting
+        are applied groupwise (bulk_transition / bulk_add_tasks) with
+        presummed resreq, so the per-task work is the dict moves and the
+        binder call."""
         with self._lock:
             staged = []
+            jobs_get = self.jobs.get
+            nodes_get = self.nodes.get
+            pods_get = self.pods.get
+            by_job: Dict[str, list] = {}
+            by_node: Dict[str, list] = {}
             for task, hostname in tasks_hosts:
-                own = self._own_task(task)
+                key = task._key
+                job = jobs_get(task.job)
+                own = job.tasks.get(key) if job is not None else None
                 if own is not None:
-                    job = self.jobs[task.job]
-                    job.update_task_status(own, TaskStatus.BINDING)
                     own.node_name = hostname
-                    node = self.nodes.get(hostname)
-                    if node is not None and own.key() not in node.tasks:
-                        node.add_task(own)
-                staged.append((task, hostname, self.pods.get(task.key())))
-        for task, hostname, pod in staged:
-            try:
-                if pod is not None:
-                    self.binder.bind(pod, hostname)
-                    self.events.append(("Scheduled", task.key(), hostname))
-            except Exception as e:  # noqa: BLE001 — resyncTask repair path
-                logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
-                self.resync_task(task)
+                    lst = by_job.get(task.job)
+                    if lst is None:
+                        lst = by_job[task.job] = []
+                    lst.append(own)
+                    node = nodes_get(hostname)
+                    if node is not None and key not in node.tasks:
+                        nlst = by_node.get(hostname)
+                        if nlst is None:
+                            nlst = by_node[hostname] = []
+                        nlst.append(own)
+                staged.append((task, hostname, pods_get(key)))
+            for job_uid, owns in by_job.items():
+                job = self.jobs[job_uid]
+                # bulk_transition needs a homogeneous allocated-ness flip;
+                # a rebound task may already carry an allocated status
+                flip = [t for t in owns if not is_allocated(t.status)]
+                noflip = [t for t in owns if is_allocated(t.status)]
+                if flip:
+                    s = self.spec.wrap_vec(np.sum([t.resreq.vec for t in flip], axis=0))
+                    job.bulk_transition(flip, TaskStatus.BINDING, s)
+                if noflip:
+                    job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
+            for hostname, owns in by_node.items():
+                node = self.nodes[hostname]
+                s = self.spec.wrap_vec(np.sum([t.resreq.vec for t in owns], axis=0))
+                node.bulk_add_tasks(owns, [], s, self.spec.empty())
+        self._dispatch_async(staged)
+
+    def _dispatch_async(self, staged) -> None:
+        """Run the binder calls off-cycle (the async goroutine,
+        cache.go:478-484); cache state was already updated under the lock."""
+        def run():
+            for task, hostname, pod in staged:
+                try:
+                    if pod is not None:
+                        self.binder.bind(pod, hostname)
+                        self.events.append(("Scheduled", task._key, hostname))
+                except Exception as e:  # noqa: BLE001 — resyncTask repair path
+                    logger.error("bind of %s to %s failed: %s", task._key, hostname, e)
+                    self.resync_task(task)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._dispatch_pool is None:
+            self._dispatch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kb-dispatch"
+            )
+        self._dispatch_futures = [f for f in self._dispatch_futures if not f.done()]
+        self._dispatch_futures.append(self._dispatch_pool.submit(run))
+
+    def flush_binds(self, timeout: Optional[float] = None) -> None:
+        """Wait for every in-flight async binder call — tests and the bench
+        use this to observe a deterministic post-cycle state."""
+        for f in list(self._dispatch_futures):
+            f.result(timeout=timeout)
+        self._dispatch_futures = [f for f in self._dispatch_futures if not f.done()]
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """(cache.go:404-444)"""

@@ -57,9 +57,20 @@ class Event:
 
 
 class EventHandler:
-    def __init__(self, allocate_func=None, deallocate_func=None):
+    """Allocate/Deallocate hooks (framework/event.go:24-32).
+
+    `batch_allocate_func(job, tasks, total_resreq)` is an optional
+    TPU-rebuild extension: a handler whose per-task effect is linear in
+    task.resreq (drf's job share, proportion's queue allocation) can expose
+    one call per job with the presummed resreq, letting the vectorized
+    allocate replay skip the per-task event loop. Handlers without it are
+    fired per task even on the bulk path — semantics never depend on it."""
+
+    def __init__(self, allocate_func=None, deallocate_func=None,
+                 batch_allocate_func=None):
         self.allocate_func = allocate_func
         self.deallocate_func = deallocate_func
+        self.batch_allocate_func = batch_allocate_func
 
 
 class FitFailure(Exception):
@@ -120,6 +131,18 @@ class Session:
 
     def plugin_enabled(self, name: str) -> bool:
         return any(opt.name == name for tier in self.tiers for opt in tier.plugins)
+
+    def enabled_plugin_names(self, kind: str) -> set:
+        """Names of plugins with an enabled fn of `kind` registered — lets the
+        vectorized allocate replay prove the gang arithmetic gate is the only
+        JobReady veto before taking the fast path."""
+        fns = self._fns.get(kind, {})
+        return {
+            opt.name
+            for tier in self.tiers
+            for opt in tier.plugins
+            if opt.name in fns and self._enabled(kind, opt)
+        }
 
     # ---- tiered dispatch ------------------------------------------------
     def _order(self, kind: str, l, r, l_info: Tuple, r_info: Tuple) -> bool:
@@ -214,6 +237,17 @@ class Session:
             fn = eh.allocate_func if allocate else eh.deallocate_func
             if fn is not None:
                 fn(Event(task))
+
+    def fire_batch_allocations(self, job: JobInfo, tasks, total_resreq) -> None:
+        """Fire allocate events for `tasks` (all of one job) — one call per
+        handler that supports batching (with `total_resreq` presummed over the
+        tasks), the per-task loop for handlers that don't."""
+        for eh in self.event_handlers:
+            if eh.batch_allocate_func is not None:
+                eh.batch_allocate_func(job, tasks, total_resreq)
+            elif eh.allocate_func is not None:
+                for t in tasks:
+                    eh.allocate_func(Event(t))
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         job = self.jobs.get(task.job)

@@ -97,10 +97,18 @@ class DrfPlugin(Plugin):
                 attr.allocated.sub_(event.task.resreq)
                 self._update_share(attr)
 
+        def on_batch_allocate(job: JobInfo, tasks, total_resreq) -> None:
+            # linear in resreq: one presummed add per job ≡ per-task events
+            attr = self.job_attrs.get(job.uid)
+            if attr is not None:
+                attr.allocated.add_(total_resreq)
+                self._update_share(attr)
+
         ssn.add_fn(fw.PREEMPTABLE, self.name, preemptable)
         ssn.add_fn(fw.JOB_ORDER, self.name, job_order)
         ssn.add_event_handler(
-            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
+                            batch_allocate_func=on_batch_allocate)
         )
 
     def on_session_close(self, ssn: fw.Session) -> None:

@@ -139,12 +139,20 @@ class ProportionPlugin(Plugin):
                 attr.allocated.sub_(event.task.resreq)
                 self._update_share(attr)
 
+        def on_batch_allocate(job: JobInfo, tasks, total_resreq) -> None:
+            # linear in resreq: one presummed add per queue ≡ per-task events
+            if job.queue in self.queue_attrs:
+                attr = self.queue_attrs[job.queue]
+                attr.allocated.add_(total_resreq)
+                self._update_share(attr)
+
         ssn.add_fn(fw.QUEUE_ORDER, self.name, queue_order)
         ssn.add_fn(fw.RECLAIMABLE, self.name, reclaimable)
         ssn.add_fn(fw.OVERUSED, self.name, overused_fn)
         ssn.add_fn(fw.JOB_ENQUEUEABLE, self.name, job_enqueueable)
         ssn.add_event_handler(
-            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate)
+            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
+                            batch_allocate_func=on_batch_allocate)
         )
 
     def _waterfill(self, spec) -> None:

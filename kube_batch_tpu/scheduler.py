@@ -53,6 +53,11 @@ class Scheduler:
         finally:
             close_session(ssn)
         metrics.observe_e2e_latency((time.perf_counter() - start) * 1e3)
+        # drain async binder dispatch (cache.go:478's goroutines) outside the
+        # measured cycle so callers observe a deterministic post-cycle state
+        flush = getattr(self.cache, "flush_binds", None)
+        if flush is not None:
+            flush()
         if self.on_cycle_end is not None:
             self.on_cycle_end()
 

@@ -36,6 +36,7 @@ def run_actions(cache, conf_text=TWO_TIER_CONF, action_names=None):
     for name in action_names or conf.actions:
         get_action(name).execute(ssn)
     close_session(ssn)
+    cache.flush_binds()  # binder dispatch is async (cache.go:478)
     return ssn
 
 
