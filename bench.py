@@ -64,7 +64,8 @@ if __name__ == "__main__" and os.environ.get("KB_BENCH_CHILD") != "1":
 
 import numpy as np  # noqa: E402
 
-from kube_batch_tpu.actions import allocate as alloc_mod  # noqa: E402
+from kube_batch_tpu import actions as _actions  # noqa: E402,F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: E402,F401 — registers
 from kube_batch_tpu.framework.conf import load_scheduler_conf  # noqa: E402
 from kube_batch_tpu.framework.session import close_session, open_session  # noqa: E402
 from kube_batch_tpu.framework.interface import get_action  # noqa: E402
@@ -92,7 +93,7 @@ def one_cycle(conf, cache):
     phases["close_session"] = (time.perf_counter() - t0) * 1e3
     # fold the allocate-internal breakdown in (snapshot build / device solve /
     # host replay) — recorded by the action itself
-    for k, v in alloc_mod.LAST_PHASE_MS.items():
+    for k, v in get_action("allocate").last_phase_ms.items():
         phases[f"allocate_{k}"] = v
     t0 = time.perf_counter()
     cache.flush_binds()

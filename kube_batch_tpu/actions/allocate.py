@@ -20,6 +20,7 @@ exactly the sequential semantics (statement.go:29-337).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Tuple
 
@@ -34,17 +35,28 @@ from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
 logger = logging.getLogger("kube_batch_tpu")
 
-# phase breakdown of the most recent execute() on this process, milliseconds
-LAST_PHASE_MS: Dict[str, float] = {}
+def _pallas_enabled(ssn) -> bool:
+    """Opt into the fused Pallas round-head kernel via an `allocate.pallas`
+    argument on any conf tier plugin (Arguments are free-form string maps,
+    arguments.go:26-66) or env KB_PALLAS=1 (pallas_kernels.py)."""
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            v = opt.arguments.get("allocate.pallas")
+            if v is not None:
+                return str(v).strip().lower() in ("1", "true", "yes")
+    return os.environ.get("KB_PALLAS", "").lower() in ("1", "true", "yes")
 
 
 class AllocateAction(Action):
     name = "allocate"
 
     def __init__(self):
+        # per-phase ms of the most recent execute() — read by bench.py via
+        # get_action("allocate").last_phase_ms
         self.last_phase_ms: Dict[str, float] = {}
 
     def execute(self, ssn) -> None:
+        self.last_phase_ms = {}
         # session → ClusterInfo view (the session's jobs/nodes/queues ARE the
         # snapshot clone; invalid jobs were already dropped at open). ALL jobs
         # are included so fairness state (queue_alloc/job_allocated) counts
@@ -64,6 +76,7 @@ class AllocateAction(Action):
             gang=ssn.plugin_enabled("gang"),
             drf=ssn.plugin_enabled("drf"),
             proportion=ssn.plugin_enabled("proportion"),
+            use_pallas=_pallas_enabled(ssn),
             weights=ssn.score_weights,
         )
         result = allocate_solve(snap, config)
@@ -80,8 +93,6 @@ class AllocateAction(Action):
             "solve": (t2 - t1) * 1e3,
             "replay": (t3 - t2) * 1e3,
         }
-        LAST_PHASE_MS.clear()
-        LAST_PHASE_MS.update(self.last_phase_ms)
 
     # ------------------------------------------------------------------
     def _replay(self, ssn, snap, meta, assigned, pipelined, task_job) -> None:
@@ -107,9 +118,14 @@ class AllocateAction(Action):
         R = resreq64.shape[1] if resreq64.ndim == 2 else spec.n
         pipe_flags = pipelined[placed].astype(bool)
         n_alloc_per_job = np.bincount(pjobs[~pipe_flags], minlength=nJ)
-        committed = (
-            np.asarray(snap.job_ready)[:nJ] + n_alloc_per_job
-        ) >= np.asarray(snap.job_min_avail)[:nJ]
+        if ssn.plugin_enabled("gang"):
+            committed = (
+                np.asarray(snap.job_ready)[:nJ] + n_alloc_per_job
+            ) >= np.asarray(snap.job_min_avail)[:nJ]
+        else:
+            # no gang plugin ⇒ JobReady is vacuously true (veto dispatch over
+            # zero fns, session_plugins.go:202-220): every placement commits
+            committed = np.ones(nJ, bool)
         job_slow = np.zeros(nJ, bool)
         if not gang_only_ready or ssn.host_only_predicates:
             job_slow[:] = True
@@ -201,6 +217,13 @@ class AllocateAction(Action):
                 if slot is None:
                     slot = by_node[ni] = ([], [])
                 if pipe_l[i]:
+                    # pipeline-on-releasing ⇒ the task did NOT fit Idle:
+                    # record the shortfall diagnostic (allocate.go:170-175)
+                    pnode = ssn.nodes.get(t.node_name)
+                    if pnode is not None:
+                        job.nodes_fit_delta[t.node_name] = (
+                            t.init_resreq.fit_delta(pnode.idle)
+                        )
                     pipe_tasks.append(t)
                     slot[1].append(t)
                 else:
@@ -277,6 +300,10 @@ class AllocateAction(Action):
                 self._host_place(ssn, stmt, task)
                 continue
             if pipe:
+                if node is not None:
+                    job.nodes_fit_delta[node_name] = (
+                        task.init_resreq.fit_delta(node.idle)
+                    )
                 stmt.pipeline(task, node_name)
             else:
                 stmt.allocate(task, node_name)
@@ -341,5 +368,10 @@ class AllocateAction(Action):
         if task.init_resreq.less_equal(best.idle):
             stmt.allocate(task, best.name)
         else:
+            job = ssn.jobs.get(task.job)
+            if job is not None:
+                job.nodes_fit_delta[best.name] = (
+                    task.init_resreq.fit_delta(best.idle)
+                )
             stmt.pipeline(task, best.name)
         return True

@@ -101,9 +101,29 @@ class PreemptAction(Action):
             running = job.task_status_index.get(TaskStatus.RUNNING, {})
             if not pending or not running:
                 continue
-            if max(t.priority for t in pending.values()) <= min(
-                t.priority for t in running.values()
-            ):
+            # cheap skip: the reference runs phase 2 unconditionally
+            # (preempt.go:145-174); we gate on the tiered task-order plugin
+            # verdict — preempt only when some enabled plugin (priority, or a
+            # custom task_order) says the best pending task outranks the
+            # worst running one. The creation-index tie-break deliberately
+            # does NOT open the gate: evicting an equal-rank sibling for its
+            # slot is zero-gain work.
+            to = ssn.task_order_fn
+            best_p = None
+            for t in pending.values():
+                if best_p is None or to(t, best_p):
+                    best_p = t
+            worst_r = None
+            for t in running.values():
+                if worst_r is None or to(worst_r, t):
+                    worst_r = t
+            verdict = ssn.task_order_plugin_verdict(best_p, worst_r)
+            if verdict == 0:
+                # no task-order plugin voted (e.g. priority disabled in
+                # conf): fall back to the raw pod-priority comparison so
+                # preemption doesn't go inert
+                verdict = -1 if best_p.priority > worst_r.priority else 1
+            if verdict >= 0:
                 continue  # nothing to rebalance
             tq = PriorityQueue(less=ssn.task_order_fn)
             for task in pending.values():

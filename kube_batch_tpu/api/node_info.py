@@ -19,6 +19,8 @@ from __future__ import annotations
 import itertools
 from typing import Dict, Optional
 
+import numpy as np
+
 from kube_batch_tpu.api.pod import Node
 from kube_batch_tpu.api.resources import Resource, ResourceSpec, PODS
 from kube_batch_tpu.api.task_info import TaskInfo
@@ -50,28 +52,71 @@ class NodeInfo:
         self.idle = self.allocatable.clone()
         self.used = spec.empty()
         self.releasing = spec.empty()
+        self._set_state()
 
     # -- state machine (node_info.go:110-134) -----------------------------
+    def _set_state(self) -> None:
+        """setNodeState (node_info.go:110-134): UnInitialized when no node
+        object yet, OutOfSync when resident pods use more than the node's
+        allocatable, else Ready — NotReady nodes are excluded from snapshots
+        (cache.go:595-597). The state is STORED, recomputed only on set_node
+        like the reference: mid-session task churn must not flip readiness
+        (a Pipelined overlay legitimately pushes used above allocatable
+        while the capacity it borrows is still Releasing)."""
+        if self.node is None:
+            self._state = "UnInitialized"
+        elif not self.used.less_equal(self.allocatable):
+            self._state = "OutOfSync"
+        elif not self.node.ready:
+            self._state = "NotReady"
+        else:
+            self._state = "Ready"
+
+    @property
+    def state(self) -> str:
+        return self._state
+
     @property
     def ready(self) -> bool:
-        return self.node is not None and self.node.ready
+        return self._state == "Ready"
 
     def set_node(self, node: Node) -> None:
         """Update the node object, rebuilding (Idle, Used, Releasing) from the
         new allocatable and replaying every resident task's status algebra
         (node_info.go:137-162 SetNode). The replay matters when pods were
         ingested before their node: their add_task skipped accounting because
-        node was None."""
+        node was None.
+
+        The replay is underflow-tolerant: when resident tasks use more than
+        the new allocatable (pods landed before a smaller node object, or the
+        node shrank), Idle clamps at zero and the `state` property reports
+        OutOfSync — excluding the node from snapshots until usage reconciles
+        (node_info.go:110-134; the reference instead skips the rebuild and
+        keeps stale accounting — same observable contract, NotReady node)."""
         self.name = node.name
         self.node = node
         self.allocatable = _node_resource(node, self.spec, "allocatable")
         self.capability = _node_resource(node, self.spec, "capacity")
-        self.idle = self.allocatable.clone()
-        self.used = self.spec.empty()
-        self.releasing = self.spec.empty()
-        tasks, self.tasks = self.tasks, {}
-        for t in tasks.values():
-            self.add_task(t, _cloned=True)
+        idle_v = self.allocatable.vec.copy()
+        used_v = self.spec.empty().vec
+        rel_v = self.spec.empty().vec
+        for t in self.tasks.values():
+            r = t.resreq.vec
+            if t.status == TaskStatus.RELEASING:
+                rel_v += r
+                idle_v -= r
+                used_v += r
+            elif t.status == TaskStatus.PIPELINED:
+                rel_v -= r
+                used_v += r
+            elif is_allocated(t.status):
+                idle_v -= r
+                used_v += r
+            t.node_name = node.name
+        self.idle = Resource(np.maximum(idle_v, 0.0), self.spec)
+        self.used = Resource(used_v, self.spec)
+        self.releasing = Resource(np.maximum(rel_v, 0.0), self.spec)
+        self._set_state()
 
     # -- task algebra (node_info.go:165-222) ------------------------------
     def add_task(self, task: TaskInfo, _cloned: bool = False) -> None:
@@ -150,6 +195,7 @@ class NodeInfo:
         n.used = self.used.clone()
         n.releasing = self.releasing.clone()
         n.tasks = {key: t.clone() for key, t in self.tasks.items()}
+        n._state = self._state  # stored state carries over (not recomputed)
         return n
 
     @property

@@ -413,6 +413,13 @@ def build_snapshot(
             for ni, n in enumerate(node_objs):
                 task_pref_node[k, ni] = preferred_node_affinity_score(t, n)
                 task_pref_pod[k, ni] = preferred_pod_affinity_score(t, n, node_objs)
+        # min-max normalize the pod-affinity row to the 0..10 priority scale
+        # per task across real nodes (InterPodAffinityPriority's reduce) so a
+        # large term weight can't dominate the other bounded score rows
+        from kube_batch_tpu.plugins.nodeorder import minmax_scale_rows
+
+        nreal = len(node_objs)
+        task_pref_pod[:, :nreal] = minmax_scale_rows(task_pref_pod[:, :nreal])
 
     total = node_alloc[node_valid].sum(axis=0).astype(np.float32) if nN else np.zeros(R, np.float32)
 

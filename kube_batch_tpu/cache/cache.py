@@ -79,6 +79,48 @@ class SchedulerCache:
         # the scheduling cycle; failures re-enter via resync_task
         self._dispatch_pool = None
         self._dispatch_futures: List = []
+        # background repair loop (cache.go:342-384) — started by run()
+        self._repair_thread: Optional[threading.Thread] = None
+        self._repair_stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # background repair loops (cache.go:342-384)
+    # ------------------------------------------------------------------
+    def run(self, resync_period: float = 1.0) -> None:
+        """Start the background repair thread — the processResyncTask +
+        processCleanupJob goroutines (cache.go:342-384, 533-581). Idempotent;
+        the thread drains err_tasks and collects terminated jobs every
+        resync_period seconds until stop()."""
+        if self._repair_thread is not None and self._repair_thread.is_alive():
+            return
+        self._repair_stop = threading.Event()
+        stop = self._repair_stop
+
+        def loop():
+            while not stop.wait(resync_period):
+                try:
+                    self.process_resync_tasks()
+                    self.process_cleanup_jobs()
+                except Exception:  # noqa: BLE001 — repair must not die
+                    logger.exception("cache repair iteration failed")
+
+        self._repair_thread = threading.Thread(
+            target=loop, name="kb-cache-repair", daemon=True
+        )
+        self._repair_thread.start()
+
+    def stop(self) -> None:
+        self._repair_stop.set()
+        if self._repair_thread is not None:
+            self._repair_thread.join(timeout=5.0)
+            self._repair_thread = None
+        # drain + retire the async bind dispatcher so a stopped cache is
+        # quiescent (no lingering kb-dispatch thread, no post-stop binder
+        # calls); _dispatch_async lazily recreates the pool if needed again
+        pool, self._dispatch_pool = self._dispatch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+        self._dispatch_futures = []
 
     # ------------------------------------------------------------------
     # ingest: pods (event_handlers.go:42-200)
@@ -398,6 +440,17 @@ class SchedulerCache:
                 self._delete_pod_locked(pod)
                 self.pods[pod.key()] = pod
                 self._add_task(TaskInfo(pod, self.spec), pod)
+
+    def process_cleanup_jobs(self) -> None:
+        """processCleanupJob analog (cache.go:533-557): sweep-collect jobs
+        that are terminated per JobTerminated (helpers.go:102-106 — no real
+        PodGroup AND no tasks). Tasks always leave through delete_pod, which
+        also clears the pod store and node task copies; this sweep is the
+        belt-and-braces pass for jobs that lost their last task on a code
+        path that didn't call _maybe_collect_job."""
+        with self._lock:
+            for job in list(self.jobs.values()):
+                self._maybe_collect_job(job)
 
     # ------------------------------------------------------------------
     # status egress (cache.go:688-736)

@@ -18,26 +18,34 @@ from kube_batch_tpu.api.pod import PriorityClass
 
 
 def save_state(cache, path: str) -> None:
+    # Snapshot object references under the lock (shallow list/dict copies —
+    # O(objects), no serialization); build the dicts and write the file
+    # outside it, so a per-cycle save at 50k pods doesn't block the ingest /
+    # bind / evict handlers for the full serialization time. Pod/Node/Queue
+    # objects are immutable-by-convention after ingest (handlers replace,
+    # not mutate), so serializing them lock-free is safe.
     with cache._lock:
-        state = {
-            "pods": [serialize.pod_to_dict(p) for p in cache.pods.values()],
-            "nodes": [
-                serialize.node_to_dict(n.node)
-                for n in cache.nodes.values()
-                if n.node is not None
-            ],
-            "pod_groups": [
-                serialize.pod_group_to_dict(j.pod_group)
-                for j in cache.jobs.values()
-                if j.pod_group is not None and not j.pod_group.shadow
-            ],
-            "queues": [serialize.queue_to_dict(q.queue) for q in cache.queues.values()],
-            "priority_classes": [
-                {"name": pc.name, "value": pc.value, "global_default": pc.global_default}
-                for pc in cache.priority_classes.values()
-            ],
-            "pod_conditions": cache.pod_conditions,
-        }
+        pods = list(cache.pods.values())
+        nodes = [n.node for n in cache.nodes.values() if n.node is not None]
+        pod_groups = [
+            j.pod_group
+            for j in cache.jobs.values()
+            if j.pod_group is not None and not j.pod_group.shadow
+        ]
+        queues = [q.queue for q in cache.queues.values()]
+        priority_classes = list(cache.priority_classes.values())
+        pod_conditions = dict(cache.pod_conditions)
+    state = {
+        "pods": [serialize.pod_to_dict(p) for p in pods],
+        "nodes": [serialize.node_to_dict(n) for n in nodes],
+        "pod_groups": [serialize.pod_group_to_dict(pg) for pg in pod_groups],
+        "queues": [serialize.queue_to_dict(q) for q in queues],
+        "priority_classes": [
+            {"name": pc.name, "value": pc.value, "global_default": pc.global_default}
+            for pc in priority_classes
+        ],
+        "pod_conditions": pod_conditions,
+    }
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
     with os.fdopen(fd, "w") as f:
         json.dump(state, f)

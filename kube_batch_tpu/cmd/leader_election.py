@@ -5,7 +5,13 @@ lease, 10s renew deadline, 5s retry, and `glog.Fatalf` (crash → standby takes
 over) on lost leadership (cmd/kube-batch/app/server.go:48-52,106-151). The
 standalone analog uses an atomically-renamed lease file in the
 lock-object-namespace directory with the same timing constants and the same
-crash-on-loss contract."""
+crash-on-loss contract.
+
+Wall-clock caveat: lease validity and renewal compare time.time() stamps
+across processes (the reference similarly trusts apiserver timestamps). An
+NTP step larger than renew_deadline can cause a spurious crash-on-loss or a
+brief dual-leader window; deploy with slewing (chrony/ntpd -x), not stepping,
+on the contending hosts."""
 
 from __future__ import annotations
 
@@ -61,16 +67,27 @@ class LeaderElector:
     def _try_acquire_or_renew(self) -> bool:
         """The read-check-write is serialized through a short-lived O_EXCL
         claim file so two standbys can't both grab an expired lease (the
-        resourcelock's apiserver-side compare-and-swap analog)."""
+        resourcelock's apiserver-side compare-and-swap analog).
+
+        A claim collision is retried with a short backoff before reporting
+        failure: a standby briefly holding the claim file is contention, not
+        a lost lease — without the retry, two coincidental collisions one
+        retry_period apart could kill a healthy leader."""
         claim = self.lock_path + ".claim"
-        try:
-            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            try:  # break a claim orphaned by a crashed contender
-                if time.time() - os.path.getmtime(claim) > self.lease_duration:
-                    os.unlink(claim)
-            except OSError:
-                pass
+        fd = None
+        for attempt in range(4):
+            try:
+                fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                try:  # break a claim orphaned by a crashed contender
+                    if time.time() - os.path.getmtime(claim) > self.lease_duration:
+                        os.unlink(claim)
+                        continue
+                except OSError:
+                    pass
+                time.sleep(0.05 * (attempt + 1))
+        if fd is None:
             return False
         try:
             rec = self._read()

@@ -165,6 +165,17 @@ class Session:
             TASK_ORDER, l, r, (l.pod.creation_index, l.uid), (r.pod.creation_index, r.uid)
         )
 
+    def task_order_plugin_verdict(self, l: TaskInfo, r: TaskInfo) -> int:
+        """The tiered plugin verdict alone (<0 l first, 0 no plugin voted),
+        WITHOUT the creation-timestamp fallback — for callers that must
+        distinguish 'a plugin prefers l' from 'mere tie-break order', e.g.
+        preempt's phase-2 worth-it gate."""
+        for _, fn in self._iter_fns(TASK_ORDER):
+            v = fn(l, r)
+            if v != 0:
+                return v
+        return 0
+
     def _veto(self, kind: str, obj) -> bool:
         """All enabled plugins must pass (JobReady session_plugins.go:202-220)."""
         for _, fn in self._iter_fns(kind):

@@ -33,17 +33,20 @@ def proportion_deserved(
     weight: jnp.ndarray,      # [Q]
     request: jnp.ndarray,     # [Q, R]
     valid: jnp.ndarray,       # [Q] bool
-    max_iters: int = 16,
+    max_iters: int | None = None,
 ) -> jnp.ndarray:
     """Weighted max-min fair deserved[Q, R] (proportion.go:101-154).
 
     Each iteration hands every unmet queue remaining·w/Σw, caps queues that
     exceed their request, and returns the excess to the pool. Terminates when
-    the pool is empty or all queues are met; max_iters bounds the lax loop
-    (each iteration retires ≥1 queue in the reference's argument, so Q
-    iterations suffice; 16 covers Q ≤ 2^16 in practice since un-capped
-    iterations converge geometrically)."""
+    the pool is empty or all queues are met. An iteration that caps no queue
+    distributes the whole pool (the uncapped fractions sum to 1), so every
+    iteration either retires ≥1 queue or empties the pool — Q+1 iterations
+    always suffice, which is the default max_iters (the reference loops to
+    the same fixpoint, proportion.go:101-154)."""
     Q, R = request.shape
+    if max_iters is None:
+        max_iters = Q + 1
 
     def cond(state):
         i, deserved, met, remaining = state

@@ -7,6 +7,8 @@ Weights come from plugin arguments (nodeorder.go:34-43), default 1 each.
 
 from __future__ import annotations
 
+import numpy as np
+
 from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.task_info import TaskInfo
 from kube_batch_tpu.framework.interface import Plugin
@@ -18,6 +20,19 @@ LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
 BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
 
 MAX_PRIORITY = 10.0
+
+
+def minmax_scale_rows(raw):
+    """Min-max reduce score rows to the 0..10 priority scale, per row (k8s
+    InterPodAffinityPriority's reduce): 10·(v−min)/(max−min), all-zero when a
+    row is constant. `raw` is [K, N]; returns same shape. Single definition
+    shared by the host scorer below and the device snapshot rows
+    (api/snapshot.py) so the two can't diverge."""
+    mn = raw.min(axis=1, keepdims=True)
+    rng = raw.max(axis=1, keepdims=True) - mn
+    return np.where(
+        rng > 0, MAX_PRIORITY * (raw - mn) / np.where(rng > 0, rng, 1.0), 0.0
+    )
 
 
 def least_requested_score(task: TaskInfo, node: NodeInfo) -> float:
@@ -108,14 +123,40 @@ class NodeOrderPlugin(Plugin):
             pod_affinity=float(w_pod_aff),
         )
 
+        # per-task normalized pod-affinity rows, memoized for the session —
+        # InterPodAffinityPriority min-max reduces raw ±weight sums to the
+        # 0..10 priority scale across the node batch before weighting, so a
+        # large term weight (k8s allows 100) can't dominate the bounded
+        # least-requested/balanced rows. Memo trades exactness under
+        # mid-session placement churn for O(N) instead of O(N²) host scoring
+        # (scores are preferences, and the reference's batch scorer is
+        # likewise computed once per PrioritizeNodes call).
+        pod_aff_rows: dict = {}
+
+        def normalized_pod_affinity(task: TaskInfo, node: NodeInfo) -> float:
+            aff = task.pod.affinity
+            if aff is None or not (
+                aff.preferred_pod_affinity or aff.preferred_pod_anti_affinity
+            ):
+                return 0.0
+            row = pod_aff_rows.get(task.key())
+            if row is None:
+                node_objs = list(ssn.nodes.values())
+                raw = np.array(
+                    [[preferred_pod_affinity_score(task, n, node_objs)
+                      for n in node_objs]]
+                )
+                scaled = minmax_scale_rows(raw)[0]
+                row = {n.name: float(s) for n, s in zip(node_objs, scaled)}
+                pod_aff_rows[task.key()] = row
+            return row.get(node.name, 0.0)
+
         def node_order(task: TaskInfo, node: NodeInfo) -> float:
             return (
                 w_least * least_requested_score(task, node)
                 + w_balanced * balanced_resource_score(task, node)
                 + w_affinity * preferred_node_affinity_score(task, node)
-                + w_pod_aff * preferred_pod_affinity_score(
-                    task, node, ssn.nodes.values()
-                )
+                + w_pod_aff * normalized_pod_affinity(task, node)
             )
 
         ssn.add_fn(fw.NODE_ORDER, self.name, node_order)

@@ -62,14 +62,25 @@ class Scheduler:
             self.on_cycle_end()
 
     def run_forever(self) -> None:
-        while not self._stop:
-            tick = time.perf_counter()
-            try:
-                self.run_once()
-            except Exception:  # noqa: BLE001 — next cycle self-corrects
-                logger.exception("scheduling cycle failed")
-            elapsed = time.perf_counter() - tick
-            time.sleep(max(self.schedule_period - elapsed, 0.0))
+        """wait.Until(runOnce, period) preceded by cache.Run — the reference
+        starts the cache's background repair loops (resync + cleanup) before
+        ticking (scheduler.go:63-86, cache.go:342-384)."""
+        cache_run = getattr(self.cache, "run", None)
+        if cache_run is not None:
+            cache_run(resync_period=min(self.schedule_period, 1.0))
+        try:
+            while not self._stop:
+                tick = time.perf_counter()
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — next cycle self-corrects
+                    logger.exception("scheduling cycle failed")
+                elapsed = time.perf_counter() - tick
+                time.sleep(max(self.schedule_period - elapsed, 0.0))
+        finally:
+            cache_stop = getattr(self.cache, "stop", None)
+            if cache_stop is not None:
+                cache_stop()
 
     def stop(self) -> None:
         self._stop = True

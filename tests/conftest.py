@@ -28,6 +28,12 @@ deregister_axon_backend()
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: scale tests (seconds-long solves); always run in CI"
+    )
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     import jax

@@ -7,7 +7,7 @@ import pytest
 from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
 from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
 from kube_batch_tpu.api.pod import PodGroup, Queue
-from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase, TaskStatus
 from kube_batch_tpu.framework.conf import parse_scheduler_conf
 from kube_batch_tpu.framework.interface import get_action
 from kube_batch_tpu.framework.session import close_session, open_session
@@ -232,6 +232,43 @@ class TestReclaimAction:
         assert cache.evictor.evicts[0].startswith("c1/a-")
 
 
+class TestNodesFitDelta:
+    def test_pipeline_on_releasing_records_fit_delta(self):
+        """allocate.go:170-175: a task that fits a node's Releasing but not
+        its Idle is Pipelined AND leaves a NodesFitDelta shortfall diagnostic
+        on its (session) job."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB),
+                   build_node("n2", cpu=1000, mem=8 * GiB)],
+            pods=[
+                # running pod being deleted → RELEASING: holds all of n1 idle
+                build_pod("c1", "dying", "n1", PodPhase.RUNNING,
+                          {"cpu": 4000, "memory": GiB}, deleting=True),
+                # pg1 is already Ready via this running member, so the
+                # pipelined placement below commits (job.Ready ≥ minMember)
+                build_pod("c1", "r0", "n2", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg1"),
+                build_pod("c1", "newb", None, PodPhase.PENDING,
+                          {"cpu": 3000, "memory": GiB}, group_name="pg1"),
+            ],
+        )
+        conf = parse_scheduler_conf(TWO_TIER_CONF)
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        job = ssn.jobs["c1/pg1"]
+        task = job.tasks["c1/newb"]
+        assert task.status == TaskStatus.PIPELINED
+        assert task.node_name == "n1"
+        delta = job.nodes_fit_delta.get("n1")
+        close_session(ssn)
+        assert delta is not None
+        # idle cpu was 0, request 3000 → shortfall ≥ 3000
+        assert delta.milli_cpu >= 3000
+
+
 class TestSchedulerLoop:
     def test_run_once_end_to_end(self):
         cache = build_cache(
@@ -253,6 +290,48 @@ class TestSchedulerLoop:
         conf = parse_scheduler_conf('actions: "bogus"\ntiers: []')
         with pytest.raises(KeyError):
             Scheduler(cache, conf=conf)
+
+    def test_failed_bind_repaired_through_running_loop(self):
+        """A binder failure must be repaired by the cache's background resync
+        loop with no test intervention: run_forever starts cache.run()
+        (cache.go:342-384), the failed bind re-enters Pending via
+        processResyncTask (cache.go:563-581), and the next cycle re-places
+        and successfully re-binds it."""
+        import threading
+        import time as _time
+
+        class FlakyBinder:
+            def __init__(self):
+                self.calls = 0
+                self.binds = {}
+
+            def bind(self, pod, hostname):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("apiserver down")
+                self.binds[f"{pod.namespace}/{pod.name}"] = hostname
+
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "p0", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB})],
+        )
+        binder = FlakyBinder()
+        cache.binder = binder
+        sched = Scheduler(cache, schedule_period=0.05)
+        t = threading.Thread(target=sched.run_forever, daemon=True)
+        t.start()
+        try:
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and not binder.binds:
+                _time.sleep(0.05)
+        finally:
+            sched.stop()
+            t.join(timeout=5.0)
+        assert binder.binds == {"c1/p0": "n1"}
+        assert binder.calls >= 2
+        assert cache.err_tasks == []
 
 
 class TestFitErrorDiagnostics:

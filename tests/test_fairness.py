@@ -56,6 +56,59 @@ class TestProportionDeserved:
         np.testing.assert_allclose(d[0, 0], 100.0, rtol=1e-3)   # capped
         assert d[1, 0] >= 900.0 * (1 - 1e-3)                     # got the rest
 
+    def test_many_queues_one_cap_per_iteration(self):
+        """Adversarial Q=64 case where every iteration retires exactly ONE
+        queue — the true worst case needing Q iterations (the reference loops
+        to convergence, proportion.go:101-154; a fixed 16-iteration bound
+        under-serves queues 17..64)."""
+        Q, R = 64, 4
+        total0 = 1_000_000.0
+        total = np.array([total0, 0.0, 0.0, 0.0], np.float32)
+        weight = np.ones(Q, np.float32)
+        # request_i = 99% of the equal-share grant at iteration i, so queue i
+        # is the only one capped in round i
+        request = np.zeros((Q, R), np.float32)
+        remaining = total0
+        for i in range(Q - 1):
+            grant = remaining / (Q - i)
+            request[i, 0] = 0.99 * grant
+            remaining -= request[i, 0]
+        request[Q - 1, 0] = 2 * total0  # never met; absorbs the rest
+        d = np.asarray(fairness.proportion_deserved(
+            total, weight, request, np.ones(Q, bool)))
+        # every capped queue got exactly its request…
+        np.testing.assert_allclose(d[: Q - 1, 0], request[: Q - 1, 0], rtol=1e-4)
+        # …and the hungry queue got everything left (pool fully drained)
+        np.testing.assert_allclose(d[:, 0].sum(), total0, rtol=1e-4)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_q64_skewed_weights_match_host_oracle(self, seed):
+        """Q=64, weights skewed over 3 decades: device waterfill must agree
+        with an independent run-to-convergence numpy oracle."""
+        Q, R = 64, 4
+        rng = np.random.default_rng(seed)
+        total = rng.uniform(1e4, 1e6, R).astype(np.float32)
+        weight = (10.0 ** rng.uniform(0, 3, Q)).astype(np.float32)
+        request = (total[None, :] * rng.uniform(0, 0.2, (Q, R))).astype(np.float32)
+        valid = np.ones(Q, bool)
+
+        # oracle: plain python waterfill to fixpoint
+        deserved = np.zeros((Q, R), np.float64)
+        met = np.zeros(Q, bool)
+        remaining = total.astype(np.float64).copy()
+        for _ in range(Q + 1):
+            if not np.any(remaining > 1e-6) or np.all(met):
+                break
+            w = np.where(~met, weight, 0.0)
+            frac = w / w.sum() if w.sum() > 0 else w
+            new = deserved + remaining[None, :] * frac[:, None]
+            now_met = np.all(request <= new + 1e-6, axis=-1)
+            capped = np.where(now_met[:, None], np.minimum(new, request), new)
+            remaining = np.maximum(remaining - (capped - deserved).sum(axis=0), 0.0)
+            deserved, met = capped, met | now_met
+        dev = np.asarray(fairness.proportion_deserved(total, weight, request, valid))
+        np.testing.assert_allclose(dev, deserved, rtol=2e-3, atol=1.0)
+
     @pytest.mark.parametrize("seed", range(4))
     def test_host_twin_agrees(self, seed):
         """plugins/proportion's numpy waterfill must match the device one."""

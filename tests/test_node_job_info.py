@@ -174,6 +174,32 @@ class TestReviewRegressions:
         assert n.used.milli_cpu == 1000
         assert n.idle.milli_cpu == 3000
 
+    def test_overcommitted_node_goes_out_of_sync(self):
+        """node_info.go:110-134 setNodeState: used > allocatable ⇒ NotReady
+        with reason OutOfSync, which excludes the node from snapshots. The
+        entry paths are set_node replays: pods ingested before a too-small
+        node, or a node shrinking below its usage."""
+        # pods before node, over-summing the node that then arrives
+        n = NodeInfo(None, DEFAULT_SPEC)
+        n.add_task(make_task("a", cpu=3000.0))
+        n.add_task(make_task("b", cpu=3000.0))
+        n.set_node(Node(name="n1", allocatable={
+            "cpu": 4000.0, "memory": 8 * 2**30, "pods": 110}))
+        assert n.state == "OutOfSync"
+        assert not n.ready
+        assert n.idle.milli_cpu == 0  # clamped, never negative
+
+        # node shrinking below current usage, then growing back
+        n2 = make_node(cpu=4000.0)
+        n2.add_task(make_task("a", cpu=3000.0))
+        assert n2.state == "Ready" and n2.ready
+        n2.set_node(Node(name="n1", allocatable={
+            "cpu": 2000.0, "memory": 8 * 2**30, "pods": 110}))
+        assert n2.state == "OutOfSync" and not n2.ready
+        n2.set_node(Node(name="n1", allocatable={
+            "cpu": 8000.0, "memory": 8 * 2**30, "pods": 110}))
+        assert n2.ready and n2.idle.milli_cpu == 5000
+
     def test_node_holds_task_copy(self):
         # node_info.go:165-168: caller-side status mutation must not
         # desynchronize the node's reversal algebra

@@ -1,0 +1,119 @@
+"""CPU scale test of the allocate cycle at 5k tasks × 500 nodes.
+
+Asserts the *invariants* (SURVEY.md §7.3 — the reference randomizes
+placement itself, scheduler_helper.go:147-158): no node overcommit, no
+committed partial gang, overused queues gain nothing — at a size that
+crosses the 4096→8192 task padding-bucket boundary (api/snapshot.py
+power-of-two buckets), which the unit tests (≤512 tasks) never exercise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.snapshot import build_snapshot
+from kube_batch_tpu.api.types import TaskStatus, is_allocated
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.framework.interface import get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu.testing.synthetic import (
+    synthetic_cluster,
+    synthetic_overcommit_cluster,
+)
+
+GANG = 5
+N_TASKS = 5000
+N_NODES = 500
+
+
+def _session_view(ssn):
+    cluster = ClusterInfo(ssn.spec)
+    cluster.nodes = ssn.nodes
+    cluster.queues = ssn.queues
+    cluster.jobs = ssn.jobs
+    return cluster
+
+
+@pytest.mark.slow
+def test_allocate_invariants_at_scale():
+    cache = synthetic_cluster(
+        n_tasks=N_TASKS, n_nodes=N_NODES, gang_size=GANG, n_queues=3
+    )
+    conf = load_scheduler_conf(None)
+    ssn = open_session(cache, conf.tiers)
+
+    # the padded task axis must cross the 4096 bucket boundary: 5000 tasks
+    # land in the next power-of-two bucket, and the padding rows must not
+    # perturb the solve below
+    snap, meta = build_snapshot(_session_view(ssn))
+    assert meta.n_tasks == N_TASKS
+    padded_T = snap.task_req.shape[0]
+    assert padded_T > 4096 and padded_T >= N_TASKS
+
+    get_action("allocate").execute(ssn)
+
+    # 1. no node overcommit, in the authoritative host accounting
+    quanta = ssn.spec.quanta
+    placed = 0
+    for node in ssn.nodes.values():
+        assert np.all(node.idle.vec >= -quanta), node.name
+        assert np.all(
+            node.used.vec <= node.allocatable.vec + quanta
+        ), node.name
+        placed += sum(
+            1 for t in node.tasks.values() if is_allocated(t.status)
+        )
+
+    # 2. no committed partial gang: every job placed all-or-nothing
+    for job in ssn.jobs.values():
+        n_alloc = sum(
+            1 for t in job.tasks.values() if is_allocated(t.status)
+        )
+        assert n_alloc == 0 or n_alloc >= job.min_available, job.uid
+
+    # 3. the solve actually did the work (not a vacuous pass): the synthetic
+    # cluster is sized so most tasks fit
+    assert placed >= N_TASKS // 2
+    close_session(ssn)
+
+
+@pytest.mark.slow
+def test_overused_queue_gains_nothing_at_scale():
+    """proportion's Overused gate (proportion.go:198-209): a queue whose
+    running allocation already exceeds its deserved share gets no new
+    placements even with pending work queued."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod, PodGroup
+    from kube_batch_tpu.api.types import PodPhase
+
+    cache = synthetic_overcommit_cluster(
+        n_running=800, n_pending=400, n_nodes=100, gang_size=4
+    )
+    # pending work in the overused queue q0 (weight 1 vs q1's 3; q0 already
+    # runs the whole cluster, far beyond its ~25% deserved share)
+    for j in range(10):
+        cache.add_pod_group(
+            PodGroup(name=f"greedy{j}", namespace="bench", min_member=1,
+                     queue="q0", creation_index=10_000 + j)
+        )
+        cache.add_pod(
+            Pod(
+                name=f"g{j}", namespace="bench",
+                requests={"cpu": 100.0, "memory": float(2 ** 28)},
+                annotations={GROUP_NAME_ANNOTATION: f"greedy{j}"},
+                phase=PodPhase.PENDING,
+                creation_index=10_000 + j,
+            )
+        )
+    conf = load_scheduler_conf(None)
+    ssn = open_session(cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    for uid, job in ssn.jobs.items():
+        if not uid.startswith("bench/greedy"):
+            continue
+        for t in job.tasks.values():
+            assert t.status == TaskStatus.PENDING, (uid, t.status)
+    close_session(ssn)
