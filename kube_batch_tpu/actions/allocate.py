@@ -291,6 +291,16 @@ class AllocateAction(Action):
                     or (pipe and task.init_resreq.less_equal(node.releasing))
                 ):
                     raise FitFailure("node resources taken by host fallback")
+                if pipe:
+                    if node is not None:
+                        job.nodes_fit_delta[node_name] = (
+                            task.init_resreq.fit_delta(node.idle)
+                        )
+                    stmt.pipeline(task, node_name)
+                else:
+                    # raises FitFailure before mutating when a volume claim
+                    # can't be satisfied from this node (cache.go:189-209)
+                    stmt.allocate(task, node_name)
             except FitFailure as e:
                 logger.info("device placement %s→%s rejected by host predicate: %s",
                             task.key(), node_name, e.reason)
@@ -298,15 +308,6 @@ class AllocateAction(Action):
                 # (the solve is deterministic), so fall back to the
                 # reference's own sequential path for this task
                 self._host_place(ssn, stmt, task)
-                continue
-            if pipe:
-                if node is not None:
-                    job.nodes_fit_delta[node_name] = (
-                        task.init_resreq.fit_delta(node.idle)
-                    )
-                stmt.pipeline(task, node_name)
-            else:
-                stmt.allocate(task, node_name)
         if ssn.job_ready(job):
             stmt.commit()
         else:
@@ -358,6 +359,10 @@ class AllocateAction(Action):
             if not (task.init_resreq.less_equal(node.idle)
                     or task.init_resreq.less_equal(node.releasing)):
                 continue
+            # volume reachability is part of host placement (AllocateVolumes
+            # failing a node, cache.go:189-209)
+            if not ssn.cache.volume_feasible(task, node.name):
+                continue
             score = ssn.node_order(task, node)
             if best is None or score > best_score:
                 best, best_score = node, score
@@ -365,13 +370,21 @@ class AllocateAction(Action):
             return False
         # allocate-vs-pipeline is decided on the already-selected node
         # (allocate.go:161-184), not folded into the selection
-        if task.init_resreq.less_equal(best.idle):
-            stmt.allocate(task, best.name)
-        else:
-            job = ssn.jobs.get(task.job)
-            if job is not None:
-                job.nodes_fit_delta[best.name] = (
-                    task.init_resreq.fit_delta(best.idle)
-                )
-            stmt.pipeline(task, best.name)
+        try:
+            if task.init_resreq.less_equal(best.idle):
+                stmt.allocate(task, best.name)
+            else:
+                job = ssn.jobs.get(task.job)
+                if job is not None:
+                    job.nodes_fit_delta[best.name] = (
+                        task.init_resreq.fit_delta(best.idle)
+                    )
+                stmt.pipeline(task, best.name)
+        except FitFailure as e:
+            # e.g. a same-cycle reservation raced the feasibility probe;
+            # the task stays Pending and the next cycle self-corrects
+            # (allocate.go logs and moves on the same way)
+            logger.info("host placement %s→%s failed: %s",
+                        task.key(), best.name, e.reason)
+            return False
         return True

@@ -120,9 +120,13 @@ class PreemptAction(Action):
             verdict = ssn.task_order_plugin_verdict(best_p, worst_r)
             if verdict == 0:
                 # no task-order plugin voted (e.g. priority disabled in
-                # conf): fall back to the raw pod-priority comparison so
-                # preemption doesn't go inert
-                verdict = -1 if best_p.priority > worst_r.priority else 1
+                # conf): fall back to comparing the extreme raw priorities —
+                # NOT best_p/worst_r, which were picked by the degenerate
+                # creation-order comparator and need not carry the extreme
+                # priorities
+                hi = max(t.priority for t in pending.values())
+                lo = min(t.priority for t in running.values())
+                verdict = -1 if hi > lo else 1
             if verdict >= 0:
                 continue  # nothing to rebalance
             tq = PriorityQueue(less=ssn.task_order_fn)

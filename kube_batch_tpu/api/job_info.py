@@ -76,6 +76,7 @@ class JobInfo:
         self.nodes_fit_errors: Dict[str, FitErrors] = {}  # taskUID → FitErrors
         self.job_fit_errors: str = ""
         self.pod_group: Optional[PodGroup] = None
+        self.pdb = None  # legacy gang source (job_info.go:199-212 SetPDB)
         self.creation_index: int = 0
         if pod_group is not None:
             self.set_pod_group(pod_group)
@@ -88,6 +89,17 @@ class JobInfo:
         self.queue = pg.queue
         self.creation_index = pg.creation_index
         self.pod_group = pg
+
+    # -- pdb wiring (job_info.go:199-212) ---------------------------------
+    def set_pdb(self, pdb) -> None:
+        self.name = pdb.name
+        self.namespace = pdb.namespace
+        self.min_available = pdb.min_available
+        self.creation_index = pdb.creation_index
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
 
     # -- task bookkeeping (job_info.go:211-263) ---------------------------
     def _index_add(self, task: TaskInfo) -> None:
@@ -224,6 +236,7 @@ class JobInfo:
         j.min_available = self.min_available
         j.creation_index = self.creation_index
         j.pod_group = self.pod_group.clone() if self.pod_group else None
+        j.pdb = self.pdb  # immutable-by-convention after ingest
         # direct index rebuild: add_task's per-task aggregate arithmetic
         # telescopes to a wholesale copy of the two ledgers (the clone is
         # exact by construction — hot in cache.snapshot at 50k tasks)

@@ -108,6 +108,33 @@ class Affinity:
 
 
 @dataclasses.dataclass
+class PodDisruptionBudget:
+    """The legacy gang source (event_handlers.go:484-594): a PDB owned by
+    the same controller as a set of pods turns that owner's job into a gang
+    of min_available, always in the default queue. Jobs defined only by a
+    PDB get events-only status updates (job_updater.go:108-111)."""
+
+    name: str
+    namespace: str = "default"
+    min_available: int = 1
+    # controller/owner UID linking the PDB to its pods' job
+    owner: Optional[str] = None
+    creation_index: int = 0
+
+
+@dataclasses.dataclass
+class PersistentVolume:
+    """Standalone PersistentVolume analog (the reference wraps the k8s
+    volumebinder over PV/PVC/StorageClass informers, cache.go:189-209). A
+    named volume, optionally reachable from a single node only (local PV),
+    optionally pre-bound to a claim (static provisioning)."""
+
+    name: str
+    node: Optional[str] = None   # None = accessible from every node
+    claim: Optional[str] = None  # pre-bound PVC name; None = matches any claim
+
+
+@dataclasses.dataclass
 class Pod:
     """The scheduler-visible slice of a pod spec + status."""
 
@@ -131,6 +158,14 @@ class Pod:
     host_ports: Tuple[int, ...] = ()
     scheduler_name: str = "volcano"
     creation_index: int = 0  # monotone stand-in for CreationTimestamp
+    # names of PersistentVolumeClaims the pod mounts (the standalone analog
+    # of pod.spec.volumes[*].persistentVolumeClaim.claimName); resolved
+    # against the PV ledger at allocate time (cache.go:189-209)
+    volume_claims: Tuple[str, ...] = ()
+    # controller/owner UID (metav1.GetControllerOf analog): pods sharing an
+    # owner share a job when no group-name annotation is set
+    # (cache/util.go:42-46, apis/utils/utils.go:25-37)
+    owner: Optional[str] = None
 
     def __post_init__(self):
         if not self.uid:

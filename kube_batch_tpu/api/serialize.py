@@ -94,6 +94,8 @@ def pod_from_dict(d: Dict[str, Any]) -> Pod:
         )
     if "host_ports" in d:
         d["host_ports"] = tuple(d["host_ports"])
+    if "volume_claims" in d:
+        d["volume_claims"] = tuple(d["volume_claims"])
     return Pod(**d)
 
 

@@ -21,11 +21,15 @@ _warned_unknown_scalars: Set[Tuple[Tuple[str, ...], str]] = set()
 
 def job_id_for_pod(pod: Pod) -> str:
     """JobID for a pod (job_info.go:56-66): namespace/group-name if the
-    group annotation is present, else the pod's own namespace/name (a shadow
-    single-task job will be synthesized by the cache)."""
+    group annotation is present; else the pod's controller UID
+    (cache/util.go:42-46 — pods sharing an owner share a job, which is how a
+    PodDisruptionBudget on the owner gangs them); else the pod's own
+    namespace/name (a shadow single-task job will be synthesized)."""
     group = pod.group_name
     if group:
         return f"{pod.namespace}/{group}"
+    if pod.owner:
+        return f"{pod.namespace}/{pod.owner}"
     return f"{pod.namespace}/{pod.name}"
 
 

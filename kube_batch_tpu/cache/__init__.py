@@ -1,6 +1,7 @@
 from kube_batch_tpu.cache.interface import Binder, Evictor, StatusUpdater, VolumeBinder
 from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder
 from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.volume import StandalonePVBinder
 
 __all__ = [
     "Binder",
@@ -12,4 +13,5 @@ __all__ = [
     "FakeStatusUpdater",
     "FakeVolumeBinder",
     "SchedulerCache",
+    "StandalonePVBinder",
 ]

@@ -148,7 +148,7 @@ class SchedulerCache:
         if job is None:
             job = JobInfo(task.job, self.spec)
             self.jobs[task.job] = job
-        if job.pod_group is None and pod.group_name is None:
+        if job.pod_group is None and pod.group_name is None and job.pdb is None:
             shadow = PodGroup(
                 name=pod.name,
                 namespace=pod.namespace,
@@ -198,6 +198,9 @@ class SchedulerCache:
     def _delete_pod_locked(self, pod: Pod) -> None:
         self.pods.pop(pod.key(), None)
         self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
+        release = getattr(self.volume_binder, "release_task", None)
+        if release is not None:
+            release(pod.uid)  # free assumed-but-unbound PV reservations
         job_id = job_id_for_pod(pod)
         job = self.jobs.get(job_id)
         if job is not None:
@@ -210,9 +213,14 @@ class SchedulerCache:
             self._maybe_collect_job(job)
 
     def _maybe_collect_job(self, job: JobInfo) -> None:
-        """processCleanupJob analog (cache.go:533-557): drop a job once it
-        has no tasks and no (non-shadow) PodGroup."""
-        if not job.tasks and (job.pod_group is None or job.pod_group.shadow):
+        """processCleanupJob analog (cache.go:533-557, JobTerminated
+        helpers.go:102-106): drop a job once it has no tasks, no (non-shadow)
+        PodGroup, and no PDB."""
+        if (
+            not job.tasks
+            and (job.pod_group is None or job.pod_group.shadow)
+            and job.pdb is None
+        ):
             self.jobs.pop(job.uid, None)
             self._status_next_write.pop(job.uid, None)
 
@@ -259,6 +267,61 @@ class SchedulerCache:
                 if not job.tasks:
                     self.jobs.pop(key, None)
             self._status_next_write.pop(key, None)
+
+    # ------------------------------------------------------------------
+    # ingest: pod disruption budgets — the legacy gang source
+    # (event_handlers.go:484-594)
+    # ------------------------------------------------------------------
+    def add_pdb(self, pdb) -> None:
+        """setPDB: the job is keyed by the PDB's controller UID (the same
+        key owner-linked pods land on, cache/util.go:42-46); min-available
+        comes from the PDB; queue is always the default (PDB has no queue
+        concept, event_handlers.go:497-498)."""
+        if not pdb.owner:
+            logger.error("PodDisruptionBudget %s has no controller; ignored",
+                         pdb.name)
+            return
+        with self._lock:
+            job_id = f"{pdb.namespace}/{pdb.owner}"
+            job = self.jobs.get(job_id)
+            if job is None:
+                job = JobInfo(job_id, self.spec)
+                self.jobs[job_id] = job
+            # a shadow PodGroup synthesized for owner pods that arrived
+            # before their PDB yields to the PDB as the gang source (its
+            # min_member=1 would otherwise mask the PDB's min-available and
+            # divert status writeback from the events-only path)
+            if job.pod_group is not None and job.pod_group.shadow:
+                job.pod_group = None
+            job.set_pdb(pdb)
+            job.queue = self.default_queue
+
+    def update_pdb(self, pdb) -> None:
+        self.add_pdb(pdb)
+
+    def delete_pdb(self, pdb) -> None:
+        if not pdb.owner:
+            return
+        with self._lock:
+            job = self.jobs.get(f"{pdb.namespace}/{pdb.owner}")
+            if job is None:
+                return
+            job.unset_pdb()
+            if job.tasks and job.pod_group is None:
+                # re-synthesize the shadow PodGroup the PDB displaced so the
+                # owner's pods keep scheduling as singletons (divergence
+                # from the reference, which leaves the job excluded from
+                # snapshots — cache.go:625-633 — until its pods are deleted)
+                any_pod = next(iter(job.tasks.values())).pod
+                job.set_pod_group(PodGroup(
+                    name=any_pod.name,
+                    namespace=any_pod.namespace,
+                    min_member=1,
+                    queue=self.default_queue,
+                    creation_index=any_pod.creation_index,
+                    shadow=True,
+                ))
+            self._maybe_collect_job(job)
 
     # ------------------------------------------------------------------
     # ingest: queues / priority classes (event_handlers.go:597-785)
@@ -413,13 +476,18 @@ class SchedulerCache:
             logger.error("evict of %s failed: %s", task.key(), e)
             self.resync_task(task)
 
-    # volume seams (no-op standalone, cache.go:189-209)
+    # volume seams (cache.go:189-209; real ledger in cache/volume.py,
+    # no-op fake by default)
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)
         task.volume_ready = True
 
     def bind_volumes(self, task: TaskInfo) -> None:
         self.volume_binder.bind_volumes(task)
+
+    def volume_feasible(self, task: TaskInfo, hostname: str) -> bool:
+        probe = getattr(self.volume_binder, "volume_feasible", None)
+        return probe(task, hostname) if probe is not None else True
 
     # ------------------------------------------------------------------
     # repair: resync (cache.go:559-581, event_handlers.go:96-122)
@@ -539,7 +607,9 @@ class SchedulerCache:
             for name, q in self.queues.items():
                 ci.queues[name] = q.clone()
             for uid, job in self.jobs.items():
-                if job.pod_group is None:
+                # jobs enter the snapshot with a PodGroup or a PDB
+                # (cache.go:625-633)
+                if job.pod_group is None and job.pdb is None:
                     continue
                 if job.queue not in self.queues:
                     logger.warning("job %s queue %s not found, skipped", uid, job.queue)
@@ -548,7 +618,7 @@ class SchedulerCache:
                 # resolve job priority from PriorityClass (cache.go:610-620)
                 pc = self.priority_classes.get(
                     job.pod_group.priority_class
-                ) if job.pod_group.priority_class else None
+                ) if job.pod_group and job.pod_group.priority_class else None
                 if pc is not None:
                     clone.priority = pc.value
                 elif self.default_priority:

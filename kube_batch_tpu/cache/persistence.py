@@ -9,12 +9,13 @@ startup. Shadow PodGroups are skipped — add_pod regenerates them."""
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import tempfile
 
 from kube_batch_tpu.api import serialize
-from kube_batch_tpu.api.pod import PriorityClass
+from kube_batch_tpu.api.pod import PersistentVolume, PodDisruptionBudget, PriorityClass
 
 
 def save_state(cache, path: str) -> None:
@@ -32,9 +33,12 @@ def save_state(cache, path: str) -> None:
             for j in cache.jobs.values()
             if j.pod_group is not None and not j.pod_group.shadow
         ]
+        pdbs = [j.pdb for j in cache.jobs.values() if j.pdb is not None]
         queues = [q.queue for q in cache.queues.values()]
         priority_classes = list(cache.priority_classes.values())
         pod_conditions = dict(cache.pod_conditions)
+        pvs = list(getattr(cache.volume_binder, "pvs", {}).values())
+        pv_bound = dict(getattr(cache.volume_binder, "bound", {}))
     state = {
         "pods": [serialize.pod_to_dict(p) for p in pods],
         "nodes": [serialize.node_to_dict(n) for n in nodes],
@@ -45,6 +49,9 @@ def save_state(cache, path: str) -> None:
             for pc in priority_classes
         ],
         "pod_conditions": pod_conditions,
+        "pdbs": [dataclasses.asdict(p) for p in pdbs],
+        "pvs": [dataclasses.asdict(p) for p in pvs],
+        "pv_bound": pv_bound,
     }
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
     with os.fdopen(fd, "w") as f:
@@ -68,7 +75,14 @@ def load_state(cache, path: str) -> bool:
         cache.add_node(serialize.node_from_dict(n))
     for pg in state.get("pod_groups", []):
         cache.add_pod_group(serialize.pod_group_from_dict(pg))
+    for pdb in state.get("pdbs", []):
+        cache.add_pdb(PodDisruptionBudget(**pdb))
     for p in state.get("pods", []):
         cache.add_pod(serialize.pod_from_dict(p))
     cache.pod_conditions.update(state.get("pod_conditions", {}))
+    add_pv = getattr(cache.volume_binder, "add_pv", None)
+    if add_pv is not None:
+        for pv in state.get("pvs", []):
+            add_pv(PersistentVolume(**pv))
+        cache.volume_binder.bound.update(state.get("pv_bound", {}))
     return True

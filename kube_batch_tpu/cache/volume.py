@@ -1,0 +1,123 @@
+"""Standalone PV ledger — a real VolumeBinder behind the cache's seams.
+
+The reference wraps the k8s volumebinder: AllocateVolumes assumes the pod's
+PVC→PV bindings for a host (and can fail the placement), BindVolumes makes
+them durable (cache.go:189-209, 258-269). Standalone there is no apiserver,
+so the ledger itself is the source of truth: PersistentVolume objects are
+ingested like nodes, claims resolve against them at allocate time, and a
+node from which a required PV is unreachable fails the placement
+(FitFailure → the action falls back to the next candidate).
+
+Reservation semantics: allocate_volumes is IDEMPOTENT PER TASK — it first
+drops the task's previous reservation, then re-reserves for the new host.
+This makes the allocate action's bulk-path volume pre-check safe: a demoted
+job's sequential replay re-allocates the same tasks without double-booking.
+A reservation left behind by a discarded Statement is likewise superseded on
+the next cycle's re-allocate (the reference's unallocate also leaves assumed
+volumes to the next BindVolumes/re-assume — convergence by re-running).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kube_batch_tpu.api.pod import PersistentVolume
+
+
+class StandalonePVBinder:
+    """VolumeBinder over a local PV ledger."""
+
+    noop = False  # the allocate bulk path must run the volume pre-check
+
+    def __init__(self):
+        self.pvs: Dict[str, PersistentVolume] = {}
+        self.bound: Dict[str, str] = {}  # claim → pv name (durable binding)
+        # task uid → {claim: pv name} (assumed, this cycle)
+        self.reservations: Dict[str, Dict[str, str]] = {}
+
+    # -- ledger ingest (pv informer analog) ------------------------------
+    def add_pv(self, pv: PersistentVolume) -> None:
+        self.pvs[pv.name] = pv
+
+    def delete_pv(self, name: str) -> None:
+        self.pvs.pop(name, None)
+
+    # -- internals --------------------------------------------------------
+    def _reserved_pvs(self, excluding_task: Optional[str] = None) -> set:
+        held = set(self.bound.values())
+        for uid, res in self.reservations.items():
+            if uid != excluding_task:
+                held.update(res.values())
+        return held
+
+    def _resolve(self, claim: str, hostname: str, held: set) -> Optional[str]:
+        """Pick a PV for the claim reachable from hostname: a durable
+        binding wins, then a pre-bound PV, then any free wildcard PV."""
+        bound_pv = self.bound.get(claim)
+        if bound_pv is not None:
+            pv = self.pvs.get(bound_pv)
+            if pv is not None and pv.node in (None, hostname):
+                return bound_pv
+            return None
+        candidates = sorted(
+            self.pvs.values(),
+            key=lambda pv: (pv.claim is None, pv.name),  # pre-bound first
+        )
+        for pv in candidates:
+            if pv.claim is not None and pv.claim != claim:
+                continue
+            if pv.node not in (None, hostname):
+                continue
+            if pv.name in held:
+                continue
+            return pv.name
+        return None
+
+    def volume_feasible(self, task, hostname: str) -> bool:
+        """Non-mutating probe: could allocate_volumes succeed right now?
+        Used as an extra host predicate by the sequential placement path."""
+        claims = getattr(task.pod, "volume_claims", ())
+        if not claims:
+            return True
+        held = self._reserved_pvs(excluding_task=task.uid)
+        picked: set = set()
+        for claim in claims:
+            pv = self._resolve(claim, hostname, held | picked)
+            if pv is None:
+                return False
+            picked.add(pv)
+        return True
+
+    # -- VolumeBinder seam ------------------------------------------------
+    def allocate_volumes(self, task, hostname: str) -> None:
+        """Assume the task's claims onto PVs reachable from hostname.
+        Raises FitFailure when any claim can't be satisfied there. Replaces
+        any previous reservation the task held (idempotent per task)."""
+        from kube_batch_tpu.framework.session import FitFailure
+
+        claims = getattr(task.pod, "volume_claims", ())
+        self.reservations.pop(task.uid, None)
+        if not claims:
+            return
+        held = self._reserved_pvs(excluding_task=task.uid)
+        picked: Dict[str, str] = {}
+        for claim in claims:
+            pv = self._resolve(claim, hostname, held | set(picked.values()))
+            if pv is None:
+                raise FitFailure(
+                    f"volume claim {claim!r} has no PV reachable from {hostname}"
+                )
+            picked[claim] = pv
+        self.reservations[task.uid] = picked
+
+    def bind_volumes(self, task) -> None:
+        """Make the task's assumed bindings durable (BindVolumes,
+        cache.go:258-269)."""
+        picked = self.reservations.pop(task.uid, None)
+        if picked:
+            self.bound.update(picked)
+
+    def release_task(self, task_uid: str) -> None:
+        """Drop a task's assumed (not yet bound) reservation — called when
+        its pod leaves the cluster so the PVs free up."""
+        self.reservations.pop(task_uid, None)

@@ -12,6 +12,8 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
 - POST/DELETE /v1/podgroups      matching the informers' upsert handlers
 - POST/DELETE /v1/queues         (event_handlers.go)
 - POST        /v1/priorityclasses
+- POST/DELETE /v1/poddisruptionbudgets
+- POST/DELETE /v1/persistentvolumes
 - GET  /v1/queues              — queue list w/ podgroup phase counts (the
                                  Queue CRD status the CLI renders, list.go:51)
 - GET  /v1/jobs                — podgroup phases/conditions
@@ -31,6 +33,7 @@ from typing import Optional
 
 from kube_batch_tpu import metrics
 from kube_batch_tpu.api import serialize
+from kube_batch_tpu.api.pod import PersistentVolume, PodDisruptionBudget
 from kube_batch_tpu.api.types import PodGroupPhase
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.cmd.leader_election import LeaderElector
@@ -106,6 +109,16 @@ def make_handler(cache: SchedulerCache):
         "priorityclasses": (serialize.priority_class_from_dict,
                             cache.add_priority_class,
                             lambda pc: cache.delete_priority_class(pc.name)),
+        # legacy gang source (event_handlers.go:484-594)
+        "poddisruptionbudgets": (
+            lambda d: PodDisruptionBudget(**d), cache.add_pdb, cache.delete_pdb),
+        # PV ledger ingest (the pv informer analog, cache.go:189-209); no-op
+        # deletes/adds when the volume binder is the fake
+        "persistentvolumes": (
+            lambda d: PersistentVolume(**d),
+            lambda pv: getattr(cache.volume_binder, "add_pv", lambda _: None)(pv),
+            lambda pv: getattr(cache.volume_binder, "delete_pv", lambda _: None)(pv.name),
+        ),
     }
 
     class Handler(BaseHTTPRequestHandler):
@@ -256,11 +269,14 @@ def run(opt: ServerOption) -> None:
     and --version live in cmd/main.py."""
     from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor
 
+    from kube_batch_tpu.cache.volume import StandalonePVBinder
+
     cache = SchedulerCache(
         scheduler_name=opt.scheduler_name,
         default_queue=opt.default_queue,
         binder=RateLimitedBackend(FakeBinder(), opt.kube_api_qps, opt.kube_api_burst),
         evictor=RateLimitedBackend(FakeEvictor(), opt.kube_api_qps, opt.kube_api_burst),
+        volume_binder=StandalonePVBinder(),  # real PV ledger behind /v1/persistentvolumes
         resolve_priority=opt.enable_priority_class,
     )
     on_cycle_end = None

@@ -503,6 +503,13 @@ def close_session(ssn: Session) -> None:
         plugin.on_session_close(ssn)
     for job in ssn.jobs.values():
         if job.pod_group is None:
+            # PDB-defined jobs get events only, no status writeback
+            # (job_updater.go:108-111; unschedulable iff tasks stay Pending,
+            # cache.go:699)
+            if job.pdb is not None and job.task_status_index.get(
+                TaskStatus.PENDING
+            ):
+                ssn.cache.record_job_status_event(job)
             continue
         job_status(ssn, job)
         ssn.cache.update_job_status(job)

@@ -613,3 +613,184 @@ class TestPreferredAffinity:
         )
         run_actions(cache, action_names=["allocate"])
         assert cache.binder.binds["c1/quiet"] == "n1"
+
+
+class TestVolumeScenarios:
+    """Standalone PV ledger behind the VolumeBinder seam (cache/volume.py;
+    cache.go:189-209, 258-269 — AllocateVolumes can fail a node,
+    BindVolumes consumes)."""
+
+    def _cache_with_pv_binder(self, **kw):
+        from kube_batch_tpu.cache.volume import StandalonePVBinder
+
+        cache = build_cache(**kw)
+        cache.volume_binder = StandalonePVBinder()
+        return cache
+
+    def test_node_without_required_volume_is_skipped(self):
+        """A pod claiming a node-local PV must land on the PV's node even
+        when another node scores equally on resources."""
+        from kube_batch_tpu.api.pod import PersistentVolume
+
+        cache = self._cache_with_pv_binder(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=8000, mem=16 * GiB),
+                   build_node("n2", cpu=8000, mem=16 * GiB)],
+            pods=[build_pod("c1", "dbpod", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB},
+                            volume_claims=("data-claim",))],
+        )
+        cache.volume_binder.add_pv(
+            PersistentVolume(name="pv-local", node="n2", claim="data-claim"))
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds["c1/dbpod"] == "n2"
+        # the binding became durable at dispatch (BindVolumes)
+        assert cache.volume_binder.bound == {"data-claim": "pv-local"}
+        assert cache.volume_binder.reservations == {}
+
+    def test_unsatisfiable_claim_fails_placement(self):
+        from kube_batch_tpu.api.pod import PersistentVolume
+
+        cache = self._cache_with_pv_binder(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=8000, mem=16 * GiB)],
+            pods=[build_pod("c1", "dbpod", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB},
+                            volume_claims=("ghost-claim",))],
+        )
+        cache.volume_binder.add_pv(
+            PersistentVolume(name="pv-other", node="n1", claim="someone-else"))
+        run_actions(cache, action_names=["allocate"])
+        assert "c1/dbpod" not in cache.binder.binds
+
+    def test_two_claimants_one_pv(self):
+        """Two pods wanting the same pre-bound claim volume: exactly one may
+        hold it (second claimant of the same PVC is a config error upstream;
+        the ledger must still never double-book a PV)."""
+        from kube_batch_tpu.api.pod import PersistentVolume
+
+        cache = self._cache_with_pv_binder(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=8000, mem=16 * GiB)],
+            pods=[
+                build_pod("c1", "a", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB},
+                          volume_claims=("claim-a",)),
+                build_pod("c1", "b", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB},
+                          volume_claims=("claim-b",)),
+            ],
+        )
+        # single wildcard PV: only one claim can take it
+        cache.volume_binder.add_pv(PersistentVolume(name="pv1"))
+        run_actions(cache, action_names=["allocate"])
+        placed = [k for k in ("c1/a", "c1/b") if k in cache.binder.binds]
+        assert len(placed) == 1
+        assert len(cache.volume_binder.bound) == 1
+
+    def test_allocate_volumes_idempotent_per_task(self):
+        """The bulk-path volume pre-check followed by a demoted job's
+        sequential replay re-allocates the same task: must not double-book."""
+        from kube_batch_tpu.api.pod import PersistentVolume, Pod
+        from kube_batch_tpu.cache.volume import StandalonePVBinder
+        from kube_batch_tpu.api.task_info import TaskInfo
+        from kube_batch_tpu.api.resources import DEFAULT_SPEC
+
+        binder = StandalonePVBinder()
+        binder.add_pv(PersistentVolume(name="pv1"))
+        binder.add_pv(PersistentVolume(name="pv2"))
+        pod = Pod(name="p", namespace="c1", requests={"cpu": 100},
+                  volume_claims=("c",))
+        task = TaskInfo(pod, DEFAULT_SPEC)
+        binder.allocate_volumes(task, "n1")
+        binder.allocate_volumes(task, "n1")  # replay — same reservation
+        assert len(binder.reservations) == 1
+        assert len(binder.reservations[task.uid]) == 1
+        binder.allocate_volumes(task, "n2")  # moved host — superseded
+        assert len(binder.reservations[task.uid]) == 1
+        binder.bind_volumes(task)
+        assert len(binder.bound) == 1 and binder.reservations == {}
+
+
+class TestPDBGang:
+    """PodDisruptionBudget as the legacy gang source (event_handlers.go:
+    484-594): pods sharing a controller + a PDB on that controller form a
+    gang with the PDB's min-available, in the default queue, with
+    events-only status (job_updater.go:108-111)."""
+
+    def test_gang_defined_only_by_pdb_schedules(self):
+        from kube_batch_tpu.api.pod import PodDisruptionBudget
+
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB)],
+        )
+        cache.add_pdb(PodDisruptionBudget(
+            name="pdb1", namespace="c1", min_available=3, owner="rs-1"))
+        for i in range(3):
+            cache.add_pod(build_pod("c1", f"w{i}", None, PodPhase.PENDING,
+                                    {"cpu": 1000, "memory": GiB}, owner="rs-1"))
+        job = cache.jobs["c1/rs-1"]
+        assert job.pdb is not None and job.pod_group is None
+        assert job.min_available == 3 and job.queue == "default"
+        run_actions(cache, action_names=["allocate"])
+        assert len(cache.binder.binds) == 3
+
+    def test_pdb_gang_blocks_partial_placement(self):
+        from kube_batch_tpu.api.pod import PodDisruptionBudget
+
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=2000, mem=8 * GiB)],  # fits only 2
+        )
+        cache.add_pdb(PodDisruptionBudget(
+            name="pdb1", namespace="c1", min_available=3, owner="rs-1"))
+        for i in range(3):
+            cache.add_pod(build_pod("c1", f"w{i}", None, PodPhase.PENDING,
+                                    {"cpu": 1000, "memory": GiB}, owner="rs-1"))
+        run_actions(cache, action_names=["allocate"])
+        assert len(cache.binder.binds) == 0  # all-or-nothing gang
+        # events-only status: an Unschedulable event was recorded, and no
+        # PodGroup status write happened for the PDB job
+        assert any(kind == "Unschedulable" and key == "c1/rs-1"
+                   for kind, key, _ in cache.events)
+
+    def test_delete_pdb_releases_gang(self):
+        from kube_batch_tpu.api.pod import PodDisruptionBudget
+
+        cache = build_cache(queues=["default"],
+                            nodes=[build_node("n1", cpu=2000, mem=8 * GiB)])
+        pdb = PodDisruptionBudget(
+            name="pdb1", namespace="c1", min_available=3, owner="rs-1")
+        cache.add_pdb(pdb)
+        for i in range(3):
+            cache.add_pod(build_pod("c1", f"w{i}", None, PodPhase.PENDING,
+                                    {"cpu": 1000, "memory": GiB}, owner="rs-1"))
+        cache.delete_pdb(pdb)
+        job = cache.jobs["c1/rs-1"]
+        assert job.pdb is None
+        # the gang constraint is gone: the pods re-shadow as singletons and
+        # now schedule individually (2 of 3 fit the 2000m node)
+        assert job.pod_group is not None and job.pod_group.shadow
+        assert job.min_available == 1
+        run_actions(cache, action_names=["allocate"])
+        assert len(cache.binder.binds) == 2
+
+    def test_pods_before_pdb_ordering(self):
+        """Owner pods ingested BEFORE their PDB: the synthesized shadow
+        PodGroup must yield to the PDB as the gang source."""
+        from kube_batch_tpu.api.pod import PodDisruptionBudget
+
+        cache = build_cache(queues=["default"],
+                            nodes=[build_node("n1", cpu=2000, mem=8 * GiB)])
+        for i in range(3):
+            cache.add_pod(build_pod("c1", f"w{i}", None, PodPhase.PENDING,
+                                    {"cpu": 1000, "memory": GiB}, owner="rs-1"))
+        job = cache.jobs["c1/rs-1"]
+        assert job.pod_group is not None and job.pod_group.shadow
+        cache.add_pdb(PodDisruptionBudget(
+            name="pdb1", namespace="c1", min_available=3, owner="rs-1"))
+        assert job.pod_group is None and job.pdb is not None
+        assert job.min_available == 3
+        run_actions(cache, action_names=["allocate"])
+        assert len(cache.binder.binds) == 0  # gang of 3 can't fit 2 slots
