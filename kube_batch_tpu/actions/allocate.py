@@ -176,6 +176,15 @@ class AllocateAction(Action):
                             )
                 except FitFailure:
                     demoted_jobs.add(ji)
+                    # free this group's pre-check reservations: the slow
+                    # replay re-reserves per task, and tasks it fails to
+                    # place must not hold PVs across cycles
+                    release = getattr(
+                        ssn.cache.volume_binder, "release_task", None
+                    )
+                    if release is not None:
+                        for i in range(lo, bounds[g + 1]):
+                            release(task_objs[placed_l[i]].uid)
 
         apply_job = np.asarray(
             [committed[j] and not job_slow[j] and j not in demoted_jobs

@@ -179,7 +179,13 @@ class JobInfo:
 
     # -- gang predicates (job_info.go:367-418) ----------------------------
     def task_num(self, *statuses: TaskStatus) -> int:
-        return sum(len(self.task_status_index.get(s, {})) for s in statuses)
+        idx = self.task_status_index
+        n = 0
+        for s in statuses:
+            bucket = idx.get(s)
+            if bucket is not None:
+                n += len(bucket)
+        return n
 
     @property
     def ready_task_num(self) -> int:
@@ -228,7 +234,12 @@ class JobInfo:
         return f"job is not ready, {body}"
 
     def clone(self) -> "JobInfo":
-        j = JobInfo(self.uid, self.spec)
+        # fully manual copy, skipping __init__ (whose fresh Resource empties
+        # and defaultdict would be immediately overwritten) — hot in
+        # cache.snapshot at 50k tasks / 12.5k jobs
+        j = JobInfo.__new__(JobInfo)
+        j.uid = self.uid
+        j.spec = self.spec
         j.name = self.name
         j.namespace = self.namespace
         j.queue = self.queue
@@ -237,13 +248,19 @@ class JobInfo:
         j.creation_index = self.creation_index
         j.pod_group = self.pod_group.clone() if self.pod_group else None
         j.pdb = self.pdb  # immutable-by-convention after ingest
+        j.nodes_fit_delta = {}
+        j.nodes_fit_errors = {}
+        j.job_fit_errors = ""
         # direct index rebuild: add_task's per-task aggregate arithmetic
         # telescopes to a wholesale copy of the two ledgers (the clone is
-        # exact by construction — hot in cache.snapshot at 50k tasks)
-        for key, t in self.tasks.items():
-            c = t.clone()
-            j.tasks[key] = c
-            j.task_status_index[c.status][key] = c
+        # exact by construction). Bucket-wise comprehensions beat per-task
+        # defaultdict inserts.
+        new_tasks = {key: t.clone() for key, t in self.tasks.items()}
+        j.tasks = new_tasks
+        j.task_status_index = defaultdict(dict)
+        for status, bucket in self.task_status_index.items():
+            if bucket:
+                j.task_status_index[status] = {k: new_tasks[k] for k in bucket}
         j.allocated = self.allocated.clone()
         j.total_request = self.total_request.clone()
         return j

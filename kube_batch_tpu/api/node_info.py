@@ -52,6 +52,8 @@ class NodeInfo:
         self.idle = self.allocatable.clone()
         self.used = spec.empty()
         self.releasing = spec.empty()
+        # status each resident task was ACCOUNTED under (see task algebra)
+        self._acct: Dict[str, TaskStatus] = {}
         self._set_state()
 
     # -- state machine (node_info.go:110-134) -----------------------------
@@ -100,8 +102,10 @@ class NodeInfo:
         idle_v = self.allocatable.vec.copy()
         used_v = self.spec.empty().vec
         rel_v = self.spec.empty().vec
-        for t in self.tasks.values():
+        acct = self._acct
+        for key, t in self.tasks.items():
             r = t.resreq.vec
+            acct[key] = t.status  # re-account under the live status
             if t.status == TaskStatus.RELEASING:
                 rel_v += r
                 idle_v -= r
@@ -119,47 +123,54 @@ class NodeInfo:
         self._set_state()
 
     # -- task algebra (node_info.go:165-222) ------------------------------
-    def add_task(self, task: TaskInfo, _cloned: bool = False) -> None:
-        """The node holds its own *copy* of the task (node_info.go:165-168:
-        "Node will hold a copy of task to make sure the status change will
-        not impact resource in node") so a later in-place status mutation on
-        the caller's object can't desynchronize remove_task's reversal."""
+    # The reference clones each task into the node ("Node will hold a copy
+    # of task to make sure the status change will not impact resource in
+    # node", node_info.go:165-168). Here the node stores the caller's task
+    # object directly and records the status it ACCOUNTED under in the
+    # `_acct` side table — remove_task reverses from _acct, so a later
+    # in-place status mutation on the task still can't desynchronize the
+    # algebra, and the 50k-placement replay skips 50k task clones. Readers
+    # of node.tasks see live status (the reference's SetNode replay reads
+    # live status the same way).
+    def add_task(self, task: TaskInfo) -> None:
         key = task.key()
         graft_assert(key not in self.tasks, f"duplicate task {key} on node {self.name}")
-        if not _cloned:
-            task = task.clone()
+        status = task.status
         if self.node is not None:
             r = task.resreq
-            if task.status == TaskStatus.RELEASING:
+            if status == TaskStatus.RELEASING:
                 self.releasing.add_(r)
                 self.idle.sub_(r)
                 self.used.add_(r)
-            elif task.status == TaskStatus.PIPELINED:
+            elif status == TaskStatus.PIPELINED:
                 self.releasing.sub_(r)
                 self.used.add_(r)
-            elif is_allocated(task.status):
+            elif is_allocated(status):
                 self.idle.sub_(r)
                 self.used.add_(r)
             # terminal/pending statuses don't touch accounting
         task.node_name = self.name
         self.tasks[key] = task
+        self._acct[key] = status
 
     def remove_task(self, task: TaskInfo) -> None:
         key = task.key()
         existing = self.tasks.get(key)
         graft_assert(existing is not None, f"task {key} not on node {self.name}")
-        if self.node is not None and existing is not None:
-            r = existing.resreq
-            if existing.status == TaskStatus.RELEASING:
-                self.releasing.sub_(r)
-                self.idle.add_(r)
-                self.used.sub_(r)
-            elif existing.status == TaskStatus.PIPELINED:
-                self.releasing.add_(r)
-                self.used.sub_(r)
-            elif is_allocated(existing.status):
-                self.idle.add_(r)
-                self.used.sub_(r)
+        if existing is not None:
+            status = self._acct.pop(key, existing.status)
+            if self.node is not None:
+                r = existing.resreq
+                if status == TaskStatus.RELEASING:
+                    self.releasing.sub_(r)
+                    self.idle.add_(r)
+                    self.used.sub_(r)
+                elif status == TaskStatus.PIPELINED:
+                    self.releasing.add_(r)
+                    self.used.sub_(r)
+                elif is_allocated(status):
+                    self.idle.add_(r)
+                    self.used.sub_(r)
         self.tasks.pop(key, None)
 
     def update_task(self, task: TaskInfo) -> None:
@@ -172,15 +183,18 @@ class NodeInfo:
         carry an AllocatedStatus, `pipe_tasks` are Pipelined; `alloc_sum` /
         `pipe_sum` are the presummed Resources over each group.  The status
         algebra (node_info.go:165-222) collapses to two vector ops per group;
-        per-task work is only the clone + dict insert that add_task does."""
+        per-task work is the dict insert + _acct record."""
         tasks = self.tasks
-        for task in itertools.chain(alloc_tasks, pipe_tasks):
-            key = task.key()
-            if key in tasks:  # avoid building the message on the hot path
-                graft_assert(False, f"duplicate task {key} on node {self.name}")
-            copy = task.clone()
-            copy.node_name = self.name
-            tasks[key] = copy
+        acct = self._acct
+        name = self.name
+        for group in (alloc_tasks, pipe_tasks):
+            for task in group:
+                key = task._key
+                if key in tasks:  # avoid building the message on the hot path
+                    graft_assert(False, f"duplicate task {key} on node {self.name}")
+                task.node_name = name
+                tasks[key] = task
+                acct[key] = task.status
         if self.node is not None:
             self.idle.sub_(alloc_sum)
             self.used.add_(alloc_sum)
@@ -189,12 +203,22 @@ class NodeInfo:
 
     def clone(self) -> "NodeInfo":
         # direct copy of the accounting triple instead of replaying every
-        # resident task's status algebra (the triple already reflects it)
-        n = NodeInfo(self.node, self.spec)
+        # resident task's status algebra (the triple already reflects it);
+        # skips __init__ (which would rebuild allocatable/capability from
+        # the node dicts) — allocatable/capability are rebound on set_node,
+        # never mutated in place, so the clone shares them. Tasks ARE cloned:
+        # the session mutates its copies' statuses in place.
+        n = NodeInfo.__new__(NodeInfo)
+        n.spec = self.spec
+        n.name = self.name
+        n.node = self.node
+        n.allocatable = self.allocatable
+        n.capability = self.capability
         n.idle = self.idle.clone()
         n.used = self.used.clone()
         n.releasing = self.releasing.clone()
         n.tasks = {key: t.clone() for key, t in self.tasks.items()}
+        n._acct = dict(self._acct)
         n._state = self._state  # stored state carries over (not recomputed)
         return n
 

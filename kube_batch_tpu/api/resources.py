@@ -96,7 +96,13 @@ class ResourceSpec:
 
     # -- constructors -----------------------------------------------------
     def empty(self) -> "Resource":
-        return Resource(np.zeros(self.n), self)
+        # bypass __init__'s ascontiguousarray — np.zeros already is one
+        # (hot: every JobInfo/NodeInfo construction allocates empties)
+        r = Resource.__new__(Resource)
+        r._vec = np.zeros(self.n)
+        r.spec = self
+        r._addr = r._vec.ctypes.data
+        return r
 
     def build(
         self,

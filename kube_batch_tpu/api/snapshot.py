@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import operator
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -42,6 +43,9 @@ BITS = 32
 HARD_TAINT_EFFECTS = ("NoSchedule", "NoExecute")
 # Capability value meaning "unbounded" (queue without a Capability cap).
 UNBOUNDED = np.float32(3.4e38)
+
+
+_task_key = operator.attrgetter("_key")
 
 
 def bucket(n: int, floor: int = 8) -> int:
@@ -172,14 +176,13 @@ def build_snapshot(
     queues = sorted(cluster.queues.values(), key=lambda q: q.name)
     queue_idx = {q.name: i for i, q in enumerate(queues)}
     jobs = sorted(cluster.jobs.values(), key=lambda j: j.uid)
-    job_idx = {j.uid: i for i, j in enumerate(jobs)}
     nodes = sorted((n for n in cluster.nodes.values()), key=lambda n: n.name)
     node_idx = {n.name: i for i, n in enumerate(nodes)}
 
     tasks = []
-    for j in jobs:
-        for t in sorted(j.tasks.values(), key=lambda t: t.key()):
-            tasks.append((t, job_idx[j.uid]))
+    for ji, j in enumerate(jobs):
+        for t in sorted(j.tasks.values(), key=_task_key):
+            tasks.append((t, ji))
 
     nT, nN, nJ, nQ = len(tasks), len(nodes), len(jobs), len(queues)
     T = bucket(nT) if pad else max(nT, 1)
@@ -228,10 +231,16 @@ def build_snapshot(
     task_needs_host = np.zeros(nT, bool)
     if nT:
         task_objs = [t for t, _ in tasks]
-        task_keys.extend(t.key() for t in task_objs)
-        task_req[:nT] = np.stack([t.init_resreq.vec for t in task_objs])
-        task_resreq64 = np.stack([t.resreq.vec for t in task_objs]).astype(np.float64)
+        task_keys.extend(t._key for t in task_objs)
+        resreq_rows = [t.resreq.vec for t in task_objs]
+        task_resreq64 = np.stack(resreq_rows)  # .vec is already float64
         task_resreq[:nT] = task_resreq64
+        # init_resreq is the same Resource object as resreq for pods without
+        # init containers (task_info.py) — reuse the stack when nothing differs
+        if all(t.init_resreq is t.resreq for t in task_objs):
+            task_req[:nT] = task_resreq[:nT]
+        else:
+            task_req[:nT] = np.stack([t.init_resreq.vec for t in task_objs])
         task_needs_host = np.fromiter(
             (t.needs_host_predicate for t in task_objs), bool, count=nT
         )

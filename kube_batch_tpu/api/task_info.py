@@ -79,10 +79,14 @@ class TaskInfo:
         # Resreq = sum of app-container requests (job_info.go:73-80)
         self.resreq: Resource = _requests_to_resource(pod.requests, spec)
         # InitResreq = max(Resreq, each init container) (pod_info.go:53-73);
-        # ingest supplies the already-maxed init_requests map.
-        self.init_resreq: Resource = self.resreq.clone()
+        # ingest supplies the already-maxed init_requests map. Without init
+        # containers InitResreq IS Resreq — share the object (Resources are
+        # immutable-by-convention; snapshot build exploits the identity)
         if pod.init_requests:
+            self.init_resreq: Resource = self.resreq.clone()
             self.init_resreq.set_max_(_requests_to_resource(pod.init_requests, spec))
+        else:
+            self.init_resreq = self.resreq
         self.node_name: Optional[str] = pod.node_name
         self.status: TaskStatus = pod_phase_to_status(pod.phase, pod.node_name, pod.deleting)
         self.priority: int = pod.priority

@@ -25,7 +25,7 @@ from kube_batch_tpu.api.pod import Node, Pod, PodGroup, PriorityClass, Queue
 from kube_batch_tpu.api.queue_info import QueueInfo
 from kube_batch_tpu.api.resources import DEFAULT_SPEC, ResourceSpec
 from kube_batch_tpu.api.task_info import TaskInfo, job_id_for_pod
-from kube_batch_tpu.api.types import TaskStatus, is_allocated
+from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus, is_allocated
 from kube_batch_tpu.cache.fake import (
     FakeBinder,
     FakeEvictor,
@@ -394,16 +394,23 @@ class SchedulerCache:
             pods_get = self.pods.get
             by_job: Dict[str, list] = {}
             by_node: Dict[str, list] = {}
+            # the allocate replay emits binds grouped by job — run-length
+            # the job lookup instead of paying two dict probes per task
+            prev_job_uid = None
+            job = None
+            jlst: list = []
             for task, hostname in tasks_hosts:
                 key = task._key
-                job = jobs_get(task.job)
+                if task.job != prev_job_uid:
+                    prev_job_uid = task.job
+                    job = jobs_get(task.job)
+                    jlst = by_job.get(task.job)
+                    if jlst is None and job is not None:
+                        jlst = by_job[task.job] = []
                 own = job.tasks.get(key) if job is not None else None
                 if own is not None:
                     own.node_name = hostname
-                    lst = by_job.get(task.job)
-                    if lst is None:
-                        lst = by_job[task.job] = []
-                    lst.append(own)
+                    jlst.append(own)
                     node = nodes_get(hostname)
                     if node is not None and key not in node.tasks:
                         nlst = by_node.get(hostname)
@@ -411,6 +418,7 @@ class SchedulerCache:
                             nlst = by_node[hostname] = []
                         nlst.append(own)
                 staged.append((task, hostname, pods_get(key)))
+            nR = self.spec.n
             for job_uid, owns in by_job.items():
                 job = self.jobs[job_uid]
                 # bulk_transition needs a homogeneous allocated-ness flip;
@@ -418,20 +426,43 @@ class SchedulerCache:
                 flip = [t for t in owns if not is_allocated(t.status)]
                 noflip = [t for t in owns if is_allocated(t.status)]
                 if flip:
-                    s = self.spec.wrap_vec(np.sum([t.resreq.vec for t in flip], axis=0))
-                    job.bulk_transition(flip, TaskStatus.BINDING, s)
+                    # tight accumulation beats np.sum-over-list at gang sizes
+                    acc = np.zeros(nR)
+                    for t in flip:
+                        acc += t.resreq.vec
+                    job.bulk_transition(flip, TaskStatus.BINDING,
+                                        self.spec.wrap_vec(acc))
                 if noflip:
                     job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
             for hostname, owns in by_node.items():
                 node = self.nodes[hostname]
-                s = self.spec.wrap_vec(np.sum([t.resreq.vec for t in owns], axis=0))
-                node.bulk_add_tasks(owns, [], s, self.spec.empty())
+                acc = np.zeros(nR)
+                for t in owns:
+                    acc += t.resreq.vec
+                node.bulk_add_tasks(owns, [], self.spec.wrap_vec(acc), self.spec.empty())
         self._dispatch_async(staged)
 
     def _dispatch_async(self, staged) -> None:
         """Run the binder calls off-cycle (the async goroutine,
         cache.go:478-484); cache state was already updated under the lock."""
+        bind_many = getattr(self.binder, "bind_many", None)
+
         def run():
+            if bind_many is not None:
+                # batch path: one call for the whole cycle's placements (the
+                # per-pod loop competes with the scheduling thread for the
+                # GIL); per-task failure isolation falls back to bind()
+                pairs = [(pod, hostname) for task, hostname, pod in staged
+                         if pod is not None]
+                try:
+                    bind_many(pairs)
+                    self.events.extend(
+                        ("Scheduled", task._key, hostname)
+                        for task, hostname, pod in staged if pod is not None
+                    )
+                    return
+                except Exception:  # noqa: BLE001 — retry per-task below
+                    logger.exception("bind_many failed; retrying per task")
             for task, hostname, pod in staged:
                 try:
                     if pod is not None:
@@ -544,15 +575,28 @@ class SchedulerCache:
         self.events.append(("FailedScheduling", key, message))
 
     def record_job_status_event(self, job: JobInfo) -> None:
-        """Unschedulable PodGroup event + per-pending-task fit-error
-        conditions (cache.go:688-711)."""
-        base = job.fit_error()
-        self.events.append(("Unschedulable", job.uid, base))
-        for task in job.tasks.values():
-            if task.status != TaskStatus.PENDING:
-                continue
-            fe = job.nodes_fit_errors.get(task.uid)
-            self.task_unschedulable(task, fe.error() if fe is not None else base)
+        """Unschedulable event (gated like RecordJobStatusEvent,
+        cache.go:688-702: non-shadow PodGroup in Pending/Unknown phase, or a
+        PDB job with Pending tasks) + fit-error conditions for Allocated and
+        Pending tasks (cache.go:704-719). Called once per job at session
+        close via update_job_status / the PDB events-only path."""
+        base = job.job_fit_errors or job.fit_error()
+        pg = job.pod_group
+        shadow = pg is not None and pg.shadow
+        pg_unsched = (
+            pg is not None
+            and not shadow
+            and pg.phase in (PodGroupPhase.PENDING, PodGroupPhase.UNKNOWN)
+        )
+        pdb_unsched = job.pdb is not None and bool(
+            job.task_status_index.get(TaskStatus.PENDING)
+        )
+        if pg_unsched or pdb_unsched:
+            self.events.append(("Unschedulable", job.uid, base))
+        for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
+            for task in job.task_status_index.get(status, {}).values():
+                fe = job.nodes_fit_errors.get(task.uid)
+                self.task_unschedulable(task, fe.error() if fe is not None else base)
 
     def update_job_status(self, job: JobInfo) -> None:
         """Write the session's derived PodGroup status back to the
@@ -568,6 +612,7 @@ class SchedulerCache:
         pg = job.pod_group
         if pg is None:
             return
+        write = True
         with self._lock:
             own = self.jobs.get(job.uid)
             if own is None:
@@ -580,18 +625,22 @@ class SchedulerCache:
                 == (pg.running, pg.failed, pg.succeeded)
             )
             now = _time.monotonic()
-            if condition_only:
-                next_ok = self._status_next_write.get(job.uid, 0.0)
-                if now < next_ok:
-                    return  # rate-limited; session state is already updated
-            self._status_next_write[job.uid] = now + 60.0 + random.uniform(0, 30.0)
-            if own_pg is not None:
-                own_pg.phase = pg.phase
-                own_pg.conditions = list(pg.conditions)
-                own_pg.running = pg.running
-                own_pg.failed = pg.failed
-                own_pg.succeeded = pg.succeeded
-        self.status_updater.update_pod_group(pg)
+            if condition_only and now < self._status_next_write.get(job.uid, 0.0):
+                write = False  # rate-limited; session state already updated
+            if write:
+                self._status_next_write[job.uid] = now + 60.0 + random.uniform(0, 30.0)
+                if own_pg is not None:
+                    own_pg.phase = pg.phase
+                    own_pg.conditions = list(pg.conditions)
+                    own_pg.running = pg.running
+                    own_pg.failed = pg.failed
+                    own_pg.succeeded = pg.succeeded
+        if write:
+            self.status_updater.update_pod_group(pg)
+        # events accompany every status pass, rate-limited or not, once per
+        # job per close (UpdateJobStatus → RecordJobStatusEvent,
+        # cache.go:722-736); task_unschedulable dedups the conditions
+        self.record_job_status_event(job)
 
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)

@@ -19,7 +19,20 @@ class FakeBinder:
         key = f"{pod.namespace}/{pod.name}"
         self.binds[key] = hostname
         self.channel.append(key)
-        self.event.set()
+        if not self.event.is_set():  # set() takes a lock — skip when already up
+            self.event.set()
+
+    def bind_many(self, pairs) -> None:
+        """Batch bind — one call per cycle from the cache's async
+        dispatcher; must be all-or-nothing (the dispatcher retries per-task
+        through bind() on failure). Subclasses overriding bind() must
+        override bind_many() too — the dispatcher prefers this batch
+        entrypoint whenever the binder exposes it."""
+        keys = [f"{pod.namespace}/{pod.name}" for pod, _ in pairs]
+        self.binds.update(zip(keys, (h for _, h in pairs)))
+        self.channel.extend(keys)
+        if not self.event.is_set():
+            self.event.set()
 
 
 class FakeEvictor:

@@ -34,13 +34,26 @@ class StandalonePVBinder:
         self.bound: Dict[str, str] = {}  # claim → pv name (durable binding)
         # task uid → {claim: pv name} (assumed, this cycle)
         self.reservations: Dict[str, Dict[str, str]] = {}
+        self._sorted_pvs: list = None  # memo; invalidated on ledger change
 
     # -- ledger ingest (pv informer analog) ------------------------------
     def add_pv(self, pv: PersistentVolume) -> None:
         self.pvs[pv.name] = pv
+        self._sorted_pvs = None
 
     def delete_pv(self, name: str) -> None:
         self.pvs.pop(name, None)
+        self._sorted_pvs = None
+
+    def _candidates(self) -> list:
+        """PVs in match order (pre-bound first), memoized — _resolve runs
+        once per (node, claim) on the sequential placement path and must not
+        re-sort the ledger every probe."""
+        if self._sorted_pvs is None:
+            self._sorted_pvs = sorted(
+                self.pvs.values(), key=lambda pv: (pv.claim is None, pv.name)
+            )
+        return self._sorted_pvs
 
     # -- internals --------------------------------------------------------
     def _reserved_pvs(self, excluding_task: Optional[str] = None) -> set:
@@ -59,11 +72,7 @@ class StandalonePVBinder:
             if pv is not None and pv.node in (None, hostname):
                 return bound_pv
             return None
-        candidates = sorted(
-            self.pvs.values(),
-            key=lambda pv: (pv.claim is None, pv.name),  # pre-bound first
-        )
-        for pv in candidates:
+        for pv in self._candidates():
             if pv.claim is not None and pv.claim != claim:
                 continue
             if pv.node not in (None, hostname):

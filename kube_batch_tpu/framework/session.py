@@ -434,6 +434,12 @@ class Statement:
         if node is not None:
             node.remove_task(task)
         task.node_name = None
+        task.volume_ready = False
+        # free the PV reservation the allocate took — a discarded gang must
+        # not hold volumes across cycles and starve other claimants
+        release = getattr(self.ssn.cache.volume_binder, "release_task", None)
+        if release is not None:
+            release(task.uid)
         self.ssn._fire(False, task)
 
 

@@ -43,13 +43,23 @@ from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
 
 NEG = jnp.float32(-3.0e38)
 
+# the multiplicative hash constants as wrapped int32 (two's complement):
+# int32 wrapping arithmetic is bit-identical to uint32 mod-2^32, and staying
+# in int32 avoids uint32<->float casts TPU Pallas doesn't support
+_H1 = 0x9E3779B1 - (1 << 32)
+_H2 = 0x85EBCA77 - (1 << 32)
+_H3 = 0xCA87C3EB - (1 << 32)
+
+
 def _tie_break_hash(T: int, N: int) -> jnp.ndarray:
-    """[T, N] deterministic per-(task, node) hash in [0, 1)."""
-    ti = jnp.arange(T, dtype=jnp.uint32)[:, None]
-    ni = jnp.arange(N, dtype=jnp.uint32)[None, :]
-    h = ti * jnp.uint32(0x9E3779B1) + ni * jnp.uint32(0x85EBCA77)
-    h = (h ^ (h >> 15)) * jnp.uint32(0xCA87C3EB)
-    return (h >> 16).astype(jnp.float32) / 65536.0
+    """[T, N] deterministic per-(task, node) hash in [0, 65535] (i32).
+    Ordering is identical to the previous float form (a monotone rescale of
+    the same 16 hash bits)."""
+    ti = jnp.arange(T, dtype=jnp.int32)[:, None]
+    ni = jnp.arange(N, dtype=jnp.int32)[None, :]
+    h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
+    h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
+    return jax.lax.shift_right_logical(h, 16)
 
 
 def _best_node(masked: jnp.ndarray, tie_hash: jnp.ndarray):
@@ -63,7 +73,7 @@ def _best_node(masked: jnp.ndarray, tie_hash: jnp.ndarray):
     Returns (best [T] i32, has [T] bool)."""
     best_val = jnp.max(masked, axis=1)
     tie = masked >= best_val[:, None]
-    best = jnp.argmax(jnp.where(tie, tie_hash, -1.0), axis=1).astype(jnp.int32)
+    best = jnp.argmax(jnp.where(tie, tie_hash, -1), axis=1).astype(jnp.int32)
     return best, best_val > NEG
 
 
@@ -192,6 +202,8 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         ),
     )
     score = score_matrix(snap, config.weights)
+    # static predicates folded into the score once — every round reuses it
+    score_static = jnp.where(static_ok, score, NEG)
     tie_hash = _tie_break_hash(T, N)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
 
@@ -208,8 +220,8 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         & snap.job_schedulable[snap.task_job]
     )
 
-    def outer_body(state, _):
-        idle, releasing, used, assigned, pipelined, job_failed = state
+    def outer_body(state):
+        idle, releasing, used, assigned, pipelined, job_failed, o, _more = state
 
         # ---- fairness state + virtual-time rank, once per outer pass -----
         # (the rank is a static plan for the whole round set: virtual time
@@ -276,9 +288,22 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
                 fit_idle = None
             else:
                 fit_idle = fits(snap.task_req, idle, snap.quanta)
-                fit_rel = fits(snap.task_req, releasing, snap.quanta)
-                feas = static_ok & (fit_idle | fit_rel) & pending[:, None]
-                masked = jnp.where(feas, score, NEG)
+                # zero-releasing clusters (every allocate-only cycle) skip
+                # the second [T, N] fit entirely: with an all-zero budget the
+                # only "fits" are tasks below quanta in every dim — BestEffort
+                # tasks, which are never solver-pending (task_pending
+                # excludes them), so all-False is exact for solver outputs
+                fit_rel = jax.lax.cond(
+                    jnp.any(releasing > 0.0),
+                    lambda rel: fits(snap.task_req, rel, snap.quanta),
+                    lambda rel: jnp.zeros_like(fit_idle),
+                    releasing,
+                )
+                # score_static pre-folds the loop-invariant static predicate
+                # mask into the score (hoisted out of the rounds)
+                masked = jnp.where(
+                    (fit_idle | fit_rel) & pending[:, None], score_static, NEG
+                )
                 best, has = _best_node(masked, tie_hash)
             if config.proportion:
                 new_alloc_cnt = jax.ops.segment_sum(
@@ -363,9 +388,19 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         idle = idle + rev_alloc
         releasing = releasing + rev_pipe
         used = used - rev_alloc - rev_pipe
+        reverted_any = jnp.any(revert)
         assigned = jnp.where(revert, -1, assigned)
         pipelined = pipelined & ~revert
-        return (idle, releasing, used, assigned, pipelined, job_failed), None
+        # still work to do? only when this iteration reverted a gang (freed
+        # capacity another job can grab) AND schedulable pending tasks remain
+        more = reverted_any & jnp.any(
+            eligible & (assigned < 0) & ~job_failed[snap.task_job]
+        )
+        return (idle, releasing, used, assigned, pipelined, job_failed, o + 1, more)
+
+    def outer_cond(state):
+        *_, o, more = state
+        return (o < config.outer) & more
 
     init = (
         snap.node_idle,
@@ -374,9 +409,13 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         jnp.full(T, -1, jnp.int32),
         jnp.zeros(T, bool),
         jnp.zeros(J, bool),
+        jnp.int32(0),
+        jnp.bool_(True),
     )
-    (idle, releasing, used, assigned, pipelined, _), _ = jax.lax.scan(
-        outer_body, init, None, length=config.outer
+    # while_loop with early exit — a scan would pay every outer iteration
+    # (~12% of solve time each) even after everything is placed
+    (idle, releasing, used, assigned, pipelined, _, _, _) = jax.lax.while_loop(
+        outer_cond, outer_body, init
     )
 
     # after the final outer revert, every surviving placement belongs to a

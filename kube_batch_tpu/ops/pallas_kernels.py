@@ -5,16 +5,22 @@ two-key tie-broken argmax (ops/assignment.py round_body's first half) into
 one VMEM pass per task tile: the [T, N] fit matrices are never materialized
 in HBM — req/idle/releasing live in VMEM and the fit predicate is computed
 on the fly per node tile; only the score and static-predicate matrices
-stream in, and three [T] vectors stream out.
+stream in, and three [T]-shaped vectors stream out.
 
 The XLA path computes the same values with fused broadcasts; this kernel
 exists to cut the intermediate [T, N] bool traffic on real TPU. It is
-opt-in (AllocateConfig.use_pallas / env KB_PALLAS=1) and falls back to
+opt-in (AllocateConfig.use_pallas, wired to env KB_PALLAS=1 / the
+`allocate.pallas` conf argument by the allocate action) and falls back to
 interpret mode off-TPU so the parity tests run everywhere.
+
+TPU lowering constraints shape the kernel: everything is float32 or int32
+(no uint32, no bool refs — the Mosaic lowering in this jax version supports
+neither), and every ref is ≥2-D (1-D refs mis-tile). Masks travel as f32
+0/1 and outputs are (T, 1) columns squeezed by the wrapper.
 
 Reference semantics carried over: epsilon-tolerant fit (resource_info.go:
 269-284 LessEqual), SelectBestNode's uniform tie-break among max-score nodes
-(scheduler_helper.go:147-158) via the same per-(task, node) hash as
+(scheduler_helper.go:147-158) via the same per-(task, node) int32 hash as
 ops/assignment._tie_break_hash.
 """
 
@@ -25,7 +31,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 # plain Python float — a jnp scalar would be a captured constant, which
 # pallas_call rejects
@@ -46,27 +51,33 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
     # fit[t, n] = all_r req[t, r] <= budget[n, r] + quanta[r]  (tolerant
     # LessEqual); R is tiny and static — unrolled, no [TM, N, R] tensor
     def fit_matrix(budget_ref):
-        fit = jnp.ones((TM, N), dtype=jnp.bool_)
+        fit = None
         for r in range(R):
-            fit &= req[:, r][:, None] <= budget_ref[:, r][None, :] + quanta[0, r]
+            f = req[:, r][:, None] <= budget_ref[:, r][None, :] + quanta[0, r]
+            fit = f if fit is None else (fit & f)
         return fit
 
     fit_idle = fit_matrix(idle_ref)
     fit_rel = fit_matrix(rel_ref)
-    pending = pending_ref[:]              # [TM]
-    feas = static_ref[:].astype(jnp.bool_) & (fit_idle | fit_rel) & pending[:, None]
+    pending = pending_ref[:] > 0.0        # [TM, 1] f32 0/1 → bool
+    feas = (static_ref[:] > 0.0) & (fit_idle | fit_rel) & pending
     masked = jnp.where(feas, score_ref[:], NEG)
 
     # two-key argmax: exact max score, then per-(task, node) hash among ties
-    # (ops/assignment._tie_break_hash — same constants)
+    # (ops/assignment._tie_break_hash — same constants, same int32 wrapping
+    # arithmetic)
+    from kube_batch_tpu.ops.assignment import _H1, _H2, _H3
+
     ti = (
-        jax.lax.broadcasted_iota(jnp.uint32, (TM, N), 0)
-        + jnp.uint32(pl.program_id(0) * TM)
+        jax.lax.broadcasted_iota(jnp.int32, (TM, N), 0)
+        + pl.program_id(0) * TM
     )
-    ni = jax.lax.broadcasted_iota(jnp.uint32, (TM, N), 1)
-    h = ti * jnp.uint32(0x9E3779B1) + ni * jnp.uint32(0x85EBCA77)
-    h = (h ^ (h >> 15)) * jnp.uint32(0xCA87C3EB)
-    tie_hash = (h >> 16).astype(jnp.float32) / 65536.0
+    ni = jax.lax.broadcasted_iota(jnp.int32, (TM, N), 1)
+    h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
+    h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
+    # Mosaic's argmax lowering is f32-only; the 16 hash bits are exactly
+    # representable in f32, so the cast preserves the ordering
+    tie_hash = jax.lax.shift_right_logical(h, 16).astype(jnp.float32)
 
     best_val = jnp.max(masked, axis=1)    # [TM]
     tie = masked >= best_val[:, None]
@@ -74,9 +85,9 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (TM, N), 1)
     chose_idle = jnp.any(fit_idle & (col == best[:, None]), axis=1)
 
-    best_ref[:] = best
-    has_ref[:] = best_val > NEG
-    chose_idle_ref[:] = chose_idle
+    best_ref[:] = best[:, None]
+    has_ref[:] = jnp.where(best_val > NEG, 1.0, 0.0)[:, None]
+    chose_idle_ref[:] = jnp.where(chose_idle, 1.0, 0.0)[:, None]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -97,9 +108,9 @@ def masked_best_node(
     R = task_req.shape[1]
     tile = min(TASK_TILE, T)
     grid = (T // tile,)
-    q2 = quanta.reshape(1, R)
+    q2 = quanta.reshape(1, R).astype(jnp.float32)
 
-    return pl.pallas_call(
+    best, has, chose = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
@@ -108,18 +119,27 @@ def masked_best_node(
             pl.BlockSpec((tile, R), lambda i: (i, 0)),                 # req
             pl.BlockSpec((N, R), lambda i: (0, 0)),                    # idle
             pl.BlockSpec((N, R), lambda i: (0, 0)),                    # releasing
-            pl.BlockSpec((tile,), lambda i: (i,)),                     # pending
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),                 # pending
             pl.BlockSpec((1, R), lambda i: (0, 0)),                    # quanta
         ],
         out_specs=[
-            pl.BlockSpec((tile,), lambda i: (i,)),
-            pl.BlockSpec((tile,), lambda i: (i,)),
-            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((T,), jnp.int32),
-            jax.ShapeDtypeStruct((T,), jnp.bool_),
-            jax.ShapeDtypeStruct((T,), jnp.bool_),
+            jax.ShapeDtypeStruct((T, 1), jnp.int32),
+            jax.ShapeDtypeStruct((T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((T, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(score, static_ok, task_req, idle, releasing, pending, q2)
+    )(
+        score.astype(jnp.float32),
+        static_ok.astype(jnp.float32),
+        task_req.astype(jnp.float32),
+        idle.astype(jnp.float32),
+        releasing.astype(jnp.float32),
+        pending.astype(jnp.float32)[:, None],
+        q2,
+    )
+    return best[:, 0], has[:, 0] > 0.0, chose[:, 0] > 0.0

@@ -45,11 +45,10 @@ class DrfPlugin(Plugin):
         for node in ssn.nodes.values():
             self.total.add_(node.allocatable)
         for job in ssn.jobs.values():
-            attr = _JobAttr(ssn.spec.empty())
-            for status, tasks in job.task_status_index.items():
-                if is_allocated(status):
-                    for t in tasks.values():
-                        attr.allocated.add_(t.resreq)
+            # job.allocated IS the sum of allocated-status task resreqs —
+            # the ledger add_task/bulk_transition maintain (job_info.py);
+            # re-deriving it per task was the session-open hot loop
+            attr = _JobAttr(job.allocated.clone())
             self._update_share(attr)
             self.job_attrs[job.uid] = attr
 

@@ -74,6 +74,7 @@ class GangPlugin(Plugin):
             message = job.fit_error() + (
                 f"; {fit_errors[0]}" if fit_errors else ""
             )
+            job.job_fit_errors = message  # read by RecordJobStatusEvent
             ssn.update_job_condition(
                 job,
                 PodGroupCondition(
@@ -84,4 +85,6 @@ class GangPlugin(Plugin):
                     message=message,
                 ),
             )
-            ssn.cache.record_job_status_event(job)
+            # events are recorded once per job by the close-session status
+            # pass (UpdateJobStatus → RecordJobStatusEvent, cache.go:722-736)
+            # — the reference's gang close writes conditions only

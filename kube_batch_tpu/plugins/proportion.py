@@ -60,14 +60,17 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 attr = _QueueAttr(ssn.queues[job.queue], spec)
                 self.queue_attrs[job.queue] = attr
-            for status, tasks in job.task_status_index.items():
-                if is_allocated(status):
-                    for t in tasks.values():
-                        attr.allocated.add_(t.resreq)
-                        attr.request.add_(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add_(t.resreq)
+            # allocated-status sum is the job.allocated ledger; only the
+            # Pending bucket needs walking (request = allocated + pending,
+            # proportion.go:87-99)
+            attr.allocated.add_(job.allocated)
+            attr.request.add_(job.allocated)
+            pend = job.task_status_index.get(TaskStatus.PENDING)
+            if pend:
+                acc = np.zeros(spec.n)
+                for t in pend.values():
+                    acc += t.resreq.vec
+                attr.request.add_(spec.wrap_vec(acc))
         self._waterfill(spec)
         for attr in self.queue_attrs.values():
             self._update_share(attr)

@@ -149,6 +149,12 @@ def _startup_latency_case(name, n_latency_pods=3_000, n_nodes=100, batch=100,
                 scheduled[f"{pod.namespace}/{pod.name}"] = _time.perf_counter()
                 super().bind(pod, hostname)
 
+            def bind_many(self, pairs):
+                now = _time.perf_counter()
+                for pod, _ in pairs:
+                    scheduled[f"{pod.namespace}/{pod.name}"] = now
+                super().bind_many(pairs)
+
         cache = SchedulerCache(binder=TimestampingBinder())
         cache.add_queue(Queue(name="default", weight=1))
         for i in range(n_nodes):

@@ -794,3 +794,37 @@ class TestPDBGang:
         assert job.min_available == 3
         run_actions(cache, action_names=["allocate"])
         assert len(cache.binder.binds) == 0  # gang of 3 can't fit 2 slots
+
+    def test_discarded_gang_releases_pv_reservations(self):
+        """A gang that can't fully place must not hold PV reservations
+        across cycles (Statement discard releases assumed volumes), so other
+        claimants of the same wildcard PV still schedule."""
+        from kube_batch_tpu.api.pod import PersistentVolume
+
+        cache = self._cache_with_pv_binder(
+            queues=["default"],
+            pod_groups=[PodGroup(name="gang2", namespace="c1", min_member=2,
+                                 queue="default")],
+            nodes=[build_node("n1", cpu=8000, mem=16 * GiB)],
+            pods=[
+                # task A: satisfiable claim; task B: unsatisfiable → the
+                # gang discards every cycle
+                build_pod("c1", "a", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="gang2",
+                          volume_claims=("claim-a",)),
+                build_pod("c1", "b", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="gang2",
+                          volume_claims=("ghost",)),
+                # independent singleton wanting the same wildcard PV
+                build_pod("c1", "solo", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB},
+                          volume_claims=("claim-solo",)),
+            ],
+        )
+        cache.volume_binder.add_pv(PersistentVolume(name="pv1"))
+        run_actions(cache, action_names=["allocate"])
+        assert "c1/a" not in cache.binder.binds  # gang blocked
+        assert "c1/b" not in cache.binder.binds
+        assert cache.binder.binds.get("c1/solo") == "n1"
+        # no reservation lingers for the discarded gang
+        assert cache.volume_binder.reservations == {}
