@@ -155,18 +155,37 @@ class JobInfo:
         new_alloc = is_allocated(status)
         idx = self.task_status_index
         new_bucket = idx[status]
-        flipped = 0
-        for task in tasks:
-            key = task._key
-            bucket = idx.get(task.status)
-            if bucket is not None:
-                bucket.pop(key, None)
-                if not bucket and bucket is not new_bucket:
-                    del idx[task.status]
-            if is_allocated(task.status) != new_alloc:
-                flipped += 1
-            task.status = status
-            new_bucket[key] = task
+        # wholesale fast path: the batch IS an entire source bucket moving
+        # into an empty destination (the common shape — a fully-placed gang's
+        # Pending bucket becoming Binding): rebind the dict instead of
+        # popping/inserting per task
+        src_status = tasks[0].status
+        src_bucket = idx.get(src_status)
+        if (
+            not new_bucket
+            and src_bucket is not None
+            and len(src_bucket) == len(tasks)
+            and src_status != status
+            and all(t.status == src_status for t in tasks)
+        ):
+            del idx[src_status]
+            idx[status] = src_bucket
+            flipped = len(tasks) if is_allocated(src_status) != new_alloc else 0
+            for task in tasks:
+                task.status = status
+        else:
+            flipped = 0
+            for task in tasks:
+                key = task._key
+                bucket = idx.get(task.status)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket and bucket is not new_bucket:
+                        del idx[task.status]
+                if is_allocated(task.status) != new_alloc:
+                    flipped += 1
+                task.status = status
+                new_bucket[key] = task
         if flipped:
             graft_assert(
                 flipped == len(tasks),

@@ -30,6 +30,8 @@ from kube_batch_tpu.utils.assertions import graft_assert
 _LIB = _native.resource_lib  # None → numpy fallback (semantics identical)
 
 # Minimum comparison quanta, resource_info.go:66-72.
+_F64 = np.dtype(np.float64)
+
 MIN_MILLI_CPU = 10.0
 MIN_MEMORY = 10.0 * 1024 * 1024  # 10 MiB
 MIN_MILLI_SCALAR = 10.0
@@ -96,13 +98,9 @@ class ResourceSpec:
 
     # -- constructors -----------------------------------------------------
     def empty(self) -> "Resource":
-        # bypass __init__'s ascontiguousarray — np.zeros already is one
+        # np.zeros is already contiguous f64 — take the raw path
         # (hot: every JobInfo/NodeInfo construction allocates empties)
-        r = Resource.__new__(Resource)
-        r._vec = np.zeros(self.n)
-        r.spec = self
-        r._addr = r._vec.ctypes.data
-        return r
+        return _raw_resource(np.zeros(self.n), self)
 
     def build(
         self,
@@ -135,8 +133,24 @@ class ResourceSpec:
     def wrap_vec(self, vec: np.ndarray) -> "Resource":
         """Resource over `vec` WITHOUT copying — for freshly-computed rows the
         caller owns and will not mutate (the allocate replay's segment sums).
-        Use from_vec for foreign arrays."""
+        Use from_vec for foreign arrays. The row must already be contiguous
+        float64 (rows of C-order float64 matrices are) — the slow setter
+        normalizes anything else."""
+        if vec.dtype == _F64 and vec.flags.c_contiguous:
+            return _raw_resource(vec, self)
         return Resource(vec, self)
+
+
+def _raw_resource(vec: np.ndarray, spec: "ResourceSpec") -> "Resource":
+    """Construct a Resource over an already-contiguous float64 buffer,
+    bypassing __init__'s normalization. The ONLY place (besides the .vec
+    setter) that maintains the __slots__ triple and the _addr↔buffer
+    invariant the native C fast path depends on."""
+    r = Resource.__new__(Resource)
+    r._vec = vec
+    r.spec = spec
+    r._addr = vec.ctypes.data
+    return r
 
 
 DEFAULT_SPEC = ResourceSpec()
@@ -190,13 +204,9 @@ class Resource:
         return float(self.vec[self.spec.index(name)])
 
     def clone(self) -> "Resource":
-        # hot in cache.snapshot's deep clone — bypass __init__'s
-        # ascontiguousarray (a copy of a contiguous f64 buffer already is one)
-        r = Resource.__new__(Resource)
-        r._vec = self._vec.copy()
-        r.spec = self.spec
-        r._addr = r._vec.ctypes.data
-        return r
+        # hot in cache.snapshot's deep clone — a copy of a contiguous f64
+        # buffer is already one; take the raw path
+        return _raw_resource(self._vec.copy(), self.spec)
 
     # -- predicates (resource_info.go:134-160) ----------------------------
     def is_empty(self) -> bool:

@@ -82,6 +82,8 @@ class SchedulerCache:
         # background repair loop (cache.go:342-384) — started by run()
         self._repair_thread: Optional[threading.Thread] = None
         self._repair_stop = threading.Event()
+        # initial-sync barrier (WaitForCacheSync analog, cache.go:363-384)
+        self._synced = threading.Event()
 
     # ------------------------------------------------------------------
     # background repair loops (cache.go:342-384)
@@ -108,6 +110,29 @@ class SchedulerCache:
             target=loop, name="kb-cache-repair", daemon=True
         )
         self._repair_thread.start()
+
+    def mark_synced(self) -> None:
+        """Signal that the initial cluster sync is complete (the informer
+        HasSynced analog) — set by load_state, by POST /v1/sync on the ingest
+        API, or implicitly by the wait timeout below."""
+        self._synced.set()
+
+    def wait_for_cache_sync(self, timeout: Optional[float] = None) -> bool:
+        """WaitForCacheSync (cache.go:363-384): block the scheduling loop
+        until the initial state has landed. Standalone there are no LIST
+        watermarks, so "synced" is an explicit signal (mark_synced / the
+        ingest API's sync barrier) with a bounded wait: on timeout the loop
+        proceeds with whatever arrived — convergence-by-re-running covers a
+        late-arriving remainder exactly like any other cluster change."""
+        if timeout is None:
+            return self._synced.is_set()
+        ok = self._synced.wait(timeout)
+        if not ok:
+            logger.warning(
+                "cache sync signal not received within %.1fs; scheduling over "
+                "%d nodes / %d jobs as-is", timeout, len(self.nodes), len(self.jobs),
+            )
+        return ok
 
     def stop(self) -> None:
         self._repair_stop.set()

@@ -30,6 +30,10 @@ class ServerOption:
     # standalone-only: durable-state file (the etcd analog, SURVEY.md §5.4);
     # empty = in-memory only
     state_file: str = ""
+    # standalone-only: how long the first cycle waits for the initial-sync
+    # barrier (POST /v1/sync or a restored state file) — the WaitForCacheSync
+    # analog; 0 = don't wait (clients that never signal lose nothing)
+    cache_sync_timeout: float = 0.0
 
     def check_option_or_die(self) -> None:
         """(options.go:84-90): leader election requires a lock namespace;
@@ -92,6 +96,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--state-file", default=d.state_file,
                         help="durable cluster-state JSON (standalone etcd "
                              "analog); loaded at startup, saved each cycle")
+    parser.add_argument("--cache-sync-timeout", default=d.cache_sync_timeout,
+                        type=float,
+                        help="seconds to wait for the initial-sync barrier "
+                             "(POST /v1/sync) before the first cycle; 0 = "
+                             "don't wait")
 
 
 def parse(argv: Optional[List[str]] = None) -> ServerOption:
@@ -113,6 +122,7 @@ def parse(argv: Optional[List[str]] = None) -> ServerOption:
         kube_api_burst=ns.kube_api_burst,
         print_version=ns.version,
         state_file=ns.state_file,
+        cache_sync_timeout=ns.cache_sync_timeout,
     )
     global server_opts
     server_opts = opt

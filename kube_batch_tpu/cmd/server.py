@@ -197,6 +197,12 @@ def make_handler(cache: SchedulerCache):
             self._send(200, json.dumps({"ok": True}))
 
         def do_POST(self):
+            if self.path == "/v1/sync":
+                # initial-sync barrier: a client that finished its re-list
+                # signals the scheduler to start (WaitForCacheSync analog)
+                cache.mark_synced()
+                self._send(200, "{}")
+                return
             self._ingest(delete=False)
 
         def do_DELETE(self):
@@ -285,6 +291,7 @@ def run(opt: ServerOption) -> None:
 
         if load_state(cache, opt.state_file):
             logger.info("restored cluster state from %s", opt.state_file)
+            cache.mark_synced()  # the state file IS the initial listing
         on_cycle_end = lambda: save_state(cache, opt.state_file)  # noqa: E731
     sched = Scheduler(
         cache,
@@ -296,6 +303,12 @@ def run(opt: ServerOption) -> None:
     admin = AdminServer(cache, host, port)
     admin.start()
     logger.info("admin/metrics listening on %s:%d", host, admin.port)
+    # WaitForCacheSync (scheduler.go:64 / cache.go:363-384): give clients a
+    # bounded window to land their initial listing (or POST /v1/sync) before
+    # the first cycle; on timeout schedule whatever arrived. Off by default —
+    # only deployments whose clients signal the barrier opt in.
+    if opt.cache_sync_timeout > 0:
+        cache.wait_for_cache_sync(timeout=opt.cache_sync_timeout)
     try:
         if opt.enable_leader_election:
             elector = LeaderElector(opt.lock_object_namespace)

@@ -352,11 +352,16 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             pipelined = pipelined | acc_p
             return (idle, releasing, used, assigned, pipelined, i + 1, jnp.any(newly))
 
-        (idle, releasing, used, assigned, pipelined, _, _) = jax.lax.while_loop(
-            round_cond,
-            round_body,
-            (idle, releasing, used, assigned, pipelined, jnp.int32(0), jnp.bool_(True)),
+        (idle, releasing, used, assigned, pipelined, rounds_i, rounds_progress) = (
+            jax.lax.while_loop(
+                round_cond,
+                round_body,
+                (idle, releasing, used, assigned, pipelined,
+                 jnp.int32(0), jnp.bool_(True)),
+            )
         )
+        # inner loop capped while still placing? another outer pass continues
+        rounds_capped = rounds_progress & (rounds_i >= config.rounds)
         # ---- gang commit/discard (vectorized Statement) -----------------
         new_alloc_cnt = jax.ops.segment_sum(
             ((assigned >= 0) & ~pipelined).astype(jnp.int32),
@@ -391,9 +396,10 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         reverted_any = jnp.any(revert)
         assigned = jnp.where(revert, -1, assigned)
         pipelined = pipelined & ~revert
-        # still work to do? only when this iteration reverted a gang (freed
-        # capacity another job can grab) AND schedulable pending tasks remain
-        more = reverted_any & jnp.any(
+        # still work to do? when this iteration reverted a gang (freed
+        # capacity another job can grab) OR the bidding rounds hit their cap
+        # while still placing — AND schedulable pending tasks remain
+        more = (reverted_any | rounds_capped) & jnp.any(
             eligible & (assigned < 0) & ~job_failed[snap.task_job]
         )
         return (idle, releasing, used, assigned, pipelined, job_failed, o + 1, more)

@@ -800,8 +800,14 @@ class TestPDBGang:
         across cycles (Statement discard releases assumed volumes), so other
         claimants of the same wildcard PV still schedule."""
         from kube_batch_tpu.api.pod import PersistentVolume
+        from kube_batch_tpu.cache.volume import StandalonePVBinder
 
-        cache = self._cache_with_pv_binder(
+        def _cache_with_pv_binder(**kw):
+            cache = build_cache(**kw)
+            cache.volume_binder = StandalonePVBinder()
+            return cache
+
+        cache = _cache_with_pv_binder(
             queues=["default"],
             pod_groups=[PodGroup(name="gang2", namespace="c1", min_member=2,
                                  queue="default")],

@@ -315,3 +315,26 @@ class TestDebugEndpoints:
             assert "thread" in body
         finally:
             srv.stop()
+
+
+class TestCacheSyncBarrier:
+    def test_wait_for_cache_sync(self):
+        """WaitForCacheSync analog (cache.go:363-384): the barrier blocks
+        until signaled, and the bounded wait falls through on timeout."""
+        cache = SchedulerCache()
+        assert not cache.wait_for_cache_sync()        # not signaled yet
+        assert not cache.wait_for_cache_sync(0.01)    # bounded wait times out
+        cache.mark_synced()
+        assert cache.wait_for_cache_sync()
+        assert cache.wait_for_cache_sync(0.01)
+
+    def test_sync_endpoint_signals_barrier(self):
+        cache = SchedulerCache()
+        srv = AdminServer(cache, port=0)
+        srv.start()
+        try:
+            assert not cache.wait_for_cache_sync()
+            _post(srv.port, "/v1/sync", {})
+            assert cache.wait_for_cache_sync()
+        finally:
+            srv.stop()
