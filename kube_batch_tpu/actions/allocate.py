@@ -265,7 +265,20 @@ class AllocateAction(Action):
                 bind_volumes = ssn.cache.bind_volumes
                 for t, _ in binds:
                     bind_volumes(t)
-            ssn.cache.bulk_bind(binds)
+            # hand the cache the segment sums this replay already computed
+            # ({key: (count, vec)}; bulk_bind falls back to accumulating any
+            # group whose applied count differs)
+            n_alloc_applied = np.bincount(pjobs[alloc_sel], minlength=nJ)
+            job_sums = {
+                meta.job_objs[ji].uid: (int(n_alloc_applied[ji]), job_alloc_sum[ji])
+                for ji in np.flatnonzero(n_alloc_applied).tolist()
+            }
+            node_alloc_cnt = np.bincount(node_of[alloc_sel], minlength=nN)
+            node_sums = {
+                node_names[ni]: (int(node_alloc_cnt[ni]), node_alloc_sum[ni])
+                for ni in np.flatnonzero(node_alloc_cnt).tolist()
+            }
+            ssn.cache.bulk_bind(binds, job_sums=job_sums, node_sums=node_sums)
 
         # slow path after every bulk placement has landed: host predicates
         # observe them; jobs the bulk path demoted replay sequentially too

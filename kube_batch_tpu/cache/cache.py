@@ -405,13 +405,21 @@ class SchedulerCache:
             logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
             self.resync_task(task)
 
-    def bulk_bind(self, tasks_hosts) -> None:
+    def bulk_bind(self, tasks_hosts, job_sums=None, node_sums=None) -> None:
         """bind() for a batch under ONE lock acquisition — the allocate
         replay's commit takes this path with every placement of the cycle;
         per-task semantics are identical to bind().  Job and node accounting
         are applied groupwise (bulk_transition / bulk_add_tasks) with
         presummed resreq, so the per-task work is the dict moves and the
-        binder call."""
+        binder call.
+
+        `job_sums` / `node_sums` optionally carry the replay's already-
+        computed resreq segment sums as {key: (task_count, vec)}; a presum is
+        trusted only when its count matches the group actually applied here
+        AND every task's resreq Resource is the identical object the session
+        snapshot cloned (TaskInfo.clone shares resreq; a mid-cycle pod update
+        replaces the TaskInfo with a fresh Resource, making the session's sum
+        stale) — otherwise the group falls back to accumulation."""
         with self._lock:
             staged = []
             jobs_get = self.jobs.get
@@ -424,6 +432,8 @@ class SchedulerCache:
             prev_job_uid = None
             job = None
             jlst: list = []
+            stale_jobs: set = set()
+            stale_nodes: set = set()
             for task, hostname in tasks_hosts:
                 key = task._key
                 if task.job != prev_job_uid:
@@ -434,6 +444,9 @@ class SchedulerCache:
                         jlst = by_job[task.job] = []
                 own = job.tasks.get(key) if job is not None else None
                 if own is not None:
+                    if own.resreq is not task.resreq:  # pod updated mid-cycle
+                        stale_jobs.add(task.job)
+                        stale_nodes.add(hostname)
                     own.node_name = hostname
                     jlst.append(own)
                     node = nodes_get(hostname)
@@ -451,20 +464,35 @@ class SchedulerCache:
                 flip = [t for t in owns if not is_allocated(t.status)]
                 noflip = [t for t in owns if is_allocated(t.status)]
                 if flip:
-                    # tight accumulation beats np.sum-over-list at gang sizes
-                    acc = np.zeros(nR)
-                    for t in flip:
-                        acc += t.resreq.vec
+                    pre = None
+                    if (
+                        job_sums is not None and not noflip
+                        and job_uid not in stale_jobs
+                    ):
+                        entry = job_sums.get(job_uid)
+                        if entry is not None and entry[0] == len(flip):
+                            pre = entry[1]
+                    if pre is None:
+                        # tight accumulation beats np.sum-over-list at gang sizes
+                        pre = np.zeros(nR)
+                        for t in flip:
+                            pre += t.resreq.vec
                     job.bulk_transition(flip, TaskStatus.BINDING,
-                                        self.spec.wrap_vec(acc))
+                                        self.spec.wrap_vec(pre))
                 if noflip:
                     job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
             for hostname, owns in by_node.items():
                 node = self.nodes[hostname]
-                acc = np.zeros(nR)
-                for t in owns:
-                    acc += t.resreq.vec
-                node.bulk_add_tasks(owns, [], self.spec.wrap_vec(acc), self.spec.empty())
+                pre = None
+                if node_sums is not None and hostname not in stale_nodes:
+                    entry = node_sums.get(hostname)
+                    if entry is not None and entry[0] == len(owns):
+                        pre = entry[1]
+                if pre is None:
+                    pre = np.zeros(nR)
+                    for t in owns:
+                        pre += t.resreq.vec
+                node.bulk_add_tasks(owns, [], self.spec.wrap_vec(pre), self.spec.empty())
         self._dispatch_async(staged)
 
     def _dispatch_async(self, staged) -> None:

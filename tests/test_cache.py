@@ -177,3 +177,37 @@ class TestStatusRateLimit:
         job.pod_group.phase = PodGroupPhase.RUNNING
         cache.update_job_status(job)
         assert len(cache.status_updater.pod_groups) == n0 + 1
+
+
+class TestBulkBindPresums:
+    def test_mid_cycle_resreq_update_invalidates_presum(self):
+        """A pod whose resources were updated between snapshot and commit
+        must be accounted at its NEW resreq — the session's presummed vector
+        is stale and bulk_bind has to fall back to accumulation (detected by
+        resreq object identity; TaskInfo.clone shares the Resource)."""
+        import dataclasses
+
+        import numpy as np
+
+        cache = build_cache(queues=["default"], nodes=[build_node("n1", cpu=8000)])
+        pod = build_pod("ns", "p1", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB})
+        cache.add_pod(pod)
+        snap = cache.snapshot()
+        session_task = next(iter(snap.jobs["ns/p1"].tasks.values()))
+        # mid-cycle ingest: requests grow to 2000m (replaces the TaskInfo)
+        cache.update_pod(dataclasses.replace(pod, requests={"cpu": 2000.0,
+                                                            "memory": GiB}))
+        # session-side presums still say 1000m
+        stale_vec = session_task.resreq.vec.copy()
+        cache.bulk_bind(
+            [(session_task, "n1")],
+            job_sums={"ns/p1": (1, stale_vec)},
+            node_sums={"n1": (1, stale_vec)},
+        )
+        cache.flush_binds()
+        job = cache.jobs["ns/p1"]
+        assert job.allocated.milli_cpu == 2000  # new resreq, not the presum
+        node = cache.nodes["n1"]
+        assert node.used.milli_cpu == 2000
+        assert node.idle.milli_cpu == 6000

@@ -24,6 +24,15 @@ from tests.fixtures import GiB, build_cache, build_node, build_pod
 from tests.test_actions import run_actions
 
 
+def _cache_with_pv_binder(**kw):
+    """build_cache with the real PV ledger behind the volume seams."""
+    from kube_batch_tpu.cache.volume import StandalonePVBinder
+
+    cache = build_cache(**kw)
+    cache.volume_binder = StandalonePVBinder()
+    return cache
+
+
 def gang(cache_kw_pods, name, n, cpu=1000, queue="default", priority=0, **pod_kw):
     """Append n pending gang pods for PodGroup `name` to a pod list."""
     for i in range(n):
@@ -621,11 +630,7 @@ class TestVolumeScenarios:
     BindVolumes consumes)."""
 
     def _cache_with_pv_binder(self, **kw):
-        from kube_batch_tpu.cache.volume import StandalonePVBinder
-
-        cache = build_cache(**kw)
-        cache.volume_binder = StandalonePVBinder()
-        return cache
+        return _cache_with_pv_binder(**kw)
 
     def test_node_without_required_volume_is_skipped(self):
         """A pod claiming a node-local PV must land on the PV's node even
@@ -800,12 +805,6 @@ class TestPDBGang:
         across cycles (Statement discard releases assumed volumes), so other
         claimants of the same wildcard PV still schedule."""
         from kube_batch_tpu.api.pod import PersistentVolume
-        from kube_batch_tpu.cache.volume import StandalonePVBinder
-
-        def _cache_with_pv_binder(**kw):
-            cache = build_cache(**kw)
-            cache.volume_binder = StandalonePVBinder()
-            return cache
 
         cache = _cache_with_pv_binder(
             queues=["default"],
