@@ -242,9 +242,15 @@ class AllocateAction(Action):
             # committed & ready → every new allocation dispatches immediately
             # (session.go:286-294); BINDING directly, skipping the
             # ALLOCATED→BINDING index churn
-            job.bulk_transition(alloc_tasks, TaskStatus.BINDING,
-                                wrap_vec(job_alloc_sum[ji]))
-            job.bulk_transition(pipe_tasks, TaskStatus.PIPELINED, EMPTY)
+            asum = wrap_vec(job_alloc_sum[ji])
+            job.bulk_transition(alloc_tasks, TaskStatus.BINDING, asum,
+                                pending_sum=asum)
+            if pipe_tasks:
+                job.bulk_transition(
+                    pipe_tasks, TaskStatus.PIPELINED, EMPTY,
+                    pending_sum=wrap_vec(job_total_sum[ji] - job_alloc_sum[ji]),
+                )
+                ssn.pipelined_tasks.extend(pipe_tasks)
             ssn.fire_batch_allocations(job, alloc_tasks + pipe_tasks,
                                        wrap_vec(job_total_sum[ji]))
 

@@ -72,6 +72,9 @@ class JobInfo:
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
         self.allocated: Resource = spec.empty()
         self.total_request: Resource = spec.empty()
+        # sum of Pending tasks' resreq — the ledger proportion's session-open
+        # reads instead of walking every task (proportion.go:87-99)
+        self.pending_request: Resource = spec.empty()
         self.nodes_fit_delta: Dict[str, Resource] = {}
         self.nodes_fit_errors: Dict[str, FitErrors] = {}  # taskUID → FitErrors
         self.job_fit_errors: str = ""
@@ -119,6 +122,8 @@ class JobInfo:
         self._index_add(task)
         if is_allocated(task.status):
             self.allocated.add_(task.resreq)
+        elif task.status == TaskStatus.PENDING:
+            self.pending_request.add_(task.resreq)
         self.total_request.add_(task.resreq)
 
     def delete_task(self, task: TaskInfo) -> None:
@@ -129,6 +134,8 @@ class JobInfo:
             return
         if is_allocated(existing.status):
             self.allocated.sub_(existing.resreq)
+        elif existing.status == TaskStatus.PENDING:
+            self.pending_request.sub_(existing.resreq)
         self.total_request.sub_(existing.resreq)
         self._index_remove(existing)
         del self.tasks[key]
@@ -142,19 +149,23 @@ class JobInfo:
         task.status = status
         self.add_task(task)
 
-    def bulk_transition(self, tasks, status: TaskStatus, resreq_sum) -> None:
+    def bulk_transition(self, tasks, status: TaskStatus, resreq_sum,
+                        pending_sum=None) -> None:
         """Batched update_task_status for the vectorized allocate replay:
         move `tasks` (members of this job) to `status`, with `resreq_sum` the
         presummed Resource over those whose allocated-ness flips.  End state
         is identical to calling update_task_status per task; the per-task
         Resource add_/sub_ churn (delete+add cancels on total_request, and
         allocated changes only on the is_allocated flip) collapses into one
-        vector op."""
+        vector op. `pending_sum` optionally presums the resreq of moved tasks
+        that were Pending (for the pending_request ledger); computed here
+        when absent."""
         if not tasks:
             return
         new_alloc = is_allocated(status)
         idx = self.task_status_index
         new_bucket = idx[status]
+        pend_delta = None  # resreq sum of tasks leaving/entering Pending
         # wholesale fast path: the batch IS an entire source bucket moving
         # into an empty destination (the common shape — a fully-placed gang's
         # Pending bucket becoming Binding): rebind the dict instead of
@@ -171,12 +182,27 @@ class JobInfo:
             del idx[src_status]
             idx[status] = src_bucket
             flipped = len(tasks) if is_allocated(src_status) != new_alloc else 0
+            pend_src = src_status == TaskStatus.PENDING
+            new_pend = status == TaskStatus.PENDING
             for task in tasks:
                 task.status = status
+            if pend_src != new_pend:
+                acc = pending_sum
+                if acc is None:
+                    acc = self.spec.empty()
+                    for task in tasks:
+                        acc.add_(task.resreq)
+                if pend_src:
+                    pend_delta = acc        # leaving Pending
+                else:
+                    self.pending_request.add_(acc)  # entering Pending
         else:
             flipped = 0
+            new_pend = status == TaskStatus.PENDING
+            pend_acc = None
             for task in tasks:
                 key = task._key
+                was_pend = task.status == TaskStatus.PENDING
                 bucket = idx.get(task.status)
                 if bucket is not None:
                     bucket.pop(key, None)
@@ -184,8 +210,19 @@ class JobInfo:
                         del idx[task.status]
                 if is_allocated(task.status) != new_alloc:
                     flipped += 1
+                if was_pend != new_pend:
+                    if new_pend:
+                        self.pending_request.add_(task.resreq)
+                    else:
+                        if pend_acc is None:
+                            pend_acc = self.spec.empty()
+                        pend_acc.add_(task.resreq)
                 task.status = status
                 new_bucket[key] = task
+            if pend_acc is not None:
+                pend_delta = pend_acc
+        if pend_delta is not None:
+            self.pending_request.sub_(pend_delta)
         if flipped:
             graft_assert(
                 flipped == len(tasks),
@@ -282,6 +319,7 @@ class JobInfo:
                 j.task_status_index[status] = {k: new_tasks[k] for k in bucket}
         j.allocated = self.allocated.clone()
         j.total_request = self.total_request.clone()
+        j.pending_request = self.pending_request.clone()
         return j
 
     def __repr__(self) -> str:

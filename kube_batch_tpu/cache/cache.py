@@ -32,6 +32,7 @@ from kube_batch_tpu.cache.fake import (
     FakeStatusUpdater,
     FakeVolumeBinder,
 )
+from kube_batch_tpu.utils.assertions import graft_assert
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -84,6 +85,40 @@ class SchedulerCache:
         self._repair_stop = threading.Event()
         # initial-sync barrier (WaitForCacheSync analog, cache.go:363-384)
         self._synced = threading.Event()
+        # exclusive-session gate: while a scheduling cycle owns the cache
+        # (the no-clone session mode), ingest/repair mutations are DEFERRED
+        # and applied at session close — the same once-per-cycle staleness an
+        # informer snapshot has, without paying the deep clone
+        self._session_active = False
+        self._deferred: List = []
+
+    # ------------------------------------------------------------------
+    # exclusive-session gate (no-clone session mode)
+    # ------------------------------------------------------------------
+    def begin_exclusive_session(self) -> None:
+        with self._lock:
+            graft_assert(not self._session_active,
+                         "nested exclusive sessions are not supported")
+            self._session_active = True
+
+    def end_exclusive_session(self) -> None:
+        """Release the cycle's ownership and apply every mutation that
+        arrived during it, in order."""
+        with self._lock:
+            self._session_active = False
+            deferred, self._deferred = self._deferred, []
+            for fn, args in deferred:
+                try:
+                    fn(*args)
+                except Exception:  # noqa: BLE001 — one bad event must not
+                    logger.exception("deferred ingest event failed")
+
+    def _gate(self, fn, *args) -> bool:
+        """Returns True when the mutation was deferred (session active)."""
+        if self._session_active:
+            self._deferred.append((fn, args))
+            return True
+        return False
 
     # ------------------------------------------------------------------
     # background repair loops (cache.go:342-384)
@@ -187,6 +222,8 @@ class SchedulerCache:
 
     def add_pod(self, pod: Pod) -> None:
         with self._lock:
+            if self._gate(self.add_pod, pod):
+                return
             if not self._owns(pod):
                 return
             self._resolve_pod_priority(pod)
@@ -208,8 +245,20 @@ class SchedulerCache:
             node.add_task(task)
 
     def update_pod(self, pod: Pod) -> None:
-        """delete + add (event_handlers.go:116-130)."""
+        """delete + add (event_handlers.go:116-130).
+
+        pod.spec.nodeName is write-once and scheduler-owned (k8s semantics:
+        clients can't unbind via update; the Binding subresource sets it):
+        an incoming update without a node keeps the stored pod's binding —
+        without this, a client update raced against the scheduler's own bind
+        (or deferred past it by the exclusive-session gate) would clobber the
+        placement and the next cycle would double-bind the pod."""
         with self._lock:
+            if self._gate(self.update_pod, pod):
+                return
+            stored = self.pods.get(pod.key())
+            if stored is not None and stored.node_name and not pod.node_name:
+                pod.node_name = stored.node_name
             self._delete_pod_locked(pod)
             if self._owns(pod):
                 self._resolve_pod_priority(pod)
@@ -218,6 +267,8 @@ class SchedulerCache:
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
+            if self._gate(self.delete_pod, pod):
+                return
             self._delete_pod_locked(pod)
 
     def _delete_pod_locked(self, pod: Pod) -> None:
@@ -254,6 +305,8 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     def add_node(self, node: Node) -> None:
         with self._lock:
+            if self._gate(self.add_node, node):
+                return
             existing = self.nodes.get(node.name)
             if existing is None:
                 self.nodes[node.name] = NodeInfo(node, self.spec)
@@ -265,6 +318,8 @@ class SchedulerCache:
 
     def delete_node(self, name: str) -> None:
         with self._lock:
+            if self._gate(self.delete_node, name):
+                return
             self.nodes.pop(name, None)
 
     # ------------------------------------------------------------------
@@ -272,6 +327,8 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     def add_pod_group(self, pg: PodGroup) -> None:
         with self._lock:
+            if self._gate(self.add_pod_group, pg):
+                return
             if not pg.queue:
                 pg.queue = self.default_queue  # default fill
             job_id = pg.key()
@@ -286,6 +343,8 @@ class SchedulerCache:
 
     def delete_pod_group(self, key: str) -> None:
         with self._lock:
+            if self._gate(self.delete_pod_group, key):
+                return
             job = self.jobs.get(key)
             if job is not None:
                 job.pod_group = None
@@ -307,6 +366,8 @@ class SchedulerCache:
                          pdb.name)
             return
         with self._lock:
+            if self._gate(self.add_pdb, pdb):
+                return
             job_id = f"{pdb.namespace}/{pdb.owner}"
             job = self.jobs.get(job_id)
             if job is None:
@@ -328,6 +389,8 @@ class SchedulerCache:
         if not pdb.owner:
             return
         with self._lock:
+            if self._gate(self.delete_pdb, pdb):
+                return
             job = self.jobs.get(f"{pdb.namespace}/{pdb.owner}")
             if job is None:
                 return
@@ -353,6 +416,8 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     def add_queue(self, queue: Queue) -> None:
         with self._lock:
+            if self._gate(self.add_queue, queue):
+                return
             self.queues[queue.name] = QueueInfo(queue)
 
     def update_queue(self, queue: Queue) -> None:
@@ -360,18 +425,24 @@ class SchedulerCache:
 
     def delete_queue(self, name: str) -> None:
         with self._lock:
+            if self._gate(self.delete_queue, name):
+                return
             self.queues.pop(name, None)
 
     def add_priority_class(self, pc: PriorityClass) -> None:
         if not self.resolve_priority:
             return  # informer not wired when disabled (cache.go:352,378)
         with self._lock:
+            if self._gate(self.add_priority_class, pc):
+                return
             self.priority_classes[pc.name] = pc
             if pc.global_default:
                 self.default_priority = pc.value
 
     def delete_priority_class(self, name: str) -> None:
         with self._lock:
+            if self._gate(self.delete_priority_class, name):
+                return
             pc = self.priority_classes.pop(name, None)
             if pc is not None and pc.global_default:
                 self.default_priority = 0
@@ -388,18 +459,25 @@ class SchedulerCache:
         queues the task for resync (cache.go:447-487; synchronous here — the
         async goroutine is replaced by the resync repair path)."""
         with self._lock:
-            own = self._own_task(task)
-            if own is not None:
-                job = self.jobs[task.job]
-                job.update_task_status(own, TaskStatus.BINDING)
-                own.node_name = hostname
-                node = self.nodes.get(hostname)
-                if node is not None and own.key() not in node.tasks:
-                    node.add_task(own)
+            if not self._session_active:
+                own = self._own_task(task)
+                if own is not None:
+                    job = self.jobs[task.job]
+                    job.update_task_status(own, TaskStatus.BINDING)
+                    own.node_name = hostname
+                    node = self.nodes.get(hostname)
+                    if node is not None and own.key() not in node.tasks:
+                        node.add_task(own)
+            # exclusive session: the session already holds this very task in
+            # the right state; the caller (Statement/dispatch) finishes the
+            # BINDING transition itself
             pod = self.pods.get(task.key())
         try:
             if pod is not None:
                 self.binder.bind(pod, hostname)
+                # binding ack → durable in the pod store (the apiserver
+                # Binding subresource analog)
+                pod.node_name = hostname
                 self.events.append(("Scheduled", task.key(), hostname))
         except Exception as e:  # noqa: BLE001 — repair path mirrors resyncTask
             logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
@@ -421,10 +499,18 @@ class SchedulerCache:
         replaces the TaskInfo with a fresh Resource, making the session's sum
         stale) — otherwise the group falls back to accumulation."""
         with self._lock:
+            pods_get = self.pods.get
+            if self._session_active:
+                # exclusive (no-clone) session: the replay already applied
+                # job/node accounting on these very objects — only stage the
+                # binder dispatch + Scheduled events
+                self._dispatch_async(
+                    [(t, h, pods_get(t._key)) for t, h in tasks_hosts]
+                )
+                return
             staged = []
             jobs_get = self.jobs.get
             nodes_get = self.nodes.get
-            pods_get = self.pods.get
             by_job: Dict[str, list] = {}
             by_node: Dict[str, list] = {}
             # the allocate replay emits binds grouped by job — run-length
@@ -477,8 +563,9 @@ class SchedulerCache:
                         pre = np.zeros(nR)
                         for t in flip:
                             pre += t.resreq.vec
-                    job.bulk_transition(flip, TaskStatus.BINDING,
-                                        self.spec.wrap_vec(pre))
+                    pre_r = self.spec.wrap_vec(pre)
+                    job.bulk_transition(flip, TaskStatus.BINDING, pre_r,
+                                        pending_sum=pre_r)
                 if noflip:
                     job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
             for hostname, owns in by_node.items():
@@ -509,6 +596,11 @@ class SchedulerCache:
                          if pod is not None]
                 try:
                     bind_many(pairs)
+                    # the binder's ack makes the binding durable in the pod
+                    # store (the apiserver Binding subresource analog):
+                    # resync/rebuild and stale client updates now see it
+                    for pod, hostname in pairs:
+                        pod.node_name = hostname
                     self.events.extend(
                         ("Scheduled", task._key, hostname)
                         for task, hostname, pod in staged if pod is not None
@@ -520,6 +612,7 @@ class SchedulerCache:
                 try:
                     if pod is not None:
                         self.binder.bind(pod, hostname)
+                        pod.node_name = hostname  # binding ack (see above)
                         self.events.append(("Scheduled", task._key, hostname))
                 except Exception as e:  # noqa: BLE001 — resyncTask repair path
                     logger.error("bind of %s to %s failed: %s", task._key, hostname, e)
@@ -544,13 +637,18 @@ class SchedulerCache:
     def evict(self, task: TaskInfo, reason: str) -> None:
         """(cache.go:404-444)"""
         with self._lock:
-            own = self._own_task(task)
-            if own is not None:
-                job = self.jobs[task.job]
-                job.update_task_status(own, TaskStatus.RELEASING)
-                node = self.nodes.get(own.node_name) if own.node_name else None
-                if node is not None:
-                    node.update_task(own)
+            if not self._session_active:
+                own = self._own_task(task)
+                if own is not None:
+                    job = self.jobs[task.job]
+                    job.update_task_status(own, TaskStatus.RELEASING)
+                    node = self.nodes.get(own.node_name) if own.node_name else None
+                    if node is not None:
+                        node.update_task(own)
+            # exclusive session: the Statement already moved this very task
+            # to Releasing and re-accounted its node; re-applying here would
+            # double-charge (the session may since have pipelined a
+            # preemptor onto the freed Releasing budget)
             pod = self.pods.get(task.key())
         try:
             if pod is not None:
@@ -584,6 +682,8 @@ class SchedulerCache:
         """Re-sync each errored task from the pod store: gone → delete;
         present → rebuild (delete + add)."""
         with self._lock:
+            if self._session_active:
+                return  # a cycle owns the cache; retry next repair tick
             tasks, self.err_tasks = self.err_tasks, []
             for task in tasks:
                 pod = self.pods.get(task.key())
@@ -593,6 +693,43 @@ class SchedulerCache:
                 self.pods[pod.key()] = pod
                 self._add_task(TaskInfo(pod, self.spec), pod)
 
+    def rebuild_from_pod_store(self) -> None:
+        """Re-list recovery (the informer re-list + WaitForCacheSync analog,
+        cache.go:342-384): rebuild every job's and node's task state from the
+        authoritative pod store. The scheduler loop invokes this after a
+        cycle dies mid-mutation in exclusive-session mode, where the session
+        objects ARE the cache and a half-applied replay would otherwise leak
+        phantom allocations. Completed bindings survive the rebuild because
+        every binder ack writes pod.node_name (the Binding subresource
+        analog); in-flight unacked binds rebuild as Pending and re-place
+        next cycle."""
+        with self._lock:
+            spec = self.spec
+            for job in self.jobs.values():
+                job.tasks.clear()
+                job.task_status_index.clear()
+                job.allocated = spec.empty()
+                job.total_request = spec.empty()
+                job.pending_request = spec.empty()
+                job.nodes_fit_delta = {}
+                job.nodes_fit_errors = {}
+            for node in self.nodes.values():
+                node.tasks.clear()
+                node._acct.clear()
+                node.idle = node.allocatable.clone()
+                node.used = spec.empty()
+                node.releasing = spec.empty()
+                node._set_state()
+            for pod in list(self.pods.values()):
+                if not self._owns(pod):
+                    continue
+                self._resolve_pod_priority(pod)
+                self._add_task(TaskInfo(pod, spec), pod)
+            for job in list(self.jobs.values()):
+                self._maybe_collect_job(job)
+        logger.warning("cache rebuilt from the pod store (%d pods, %d jobs)",
+                       len(self.pods), len(self.jobs))
+
     def process_cleanup_jobs(self) -> None:
         """processCleanupJob analog (cache.go:533-557): sweep-collect jobs
         that are terminated per JobTerminated (helpers.go:102-106 — no real
@@ -601,6 +738,8 @@ class SchedulerCache:
         belt-and-braces pass for jobs that lost their last task on a code
         path that didn't call _maybe_collect_job."""
         with self._lock:
+            if self._session_active:
+                return  # a cycle owns the cache; retry next repair tick
             for job in list(self.jobs.values()):
                 self._maybe_collect_job(job)
 
@@ -651,7 +790,7 @@ class SchedulerCache:
                 fe = job.nodes_fit_errors.get(task.uid)
                 self.task_unschedulable(task, fe.error() if fe is not None else base)
 
-    def update_job_status(self, job: JobInfo) -> None:
+    def update_job_status(self, job: JobInfo, prev_status=None) -> None:
         """Write the session's derived PodGroup status back to the
         authoritative store (UpdatePodGroup, cache.go:722-736).
 
@@ -671,12 +810,20 @@ class SchedulerCache:
             if own is None:
                 return  # job deleted mid-cycle — nothing to write status for
             own_pg = own.pod_group if own is not None else None
-            condition_only = (
-                own_pg is not None
-                and own_pg.phase == pg.phase
-                and (own_pg.running, own_pg.failed, own_pg.succeeded)
-                == (pg.running, pg.failed, pg.succeeded)
-            )
+            if prev_status is not None:
+                # exclusive session: own_pg IS pg (mutated in place), so the
+                # change detection compares against the status saved at open
+                # (session.go:102-105 podGroupStatus)
+                condition_only = prev_status == (
+                    pg.phase, pg.running, pg.failed, pg.succeeded
+                )
+            else:
+                condition_only = (
+                    own_pg is not None
+                    and own_pg.phase == pg.phase
+                    and (own_pg.running, own_pg.failed, own_pg.succeeded)
+                    == (pg.running, pg.failed, pg.succeeded)
+                )
             now = _time.monotonic()
             if condition_only and now < self._status_next_write.get(job.uid, 0.0):
                 write = False  # rate-limited; session state already updated
@@ -698,6 +845,28 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)
     # ------------------------------------------------------------------
+    def _job_in_session(self, uid: str, job: JobInfo) -> bool:
+        """Membership filter shared by snapshot() and session_view(): jobs
+        enter a session with a PodGroup or a PDB (cache.go:625-633) and a
+        known queue."""
+        if job.pod_group is None and job.pdb is None:
+            return False
+        if job.queue not in self.queues:
+            logger.warning("job %s queue %s not found, skipped", uid, job.queue)
+            return False
+        return True
+
+    def _resolve_job_priority(self, job: JobInfo) -> int:
+        """PriorityClass resolution (cache.go:610-620): named class if it
+        exists, else the global default — recomputed every session so a
+        deleted class stops conferring its value."""
+        pc = self.priority_classes.get(
+            job.pod_group.priority_class
+        ) if job.pod_group and job.pod_group.priority_class else None
+        if pc is not None:
+            return pc.value
+        return self.default_priority
+
     def snapshot(self) -> ClusterInfo:
         """Deep-clone ready nodes, all queues, and every job that has a
         PodGroup and whose queue exists."""
@@ -709,21 +878,27 @@ class SchedulerCache:
             for name, q in self.queues.items():
                 ci.queues[name] = q.clone()
             for uid, job in self.jobs.items():
-                # jobs enter the snapshot with a PodGroup or a PDB
-                # (cache.go:625-633)
-                if job.pod_group is None and job.pdb is None:
-                    continue
-                if job.queue not in self.queues:
-                    logger.warning("job %s queue %s not found, skipped", uid, job.queue)
+                if not self._job_in_session(uid, job):
                     continue
                 clone = job.clone()
-                # resolve job priority from PriorityClass (cache.go:610-620)
-                pc = self.priority_classes.get(
-                    job.pod_group.priority_class
-                ) if job.pod_group and job.pod_group.priority_class else None
-                if pc is not None:
-                    clone.priority = pc.value
-                elif self.default_priority:
-                    clone.priority = self.default_priority
+                clone.priority = self._resolve_job_priority(job)
                 ci.jobs[uid] = clone
+            return ci
+
+    def session_view(self) -> ClusterInfo:
+        """The exclusive (no-clone) session's ClusterInfo: the same
+        membership filters as snapshot(), as shallow views over the live
+        objects — caller must hold the exclusive-session gate."""
+        with self._lock:
+            ci = ClusterInfo(self.spec)
+            ci.nodes = {
+                name: n for name, n in self.nodes.items() if n.ready
+            }
+            ci.queues = dict(self.queues)
+            ci.jobs = {}
+            for uid, job in self.jobs.items():
+                if not self._job_in_session(uid, job):
+                    continue
+                job.priority = self._resolve_job_priority(job)
+                ci.jobs[uid] = job
             return ci

@@ -82,13 +82,22 @@ class FitFailure(Exception):
 
 
 class Session:
-    def __init__(self, cache, cluster: ClusterInfo, tiers: List[Tier]):
+    def __init__(self, cache, cluster: ClusterInfo, tiers: List[Tier],
+                 exclusive: bool = False):
         self.uid = str(uuid.uuid4())
         self.cache = cache
         self.spec = cluster.spec
         self.jobs: Dict[str, JobInfo] = cluster.jobs
         self.nodes: Dict[str, NodeInfo] = cluster.nodes
         self.queues: Dict[str, QueueInfo] = cluster.queues
+        # exclusive (no-clone) session: jobs/nodes ARE the cache's objects;
+        # the cache defers ingest until close and close_session unwinds
+        # session-only state (pipelined placements)
+        self.exclusive = exclusive
+        # every task Pipelined this session (Statement.pipeline /
+        # Session.pipeline / the bulk replay) — session-only state the
+        # exclusive close must revert (a cloned session just dies)
+        self.pipelined_tasks: List[TaskInfo] = []
         self.tiers = tiers
         self.plugins: List = []
         # plugin-fn registries: kind → {plugin_name: fn}
@@ -102,9 +111,12 @@ class Session:
         # (e.g. pressure gates); forces per-placement host re-validation
         self.host_only_predicates = False
         # PodGroup statuses as they stood at open (session.go:102-105), used
-        # by the job updater to skip no-op writes
-        self.pod_group_status_at_open: Dict[str, object] = {
-            j.uid: (j.pod_group.phase, len(j.pod_group.conditions))
+        # by the job updater to detect condition-only updates (rate-limited)
+        # — essential in exclusive mode, where the session mutates the
+        # authoritative PodGroup in place and a post-hoc compare is vacuous
+        self.pod_group_status_at_open: Dict[str, tuple] = {
+            j.uid: (j.pod_group.phase, j.pod_group.running, j.pod_group.failed,
+                    j.pod_group.succeeded)
             for j in self.jobs.values()
             if j.pod_group
         }
@@ -268,6 +280,7 @@ class Session:
         node = self.nodes.get(hostname)
         if node is not None:
             node.add_task(task)
+        self.pipelined_tasks.append(task)
         self._fire(True, task)
 
     def allocate(self, task: TaskInfo, hostname: str) -> None:
@@ -345,6 +358,7 @@ class Statement:
         node = self.ssn.nodes.get(hostname)
         if node is not None:
             node.add_task(task)
+        self.ssn.pipelined_tasks.append(task)
         self.ssn._fire(True, task)
         self.operations.append(("pipeline", (task, hostname)))
 
@@ -445,35 +459,61 @@ class Statement:
 
 # ---- session lifecycle (framework/framework.go:30-62) -------------------
 
-def open_session(cache, tiers: List[Tier], plugin_options=None) -> Session:
-    """Snapshot the cache, drop gang-invalid jobs (marking them
-    unschedulable, session.go:107-124), and run every configured plugin's
-    OnSessionOpen."""
+def open_session(cache, tiers: List[Tier], plugin_options=None,
+                 isolated: bool = False) -> Session:
+    """Open a scheduling session: drop gang-invalid jobs (marking them
+    unschedulable, session.go:107-124) and run every configured plugin's
+    OnSessionOpen.
+
+    Default is the EXCLUSIVE (no-clone) mode: the session takes ownership of
+    the cache's own objects for the cycle — ingest and repair mutations are
+    deferred by the cache until close, exactly the once-per-cycle staleness
+    the reference's deep-cloned snapshot has, without paying the 50k-task
+    clone or the commit-time double bookkeeping (the reference clones
+    because informer goroutines race the session, cache.go:584-654; here
+    the gate provides the same isolation). Session-only state (Pipelined
+    placements) is unwound at close. `isolated=True` forces the reference's
+    deep-clone behavior — callers that want to inspect a what-if session
+    without touching the cache."""
     from kube_batch_tpu.framework.interface import get_plugin_builder
 
-    cluster = cache.snapshot()
-    ssn = Session(cache, cluster, tiers)
-    for tier in tiers:
-        for opt in tier.plugins:
-            plugin = get_plugin_builder(opt.name)(opt.arguments)
-            ssn.plugins.append(plugin)
-            plugin.on_session_open(ssn)
-    # gang-validity gate after plugins registered their JobValid fns
-    for uid, job in list(ssn.jobs.items()):
-        reason = ssn.job_valid(job)
-        if reason is not None:
-            ssn.update_job_condition(
-                job,
-                PodGroupCondition(
-                    type="Unschedulable",
-                    status="True",
-                    transition_id=ssn.uid,
-                    reason="NotEnoughPods",
-                    message=reason,
-                ),
-            )
-            cache.record_job_status_event(job)
-            del ssn.jobs[uid]
+    if isolated:
+        cluster = cache.snapshot()
+        ssn = Session(cache, cluster, tiers)
+    else:
+        cache.begin_exclusive_session()
+        try:
+            cluster = cache.session_view()
+        except BaseException:
+            cache.end_exclusive_session()
+            raise
+        ssn = Session(cache, cluster, tiers, exclusive=True)
+    try:
+        for tier in tiers:
+            for opt in tier.plugins:
+                plugin = get_plugin_builder(opt.name)(opt.arguments)
+                ssn.plugins.append(plugin)
+                plugin.on_session_open(ssn)
+        # gang-validity gate after plugins registered their JobValid fns
+        for uid, job in list(ssn.jobs.items()):
+            reason = ssn.job_valid(job)
+            if reason is not None:
+                ssn.update_job_condition(
+                    job,
+                    PodGroupCondition(
+                        type="Unschedulable",
+                        status="True",
+                        transition_id=ssn.uid,
+                        reason="NotEnoughPods",
+                        message=reason,
+                    ),
+                )
+                cache.record_job_status_event(job)
+                del ssn.jobs[uid]
+    except BaseException:
+        if ssn.exclusive:
+            cache.end_exclusive_session()  # never leave the gate stuck
+        raise
     return ssn
 
 
@@ -504,22 +544,51 @@ def job_status(ssn: Session, job: JobInfo) -> None:
 
 def close_session(ssn: Session) -> None:
     """Plugin close hooks then the job updater (framework.go:55-62 +
-    job_updater.go:33-122, sans the 16-worker pool — the host loop is cold)."""
-    for plugin in ssn.plugins:
-        plugin.on_session_close(ssn)
-    for job in ssn.jobs.values():
-        if job.pod_group is None:
-            # PDB-defined jobs get events only, no status writeback
-            # (job_updater.go:108-111; unschedulable iff tasks stay Pending,
-            # cache.go:699)
-            if job.pdb is not None and job.task_status_index.get(
-                TaskStatus.PENDING
-            ):
-                ssn.cache.record_job_status_event(job)
-            continue
-        job_status(ssn, job)
-        ssn.cache.update_job_status(job)
-    ssn.jobs = {}
-    ssn.nodes = {}
-    ssn.queues = {}
-    ssn.plugins = []
+    job_updater.go:33-122, sans the 16-worker pool — the host loop is cold).
+    Exclusive sessions additionally unwind Pipelined placements (session-only
+    state, gone with a cloned session) and release the cache gate."""
+    try:
+        for plugin in ssn.plugins:
+            plugin.on_session_close(ssn)
+        for job in ssn.jobs.values():
+            if job.pod_group is None:
+                # PDB-defined jobs get events only, no status writeback
+                # (job_updater.go:108-111; unschedulable iff tasks stay
+                # Pending, cache.go:699)
+                if job.pdb is not None and job.task_status_index.get(
+                    TaskStatus.PENDING
+                ):
+                    ssn.cache.record_job_status_event(job)
+                continue
+            job_status(ssn, job)
+            ssn.cache.update_job_status(
+                job, prev_status=ssn.pod_group_status_at_open.get(job.uid)
+            )
+    finally:
+        if ssn.exclusive:
+            # revert surviving Pipelined placements: they exist only inside
+            # a session (the reference's clone takes them to the grave;
+            # statement.go pipeline no-ops on commit) — next cycle re-derives
+            # them from fresh Releasing capacity
+            for task in ssn.pipelined_tasks:
+                if task.status != TaskStatus.PIPELINED:
+                    continue  # discarded or transitioned meanwhile
+                job = ssn.jobs.get(task.job)
+                if job is not None and task.key() in job.tasks:
+                    job.update_task_status(task, TaskStatus.PENDING)
+                node = ssn.nodes.get(task.node_name) if task.node_name else None
+                if node is not None and task.key() in node.tasks:
+                    node.remove_task(task)
+                task.node_name = None
+            # drain binder acks BEFORE applying deferred ingest: a deferred
+            # pod update must observe the durable bindings (pod.node_name)
+            # this cycle produced, or it would clobber them
+            flush = getattr(ssn.cache, "flush_binds", None)
+            if flush is not None:
+                flush()
+            ssn.cache.end_exclusive_session()
+        ssn.jobs = {}
+        ssn.nodes = {}
+        ssn.queues = {}
+        ssn.plugins = []
+        ssn.pipelined_tasks = []

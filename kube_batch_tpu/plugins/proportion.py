@@ -60,17 +60,11 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 attr = _QueueAttr(ssn.queues[job.queue], spec)
                 self.queue_attrs[job.queue] = attr
-            # allocated-status sum is the job.allocated ledger; only the
-            # Pending bucket needs walking (request = allocated + pending,
-            # proportion.go:87-99)
+            # request = allocated + pending (proportion.go:87-99), both read
+            # straight off the JobInfo ledgers — no task iteration
             attr.allocated.add_(job.allocated)
             attr.request.add_(job.allocated)
-            pend = job.task_status_index.get(TaskStatus.PENDING)
-            if pend:
-                acc = np.zeros(spec.n)
-                for t in pend.values():
-                    acc += t.resreq.vec
-                attr.request.add_(spec.wrap_vec(acc))
+            attr.request.add_(job.pending_request)
         self._waterfill(spec)
         for attr in self.queue_attrs.values():
             self._update_share(attr)

@@ -75,6 +75,16 @@ class Scheduler:
                     self.run_once()
                 except Exception:  # noqa: BLE001 — next cycle self-corrects
                     logger.exception("scheduling cycle failed")
+                    # exclusive (no-clone) sessions mutate the authoritative
+                    # cache in place: a cycle that died mid-mutation may have
+                    # leaked partial state — rebuild from the pod store (the
+                    # informer re-list analog) before the next cycle
+                    recover = getattr(self.cache, "rebuild_from_pod_store", None)
+                    if recover is not None:
+                        try:
+                            recover()
+                        except Exception:  # noqa: BLE001
+                            logger.exception("re-list recovery failed")
                 elapsed = time.perf_counter() - tick
                 time.sleep(max(self.schedule_period - elapsed, 0.0))
         finally:

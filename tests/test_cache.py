@@ -211,3 +211,105 @@ class TestBulkBindPresums:
         node = cache.nodes["n1"]
         assert node.used.milli_cpu == 2000
         assert node.idle.milli_cpu == 6000
+
+
+class TestExclusiveSessionSafety:
+    def test_deferred_update_does_not_clobber_binding(self):
+        """A client pod update deferred past the cycle's bind (exclusive
+        session gate) must not erase the placement: nodeName is write-once,
+        scheduler-owned, and binder acks persist it on the stored pod."""
+        import dataclasses
+
+        from kube_batch_tpu import actions as _a  # noqa: F401
+        from kube_batch_tpu import plugins as _p  # noqa: F401
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.framework.interface import get_action
+        from kube_batch_tpu.framework.session import close_session, open_session
+
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")])
+        pod = build_pod("ns", "p1", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB})
+        cache.add_pod(pod)
+        conf = load_scheduler_conf(None)
+        ssn = open_session(cache, conf.tiers)
+        # informer delivers an annotation-only update mid-cycle — deferred
+        cache.update_pod(dataclasses.replace(
+            pod, annotations={"touched": "yes"}))
+        get_action("allocate").execute(ssn)
+        close_session(ssn)  # flushes binder acks, then applies the update
+        assert cache.binder.binds == {"ns/p1": "n1"}
+        # the rebuilt task carries the binding (pod.node_name was acked)
+        task = cache.jobs["ns/p1"].tasks["ns/p1"]
+        assert task.node_name == "n1"
+        assert task.status == TaskStatus.BOUND
+        assert cache.nodes["n1"].used.milli_cpu == 1000
+        # a second cycle must not double-place it
+        ssn2 = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn2)
+        close_session(ssn2)
+        assert len(cache.binder.channel) == 1  # exactly one bind ever
+
+    def test_crashed_cycle_recovers_via_pod_store_rebuild(self):
+        """A cycle that dies mid-mutation in exclusive mode must not leak
+        phantom allocations: run_forever rebuilds from the pod store and the
+        next cycle places everything."""
+        import threading
+        import time as _time
+
+        from kube_batch_tpu import actions as _a  # noqa: F401
+        from kube_batch_tpu import plugins as _p  # noqa: F401
+        from kube_batch_tpu.framework.interface import Action, register_action
+        from kube_batch_tpu.framework.conf import parse_scheduler_conf
+        from kube_batch_tpu.scheduler import Scheduler
+
+        boom = [2]  # explode on the first two cycles, after allocate ran
+
+        class ExplodingAction(Action):
+            name = "explode"
+
+            def execute(self, ssn):
+                if boom[0] > 0:
+                    boom[0] -= 1
+                    raise RuntimeError("mid-cycle crash")
+
+        register_action(ExplodingAction())
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB}) for i in range(3)],
+        )
+        conf = parse_scheduler_conf(
+            'actions: "allocate, explode"\n'
+            "tiers:\n- plugins:\n  - name: gang\n  - name: drf\n"
+        )
+        sched = Scheduler(cache, conf=conf, schedule_period=0.05)
+        t = threading.Thread(target=sched.run_forever, daemon=True)
+        t.start()
+        try:
+            deadline = _time.monotonic() + 15
+            while _time.monotonic() < deadline and len(cache.binder.binds) < 3:
+                _time.sleep(0.05)
+        finally:
+            sched.stop()
+            t.join(5)
+        assert len(cache.binder.binds) == 3
+        # no phantom allocations: node accounting equals the placed pods
+        assert cache.nodes["n1"].used.milli_cpu == 3000
+        assert cache.nodes["n1"].idle.milli_cpu == \
+            cache.nodes["n1"].allocatable.milli_cpu - 3000
+
+    def test_deleted_priority_class_stops_conferring(self):
+        """Priority resolution is recomputed per session (cache.go:610-620):
+        deleting a PriorityClass resets its jobs to the default."""
+        from kube_batch_tpu.api.pod import PriorityClass
+
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")])
+        cache.add_priority_class(PriorityClass(name="high", value=100))
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns", min_member=1,
+                                     queue="default", priority_class="high"))
+        view = cache.session_view()
+        assert view.jobs["ns/pg"].priority == 100
+        cache.delete_priority_class("high")
+        view = cache.session_view()
+        assert view.jobs["ns/pg"].priority == 0
