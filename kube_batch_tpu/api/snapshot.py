@@ -268,8 +268,14 @@ def build_snapshot(
             for t in task_objs
         ]
     # sparse per-task features: bitsets, affinity and preference rows — only
-    # tasks actually carrying selectors/tolerations/affinity walk this path
-    for i, (t, ji) in enumerate(tasks):
+    # tasks actually carrying selectors/tolerations/affinity walk this path;
+    # one cheap comprehension picks them so the plain-pod common case pays a
+    # single attribute read instead of the full branch ladder
+    sparse = [
+        (i, t) for i, (t, _) in enumerate(tasks)
+        if t.pod.affinity is not None or t.pod.node_selector or t.pod.tolerations
+    ]
+    for i, t in sparse:
         pod = t.pod
         if pod.affinity is not None and (
             pod.affinity.pod_affinity or pod.affinity.pod_anti_affinity

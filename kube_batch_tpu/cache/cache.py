@@ -772,7 +772,6 @@ class SchedulerCache:
         PDB job with Pending tasks) + fit-error conditions for Allocated and
         Pending tasks (cache.go:704-719). Called once per job at session
         close via update_job_status / the PDB events-only path."""
-        base = job.job_fit_errors or job.fit_error()
         pg = job.pod_group
         shadow = pg is not None and pg.shadow
         pg_unsched = (
@@ -783,6 +782,11 @@ class SchedulerCache:
         pdb_unsched = job.pdb is not None and bool(
             job.task_status_index.get(TaskStatus.PENDING)
         )
+        has_stuck = job.task_status_index.get(TaskStatus.ALLOCATED) or \
+            job.task_status_index.get(TaskStatus.PENDING)
+        if not (pg_unsched or pdb_unsched or has_stuck):
+            return  # nothing to report — skip the fit-error rendering
+        base = job.job_fit_errors or job.fit_error()
         if pg_unsched or pdb_unsched:
             self.events.append(("Unschedulable", job.uid, base))
         for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
