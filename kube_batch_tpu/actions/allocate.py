@@ -24,6 +24,7 @@ import os
 import time
 from typing import Dict, List, Tuple
 
+import jax
 import numpy as np
 
 from kube_batch_tpu.api.cluster_info import ClusterInfo
@@ -80,12 +81,17 @@ class AllocateAction(Action):
             weights=ssn.score_weights,
         )
         result = allocate_solve(snap, config)
-        assigned = np.asarray(result.assigned)[: meta.n_tasks]  # blocks on device
-        pipelined = np.asarray(result.pipelined)[: meta.n_tasks]
+        # one blocking transfer for everything the host reads (assignment,
+        # pipelined flags, and the fit-error histogram the diagnostics use)
+        assigned, pipelined, fail_hist = jax.device_get(
+            (result.assigned, result.pipelined, result.fail_hist)
+        )
+        assigned = assigned[: meta.n_tasks]
+        pipelined = pipelined[: meta.n_tasks]
         t2 = time.perf_counter()
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
         pending = np.asarray(snap.task_pending)[: meta.n_tasks]
-        self._record_fit_errors(ssn, meta, result, assigned, task_job, pending)
+        self._record_fit_errors(ssn, meta, fail_hist, assigned, task_job, pending)
         self._replay(ssn, snap, meta, assigned, pipelined, task_job)
         t3 = time.perf_counter()
         self.last_phase_ms = {
@@ -345,7 +351,7 @@ class AllocateAction(Action):
             )
             stmt.discard()
 
-    def _record_fit_errors(self, ssn, meta, result, assigned, task_job, pending) -> None:
+    def _record_fit_errors(self, ssn, meta, fail_hist, assigned, task_job, pending) -> None:
         """FitErrors for unplaced pending tasks (allocate.go:151-155). The
         reason histogram comes out of the solve itself (AllocateResult
         .fail_hist) — diagnostics add no extra [T, N] dispatch."""
@@ -355,7 +361,7 @@ class AllocateAction(Action):
         unplaced = np.flatnonzero(pending & (assigned < 0))
         if unplaced.size == 0:
             return
-        hist = np.asarray(result.fail_hist)[: meta.n_tasks]
+        hist = fail_hist[: meta.n_tasks]
         for ti in unplaced:
             job = meta.job_objs[int(task_job[ti])]
             task = meta.task_objs[int(ti)]

@@ -22,11 +22,12 @@ SHARE_DELTA = 1e-6  # drf.go:33
 
 
 class _JobAttr:
-    __slots__ = ("allocated", "share")
+    __slots__ = ("allocated", "_share", "_dirty")
 
     def __init__(self, allocated: Resource):
         self.allocated = allocated
-        self.share = 0.0
+        self._share = 0.0
+        self._dirty = True
 
 
 class DrfPlugin(Plugin):
@@ -37,8 +38,13 @@ class DrfPlugin(Plugin):
         self.total: Resource | None = None
         self.job_attrs: Dict[str, _JobAttr] = {}
 
-    def _update_share(self, attr: _JobAttr) -> None:
-        attr.share = attr.allocated.share(self.total)
+    def _share(self, attr: _JobAttr) -> float:
+        # recomputed lazily on read: the allocate replay fires thousands of
+        # batch events whose shares nothing reads until preempt/reclaim
+        if attr._dirty:
+            attr._share = attr.allocated.share(self.total)
+            attr._dirty = False
+        return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
         self.total = ssn.spec.empty()
@@ -48,9 +54,7 @@ class DrfPlugin(Plugin):
             # job.allocated IS the sum of allocated-status task resreqs —
             # the ledger add_task/bulk_transition maintain (job_info.py);
             # re-deriving it per task was the session-open hot loop
-            attr = _JobAttr(job.allocated.clone())
-            self._update_share(attr)
-            self.job_attrs[job.uid] = attr
+            self.job_attrs[job.uid] = _JobAttr(job.allocated.clone())
 
         def preemptable(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
             """(drf.go:85-110)"""
@@ -78,8 +82,10 @@ class DrfPlugin(Plugin):
 
         def job_order(l: JobInfo, r: JobInfo) -> int:
             """(drf.go:114-132) lower dominant share first."""
-            ls = self.job_attrs[l.uid].share if l.uid in self.job_attrs else 0.0
-            rs = self.job_attrs[r.uid].share if r.uid in self.job_attrs else 0.0
+            la = self.job_attrs.get(l.uid)
+            ra = self.job_attrs.get(r.uid)
+            ls = self._share(la) if la is not None else 0.0
+            rs = self._share(ra) if ra is not None else 0.0
             if ls == rs:
                 return 0
             return -1 if ls < rs else 1
@@ -88,20 +94,20 @@ class DrfPlugin(Plugin):
             attr = self.job_attrs.get(event.task.job)
             if attr is not None:
                 attr.allocated.add_(event.task.resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         def on_deallocate(event: fw.Event) -> None:
             attr = self.job_attrs.get(event.task.job)
             if attr is not None:
                 attr.allocated.sub_(event.task.resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         def on_batch_allocate(job: JobInfo, tasks, total_resreq) -> None:
             # linear in resreq: one presummed add per job ≡ per-task events
             attr = self.job_attrs.get(job.uid)
             if attr is not None:
                 attr.allocated.add_(total_resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         ssn.add_fn(fw.PREEMPTABLE, self.name, preemptable)
         ssn.add_fn(fw.JOB_ORDER, self.name, job_order)

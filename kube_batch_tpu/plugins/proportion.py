@@ -24,7 +24,8 @@ from kube_batch_tpu.framework import session as fw
 
 
 class _QueueAttr:
-    __slots__ = ("queue", "weight", "deserved", "allocated", "request", "share")
+    __slots__ = ("queue", "weight", "deserved", "allocated", "request",
+                 "_share", "_dirty")
 
     def __init__(self, queue: QueueInfo, spec):
         self.queue = queue
@@ -32,7 +33,8 @@ class _QueueAttr:
         self.deserved = spec.empty()
         self.allocated = spec.empty()
         self.request = spec.empty()
-        self.share = 0.0
+        self._share = 0.0
+        self._dirty = True
 
 
 class ProportionPlugin(Plugin):
@@ -43,9 +45,14 @@ class ProportionPlugin(Plugin):
         self.total: Resource | None = None
         self.queue_attrs: Dict[str, _QueueAttr] = {}
 
-    def _update_share(self, attr: _QueueAttr) -> None:
-        """share = dominant allocated/deserved (proportion.go:265-277)."""
-        attr.share = _dominant(attr.allocated, attr.deserved)
+    def _share(self, attr: _QueueAttr) -> float:
+        """share = dominant allocated/deserved (proportion.go:265-277),
+        recomputed lazily on read — the allocate replay fires thousands of
+        batch events whose shares nothing reads until queue ordering."""
+        if attr._dirty:
+            attr._share = _dominant(attr.allocated, attr.deserved)
+            attr._dirty = False
+        return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
         spec = ssn.spec
@@ -66,14 +73,12 @@ class ProportionPlugin(Plugin):
             attr.request.add_(job.allocated)
             attr.request.add_(job.pending_request)
         self._waterfill(spec)
-        for attr in self.queue_attrs.values():
-            self._update_share(attr)
 
         def queue_order(l: QueueInfo, r: QueueInfo) -> int:
             la = self.queue_attrs.get(l.name)
             ra = self.queue_attrs.get(r.name)
-            ls = la.share if la else 0.0
-            rs = ra.share if ra else 0.0
+            ls = self._share(la) if la else 0.0
+            rs = self._share(ra) if ra else 0.0
             if ls == rs:
                 return 0
             return -1 if ls < rs else 1
@@ -127,21 +132,21 @@ class ProportionPlugin(Plugin):
             if job and job.queue in self.queue_attrs:
                 attr = self.queue_attrs[job.queue]
                 attr.allocated.add_(event.task.resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         def on_deallocate(event: fw.Event) -> None:
             job = ssn.jobs.get(event.task.job)
             if job and job.queue in self.queue_attrs:
                 attr = self.queue_attrs[job.queue]
                 attr.allocated.sub_(event.task.resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         def on_batch_allocate(job: JobInfo, tasks, total_resreq) -> None:
             # linear in resreq: one presummed add per queue ≡ per-task events
             if job.queue in self.queue_attrs:
                 attr = self.queue_attrs[job.queue]
                 attr.allocated.add_(total_resreq)
-                self._update_share(attr)
+                attr._dirty = True
 
         ssn.add_fn(fw.QUEUE_ORDER, self.name, queue_order)
         ssn.add_fn(fw.RECLAIMABLE, self.name, reclaimable)
