@@ -375,3 +375,62 @@ class TestFitErrorDiagnostics:
         run_actions(cache, action_names=["allocate"])  # second cycle, same state
         n_events2 = len([e for e in cache.events if e[0] == "FailedScheduling"])
         assert n_events2 == n_events  # no-op condition writes suppressed
+
+    def test_failed_evict_repaired_through_running_loop(self):
+        """An evictor failure queues the victim for resync
+        (cache.go:432-441); the background repair loop restores it from the
+        pod store and a later cycle re-evicts successfully."""
+        import threading
+        import time as _time
+
+        class FlakyEvictor:
+            def __init__(self):
+                self.calls = 0
+                self.evicts = []
+
+            def evict(self, pod):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("apiserver down")
+                self.evicts.append(f"{pod.namespace}/{pod.name}")
+
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=1, queue="default"),
+                PodGroup(name="high", namespace="c1", min_member=1,
+                         queue="default"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "high-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="high",
+                          priority=100),
+            ],
+        )
+        evictor = FlakyEvictor()
+        cache.evictor = evictor
+        # enqueue must run so the starved job's Pending-phase PodGroup is
+        # promoted to Inqueue — preempt skips Pending-phase podgroups
+        # (preempt.go:59-63), exactly like the reference's shipped conf order
+        conf_text = TWO_TIER_CONF.replace(
+            '"allocate, backfill"', '"enqueue, allocate, preempt"'
+        )
+        sched = Scheduler(cache, conf=parse_scheduler_conf(conf_text),
+                          schedule_period=0.05)
+        t = threading.Thread(target=sched.run_forever, daemon=True)
+        t.start()
+        try:
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and not evictor.evicts:
+                _time.sleep(0.05)
+        finally:
+            sched.stop()
+            t.join(timeout=5.0)
+        assert evictor.evicts and evictor.evicts[0].startswith("c1/low-")
+        assert evictor.calls >= 2
+        assert cache.err_tasks == []
