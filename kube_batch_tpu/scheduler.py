@@ -41,6 +41,14 @@ class Scheduler:
 
     def run_once(self) -> None:
         """(scheduler.go:88-102)"""
+        # drain the resync queue at the cycle boundary: the background repair
+        # tick (cache.go:563-581) skips while an exclusive session owns the
+        # cache, and at small schedule periods sessions run nearly
+        # back-to-back — this bound guarantees a failed bind/evict is
+        # repaired within one cycle instead of racing for a gap
+        resync = getattr(self.cache, "process_resync_tasks", None)
+        if resync is not None:
+            resync()
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
         try:

@@ -338,3 +338,41 @@ class TestCacheSyncBarrier:
             assert cache.wait_for_cache_sync()
         finally:
             srv.stop()
+
+
+class TestRestartWithBindings:
+    def test_bound_pods_survive_restart_and_are_not_rescheduled(self, tmp_path):
+        """Crash-restart story: binder acks persist pod.node_name, so a
+        state-file round trip restores placements as Bound (Pending+nodeName
+        → Bound, helpers.go:35-61) with correct node accounting, and the
+        next cycle on the fresh process re-schedules nothing."""
+        from kube_batch_tpu.api.types import TaskStatus
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.scheduler import Scheduler
+
+        cache = SchedulerCache()
+        cache.add_queue(Queue(name="default", weight=1))
+        cache.add_node(Node(name="n1", allocatable={
+            "cpu": 8000.0, "memory": float(16 << 30), "pods": 110.0}))
+        for i in range(3):
+            cache.add_pod(Pod(name=f"p{i}", namespace="c1",
+                              requests={"cpu": 1000.0,
+                                        "memory": float(1 << 30)},
+                              phase=PodPhase.PENDING))
+        Scheduler(cache, conf=load_scheduler_conf(None)).run_once()
+        assert len(cache.binder.binds) == 3
+        path = str(tmp_path / "state.json")
+        save_state(cache, path)
+
+        fresh = SchedulerCache()
+        assert load_state(fresh, path)
+        # placements restored: tasks Bound on n1, idle reflects them
+        for i in range(3):
+            task = fresh.jobs[f"c1/p{i}"].tasks[f"c1/p{i}"]
+            assert task.status == TaskStatus.BOUND
+            assert task.node_name == "n1"
+        assert fresh.nodes["n1"].used.milli_cpu == 3000
+        # the restarted process schedules nothing new
+        Scheduler(fresh, conf=load_scheduler_conf(None)).run_once()
+        assert fresh.binder.binds == {}
