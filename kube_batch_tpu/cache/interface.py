@@ -12,6 +12,17 @@ from kube_batch_tpu.api.pod import Pod
 
 
 class Binder(Protocol):
+    """A binder MAY additionally expose `bind_many(pairs)` — a batch fast
+    path the dispatcher prefers when present (duck-typed, deliberately NOT
+    declared here: a Protocol stub body would be inherited as a silent no-op
+    by explicit subclasses).  bind_many's contract is ALL-OR-NOTHING:
+    raising must mean no pod in the batch was durably bound, because the
+    dispatcher retries the whole batch per-pod after a bind_many exception —
+    a partially-successful bind_many would get its successful prefix
+    re-bound (duplicate bind calls + duplicate Scheduled events).  A binder
+    that cannot give that guarantee should expose per-pod idempotent bind()
+    only."""
+
     def bind(self, pod: Pod, hostname: str) -> None:
         """Place the pod; raise to signal failure (→ resync)."""
 

@@ -24,7 +24,12 @@ def save_state(cache, path: str) -> None:
     # outside it, so a per-cycle save at 50k pods doesn't block the ingest /
     # bind / evict handlers for the full serialization time. Pod/Node/Queue
     # objects are immutable-by-convention after ingest (handlers replace,
-    # not mutate), so serializing them lock-free is safe.
+    # not mutate) EXCEPT pod.node_name, which the async binder ack mutates —
+    # drain in-flight dispatches first so the state file can't miss a
+    # just-acked binding (restoring such a pod as Pending).
+    flush = getattr(cache, "flush_binds", None)
+    if flush is not None:
+        flush()
     with cache._lock:
         pods = list(cache.pods.values())
         nodes = [n.node for n in cache.nodes.values() if n.node is not None]

@@ -98,6 +98,24 @@ class Session:
         # Session.pipeline / the bulk replay) — session-only state the
         # exclusive close must revert (a cloned session just dies)
         self.pipelined_tasks: List[TaskInfo] = []
+        # every task set ALLOCATED this session (Session.allocate /
+        # Statement.allocate).  ALLOCATED only becomes durable via dispatch
+        # (→ BINDING); residue whose job never turned ready is session-only
+        # state too — exclusive close reverts whatever is still ALLOCATED
+        # (the reference's clone takes it to the grave, session.go:286-294)
+        self.allocated_tasks: List[TaskInfo] = []
+        if exclusive:
+            # per-session diagnostic state on the live objects — a cloned
+            # session starts clean because clone() clears these
+            # (job_info.go:295-329); the no-clone path must do it explicitly
+            # or stale fit errors replay forever (and grow unboundedly)
+            for job in self.jobs.values():
+                if job.nodes_fit_delta:
+                    job.nodes_fit_delta = {}
+                if job.nodes_fit_errors:
+                    job.nodes_fit_errors = {}
+                if job.job_fit_errors:
+                    job.job_fit_errors = ""
         self.tiers = tiers
         self.plugins: List = []
         # plugin-fn registries: kind → {plugin_name: fn}
@@ -294,6 +312,7 @@ class Session:
         node = self.nodes.get(hostname)
         if node is not None:
             node.add_task(task)
+        self.allocated_tasks.append(task)
         self._fire(True, task)
         if job is not None and self.job_ready(job):
             for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
@@ -371,6 +390,7 @@ class Statement:
         node = self.ssn.nodes.get(hostname)
         if node is not None:
             node.add_task(task)
+        self.ssn.allocated_tasks.append(task)
         self.ssn._fire(True, task)
         self.operations.append(("allocate", (task, hostname)))
 
@@ -431,29 +451,14 @@ class Statement:
         self.ssn._fire(True, task)
 
     def _unpipeline(self, task: TaskInfo) -> None:
-        job = self.ssn.jobs.get(task.job)
-        if job is not None:
-            job.update_task_status(task, TaskStatus.PENDING)
-        node = self.ssn.nodes.get(task.node_name)
-        if node is not None:
-            node.remove_task(task)
-        task.node_name = None
+        _undo_placement(self.ssn, task, release_volumes=False)
         self.ssn._fire(False, task)
 
     def _unallocate(self, task: TaskInfo) -> None:
-        job = self.ssn.jobs.get(task.job)
-        if job is not None:
-            job.update_task_status(task, TaskStatus.PENDING)
-        node = self.ssn.nodes.get(task.node_name)
-        if node is not None:
-            node.remove_task(task)
-        task.node_name = None
-        task.volume_ready = False
-        # free the PV reservation the allocate took — a discarded gang must
-        # not hold volumes across cycles and starve other claimants
-        release = getattr(self.ssn.cache.volume_binder, "release_task", None)
-        if release is not None:
-            release(task.uid)
+        # release_volumes frees the PV reservation the allocate took — a
+        # discarded gang must not hold volumes across cycles and starve
+        # other claimants
+        _undo_placement(self.ssn, task, release_volumes=True)
         self.ssn._fire(False, task)
 
 
@@ -542,6 +547,38 @@ def job_status(ssn: Session, job: JobInfo) -> None:
     pg.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
 
 
+def _undo_placement(ssn: Session, task: TaskInfo, release_volumes: bool) -> None:
+    """The shared placement-undo core: status→PENDING, node removal,
+    node_name cleared, and (for allocates) volume reservation release.
+    Used by Statement discard inverses (which additionally fire deallocate
+    events) and the exclusive-close residue revert (which doesn't — plugin
+    session state dies with the session anyway)."""
+    job = ssn.jobs.get(task.job)
+    if job is not None and task.key() in job.tasks:
+        job.update_task_status(task, TaskStatus.PENDING)
+    node = ssn.nodes.get(task.node_name) if task.node_name else None
+    if node is not None and task.key() in node.tasks:
+        node.remove_task(task)
+    task.node_name = None
+    if release_volumes:
+        task.volume_ready = False
+        release = getattr(ssn.cache.volume_binder, "release_task", None)
+        if release is not None:
+            release(task.uid)
+
+
+def _revert_residue(ssn: Session, tasks: List[TaskInfo], expected: TaskStatus,
+                    release_volumes: bool) -> None:
+    """Revert session-only placements still in `expected` status back to
+    PENDING on the live objects (exclusive close; the reference's clone takes
+    such state to the grave). The status guard makes this idempotent —
+    dispatched / discarded / transitioned tasks are skipped."""
+    for task in tasks:
+        if task.status != expected:
+            continue
+        _undo_placement(ssn, task, release_volumes)
+
+
 def close_session(ssn: Session) -> None:
     """Plugin close hooks then the job updater (framework.go:55-62 +
     job_updater.go:33-122, sans the 16-worker pool — the host loop is cold).
@@ -570,16 +607,15 @@ def close_session(ssn: Session) -> None:
             # a session (the reference's clone takes them to the grave;
             # statement.go pipeline no-ops on commit) — next cycle re-derives
             # them from fresh Releasing capacity
-            for task in ssn.pipelined_tasks:
-                if task.status != TaskStatus.PIPELINED:
-                    continue  # discarded or transitioned meanwhile
-                job = ssn.jobs.get(task.job)
-                if job is not None and task.key() in job.tasks:
-                    job.update_task_status(task, TaskStatus.PENDING)
-                node = ssn.nodes.get(task.node_name) if task.node_name else None
-                if node is not None and task.key() in node.tasks:
-                    node.remove_task(task)
-                task.node_name = None
+            _revert_residue(ssn, ssn.pipelined_tasks, TaskStatus.PIPELINED,
+                            release_volumes=False)
+            # likewise ALLOCATED residue: allocate only becomes durable via
+            # dispatch (ALLOCATED→BINDING when the job turns ready); a task
+            # still ALLOCATED here belongs to a job that never became ready
+            # this cycle (e.g. backfill into an unready gang) and must not
+            # leak node/volume accounting onto the authoritative cache
+            _revert_residue(ssn, ssn.allocated_tasks, TaskStatus.ALLOCATED,
+                            release_volumes=True)
             # drain binder acks BEFORE applying deferred ingest: a deferred
             # pod update must observe the durable bindings (pod.node_name)
             # this cycle produced, or it would clobber them
@@ -592,3 +628,4 @@ def close_session(ssn: Session) -> None:
         ssn.queues = {}
         ssn.plugins = []
         ssn.pipelined_tasks = []
+        ssn.allocated_tasks = []

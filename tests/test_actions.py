@@ -156,6 +156,35 @@ class TestBackfillAction:
         run_actions(cache)
         assert cache.binder.binds == {"c1/be": "n1"}
 
+    def test_backfill_into_unready_gang_reverts_at_close(self):
+        """ADVICE r2 (high): Session.allocate leaves a task ALLOCATED when
+        its job never turns ready in the cycle; the exclusive (no-clone)
+        close must revert it to PENDING on the authoritative cache — not
+        leak node accounting and phantom gang readiness across cycles."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=2, queue="default")],
+            nodes=[build_node("n1", cpu=100, mem=GiB)],
+            pods=[
+                # BestEffort member — backfill places it via Session.allocate
+                build_pod("c1", "be", None, PodPhase.PENDING, {}, group_name="pg1"),
+                # sibling that can never fit → job never ready (1 < 2)
+                build_pod("c1", "big", None, PodPhase.PENDING,
+                          {"cpu": 64000, "memory": 64 * GiB}, group_name="pg1"),
+            ],
+        )
+        run_actions(cache)
+        assert cache.binder.binds == {}
+        job = cache.jobs["c1/pg1"]
+        task = job.tasks["c1/be"]
+        assert task.status == TaskStatus.PENDING
+        assert task.node_name is None
+        assert job.ready_task_num == 0
+        # the node must be back to pristine accounting — no resident tasks
+        # at all (used.is_empty() alone is vacuous for a BestEffort resreq)
+        assert not cache.nodes["n1"].tasks
+        assert cache.nodes["n1"].used.is_empty()
+
 
 class TestPreemptAction:
     def test_high_priority_job_preempts_within_queue(self):
