@@ -63,15 +63,22 @@ class AllocateAction(Action):
         # are included so fairness state (queue_alloc/job_allocated) counts
         # Pending-phase jobs' allocations; the Pending-phase gate
         # (allocate.go:50-52) is the snapshot's job_schedulable flag
-        cluster = ClusterInfo(ssn.spec)
-        cluster.nodes = ssn.nodes
-        cluster.queues = ssn.queues
-        cluster.jobs = ssn.jobs
-        if not cluster.jobs or not cluster.nodes:
+        if not ssn.jobs or not ssn.nodes:
             return
 
         t0 = time.perf_counter()
-        snap, meta = build_snapshot(cluster)
+        cols = ssn.columns
+        if cols is not None:
+            # persistent columnar host model: row space == device axis, no
+            # per-object rebuild (api/columns.py)
+            snap, meta = cols.device_snapshot(ssn)
+        else:
+            # isolated (deep-clone) sessions rebuild from objects
+            cluster = ClusterInfo(ssn.spec)
+            cluster.nodes = ssn.nodes
+            cluster.queues = ssn.queues
+            cluster.jobs = ssn.jobs
+            snap, meta = build_snapshot(cluster)
         t1 = time.perf_counter()
         config = AllocateConfig(
             gang=ssn.plugin_enabled("gang"),
@@ -90,7 +97,17 @@ class AllocateAction(Action):
         pipelined = pipelined[: meta.n_tasks]
         t2 = time.perf_counter()
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
-        pending = np.asarray(snap.task_pending)[: meta.n_tasks]
+        # fit errors only for tasks of jobs that are IN this session (the
+        # columnar row space also carries rows of jobs the session dropped —
+        # gang-invalid or unknown-queue — which the object path never saw);
+        # Pending-phase jobs stay included: their histogram rows carry the
+        # real per-node reasons, keeping the condition dedup stable across
+        # cycles
+        job_in_session = np.asarray(snap.job_valid)
+        pending = (
+            np.asarray(snap.task_pending)[: meta.n_tasks]
+            & job_in_session[task_job]
+        )
         self._record_fit_errors(ssn, meta, fail_hist, assigned, task_job, pending)
         self._replay(ssn, snap, meta, assigned, pipelined, task_job)
         t3 = time.perf_counter()
@@ -215,6 +232,45 @@ class AllocateAction(Action):
         wrap_vec = spec.wrap_vec
         binds: List[Tuple[object, str]] = []
         by_node: Dict[int, Tuple[list, list]] = {}
+        # shared by the columnar count update and the bulk_bind job sums
+        n_alloc_applied = np.bincount(pjobs[alloc_sel], minlength=nJ)
+
+        cols = ssn.columns
+        columnar = (
+            cols is not None
+            and meta.task_objs is cols.task_by_row  # snapshot IS the row space
+            and ssn.all_handlers_columnar()
+        )
+        if columnar:
+            # ---- columnar apply: every ledger/count/status column updated
+            # by whole-matrix ops; the Python loop below only does what MUST
+            # touch objects (status-index buckets, node task dicts, the
+            # binds list).  The ledger matrices are the same buffers the
+            # JobInfo/NodeInfo Resource views wrap, so the object model
+            # observes every update with zero double bookkeeping.
+            BINDING_I = int(TaskStatus.BINDING)
+            PIPELINED_I = int(TaskStatus.PIPELINED)
+            PENDING_I = int(TaskStatus.PENDING)
+            alloc_rows = placed[alloc_sel]
+            pipe_rows = placed[pipe_sel]
+            cols.t_status[alloc_rows] = BINDING_I
+            cols.t_status[pipe_rows] = PIPELINED_I
+            apply_rows = placed[apply_mask]
+            cols.t_node[apply_rows] = node_of[apply_mask]
+            cols.j_alloc += job_alloc_sum
+            cols.j_pend -= job_total_sum
+            np.maximum(cols.j_pend, 0.0, out=cols.j_pend)
+            n_pipe_applied = np.bincount(pjobs[pipe_sel], minlength=nJ)
+            jc = cols.j_counts
+            jc[:, PENDING_I] -= n_alloc_applied + n_pipe_applied
+            jc[:, BINDING_I] += n_alloc_applied
+            jc[:, PIPELINED_I] += n_pipe_applied
+            cols.n_idle -= node_alloc_sum
+            np.maximum(cols.n_idle, 0.0, out=cols.n_idle)
+            cols.n_used += node_alloc_sum + node_pipe_sum
+            cols.n_rel -= node_pipe_sum
+            np.maximum(cols.n_rel, 0.0, out=cols.n_rel)
+            ssn.fire_columnar_allocations(cols, job_total_sum)
 
         for g in range(n_groups):
             lo, hi = bounds[g], bounds[g + 1]
@@ -224,6 +280,36 @@ class AllocateAction(Action):
             job = meta.job_objs[ji]
             alloc_tasks: list = []
             pipe_tasks: list = []
+            if columnar:
+                # object residue only: bucket moves, node dicts, binds.
+                # _status/_node_name are written as raw attrs — the columns
+                # were already updated vectorized above, and going through
+                # the property setters would redo 50k scalar column writes
+                for i in range(lo, hi):
+                    t = task_objs[placed_l[i]]
+                    ni = node_l[i]
+                    name = node_names[ni]
+                    t._node_name = name
+                    slot = by_node.get(ni)
+                    if slot is None:
+                        slot = by_node[ni] = ([], [])
+                    if pipe_l[i]:
+                        pnode = ssn.nodes.get(name)
+                        if pnode is not None:
+                            job.nodes_fit_delta[name] = (
+                                t.init_resreq.fit_delta(pnode.idle)
+                            )
+                        pipe_tasks.append(t)
+                        slot[1].append(t)
+                    else:
+                        alloc_tasks.append(t)
+                        slot[0].append(t)
+                        binds.append((t, name))
+                job.rebucket_moved(alloc_tasks, TaskStatus.BINDING)
+                if pipe_tasks:
+                    job.rebucket_moved(pipe_tasks, TaskStatus.PIPELINED)
+                    ssn.pipelined_tasks.extend(pipe_tasks)
+                continue
             for i in range(lo, hi):
                 t = task_objs[placed_l[i]]
                 ni = node_l[i]
@@ -261,15 +347,19 @@ class AllocateAction(Action):
                                        wrap_vec(job_total_sum[ji]))
 
         # per-node accounting with the presummed rows (node_info.go:165-222
-        # algebra, two vector ops per node instead of two per task)
+        # algebra); columnar path already applied the resource algebra via
+        # the column matrices — only the task dict / acct residue remains
         for ni, (allocs, pipes) in by_node.items():
             node = ssn.nodes.get(node_names[ni])
             if node is None:
                 continue
-            node.bulk_add_tasks(
-                allocs, pipes,
-                spec.wrap_vec(node_alloc_sum[ni]), spec.wrap_vec(node_pipe_sum[ni]),
-            )
+            if columnar:
+                node.bulk_register_tasks(allocs, pipes)
+            else:
+                node.bulk_add_tasks(
+                    allocs, pipes,
+                    spec.wrap_vec(node_alloc_sum[ni]), spec.wrap_vec(node_pipe_sum[ni]),
+                )
 
         if binds:
             # BindVolumes precedes every dispatch (statement.go:253-277)
@@ -280,7 +370,6 @@ class AllocateAction(Action):
             # hand the cache the segment sums this replay already computed
             # ({key: (count, vec)}; bulk_bind falls back to accumulating any
             # group whose applied count differs)
-            n_alloc_applied = np.bincount(pjobs[alloc_sel], minlength=nJ)
             job_sums = {
                 meta.job_objs[ji].uid: (int(n_alloc_applied[ji]), job_alloc_sum[ji])
                 for ji in np.flatnonzero(n_alloc_applied).tolist()
@@ -362,6 +451,7 @@ class AllocateAction(Action):
         if unplaced.size == 0:
             return
         hist = fail_hist[: meta.n_tasks]
+        n_nodes = getattr(meta, "live_nodes", meta.n_nodes)
         for ti in unplaced:
             job = meta.job_objs[int(task_job[ti])]
             task = meta.task_objs[int(ti)]
@@ -373,10 +463,10 @@ class AllocateAction(Action):
                 # capacity went to other tasks this cycle
                 counts = {
                     "node(s) resources were consumed by other tasks this cycle":
-                        meta.n_nodes
+                        n_nodes
                 }
             fe = FitErrors()
-            fe.set_histogram(counts, meta.n_nodes)
+            fe.set_histogram(counts, n_nodes)
             job.nodes_fit_errors[task.uid] = fe
 
     def _host_place(self, ssn, stmt, task) -> bool:

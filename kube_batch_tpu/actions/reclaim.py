@@ -65,10 +65,13 @@ def victim_gates(ssn, mode: str):
 def solve_claims(ssn, mode: str):
     """Run the eviction solve and decode to [(claimant_key, node_name,
     [victim_keys...])] in device claim order."""
-    cluster = _cluster_view(ssn)
-    if not cluster.jobs or not cluster.nodes:
+    if not ssn.jobs or not ssn.nodes:
         return [], None
-    snap, meta = build_snapshot(cluster)
+    cols = ssn.columns
+    if cols is not None:
+        snap, meta = cols.device_snapshot(ssn)
+    else:
+        snap, meta = build_snapshot(_cluster_view(ssn))
     gates = victim_gates(ssn, mode)
     config = EvictConfig(
         mode=mode,

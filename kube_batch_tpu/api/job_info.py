@@ -81,6 +81,11 @@ class JobInfo:
         self.pod_group: Optional[PodGroup] = None
         self.pdb = None  # legacy gang source (job_info.go:199-212 SetPDB)
         self.creation_index: int = 0
+        # ColumnStore binding (api/columns.py): when bound, the three ledger
+        # Resources above are views into the store's [J, R] matrices and the
+        # index choke points mirror per-status counts into j_counts
+        self._cols = None
+        self._row: int = -1
         if pod_group is not None:
             self.set_pod_group(pod_group)
 
@@ -107,13 +112,17 @@ class JobInfo:
     # -- task bookkeeping (job_info.go:211-263) ---------------------------
     def _index_add(self, task: TaskInfo) -> None:
         self.task_status_index[task.status][task.key()] = task
+        if self._cols is not None:
+            self._cols.j_counts[self._row, int(task.status)] += 1
 
     def _index_remove(self, task: TaskInfo) -> None:
         bucket = self.task_status_index.get(task.status)
         if bucket is not None:
-            bucket.pop(task.key(), None)
+            popped = bucket.pop(task.key(), None)
             if not bucket:
                 del self.task_status_index[task.status]
+            if popped is not None and self._cols is not None:
+                self._cols.j_counts[self._row, int(task.status)] -= 1
 
     def add_task(self, task: TaskInfo) -> None:
         key = task.key()
@@ -142,10 +151,20 @@ class JobInfo:
 
     def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
         """delete + re-add under the new status so indices and aggregates stay
-        consistent (job_info.go:250-263)."""
+        consistent (job_info.go:250-263).
+
+        `task` may be a clone of the resident object (preempt/reclaim evict
+        cloned victims, like the reference's session copies) — the clone then
+        becomes the canonical object, so it inherits the replaced object's
+        ColumnStore row."""
         key = task.key()
-        if key in self.tasks:
-            self.delete_task(task)
+        existing = self.tasks.get(key)
+        if existing is not None:
+            self.delete_task(existing)
+            if existing is not task:
+                store = getattr(existing, "_store", None)
+                if store is not None and task._store is None:
+                    store.adopt_task_row(existing, task)
         task.status = status
         self.add_task(task)
 
@@ -181,6 +200,10 @@ class JobInfo:
         ):
             del idx[src_status]
             idx[status] = src_bucket
+            if self._cols is not None:
+                counts = self._cols.j_counts[self._row]
+                counts[int(src_status)] -= len(tasks)
+                counts[int(status)] += len(tasks)
             flipped = len(tasks) if is_allocated(src_status) != new_alloc else 0
             pend_src = src_status == TaskStatus.PENDING
             new_pend = status == TaskStatus.PENDING
@@ -200,14 +223,21 @@ class JobInfo:
             flipped = 0
             new_pend = status == TaskStatus.PENDING
             pend_acc = None
+            counts = (
+                self._cols.j_counts[self._row] if self._cols is not None else None
+            )
             for task in tasks:
                 key = task._key
                 was_pend = task.status == TaskStatus.PENDING
                 bucket = idx.get(task.status)
                 if bucket is not None:
-                    bucket.pop(key, None)
+                    popped = bucket.pop(key, None)
                     if not bucket and bucket is not new_bucket:
                         del idx[task.status]
+                    if popped is not None and counts is not None:
+                        counts[int(task.status)] -= 1
+                if counts is not None:
+                    counts[int(status)] += 1
                 if is_allocated(task.status) != new_alloc:
                     flipped += 1
                 if was_pend != new_pend:
@@ -232,6 +262,38 @@ class JobInfo:
                 self.allocated.add_(resreq_sum)
             else:
                 self.allocated.sub_(resreq_sum)
+
+    def rebucket_moved(self, tasks, status: TaskStatus) -> None:
+        """Status-index bucket moves ONLY, for the columnar allocate replay:
+        ledgers, counts, and the t_status column were already updated by
+        whole-matrix ops (actions/allocate.py), so this touches nothing but
+        the bucket dicts and the raw _status attrs.  End state equals
+        bulk_transition's."""
+        if not tasks:
+            return
+        idx = self.task_status_index
+        new_bucket = idx[status]
+        src_status = tasks[0]._status
+        src_bucket = idx.get(src_status)
+        if (
+            not new_bucket
+            and src_bucket is not None
+            and len(src_bucket) == len(tasks)
+            and src_status != status
+        ):
+            del idx[src_status]
+            idx[status] = src_bucket
+            for t in tasks:
+                t._status = status
+        else:
+            for t in tasks:
+                b = idx.get(t._status)
+                if b is not None:
+                    b.pop(t._key, None)
+                    if not b and b is not new_bucket:
+                        del idx[t._status]
+                t._status = status
+                new_bucket[t._key] = t
 
     # -- gang predicates (job_info.go:367-418) ----------------------------
     def task_num(self, *statuses: TaskStatus) -> int:
@@ -294,6 +356,8 @@ class JobInfo:
         # and defaultdict would be immediately overwritten) — hot in
         # cache.snapshot at 50k tasks / 12.5k jobs
         j = JobInfo.__new__(JobInfo)
+        j._cols = None    # clones are never column-bound
+        j._row = -1
         j.uid = self.uid
         j.spec = self.spec
         j.name = self.name

@@ -54,6 +54,10 @@ class NodeInfo:
         self.releasing = spec.empty()
         # status each resident task was ACCOUNTED under (see task algebra)
         self._acct: Dict[str, TaskStatus] = {}
+        # ColumnStore binding (api/columns.py): when bound, the five ledger
+        # Resources above are views into the store's [N, R] matrices
+        self._cols = None
+        self._row: int = -1
         self._set_state()
 
     # -- state machine (node_info.go:110-134) -----------------------------
@@ -97,9 +101,9 @@ class NodeInfo:
         keeps stale accounting — same observable contract, NotReady node)."""
         self.name = node.name
         self.node = node
-        self.allocatable = _node_resource(node, self.spec, "allocatable")
-        self.capability = _node_resource(node, self.spec, "capacity")
-        idle_v = self.allocatable.vec.copy()
+        alloc = _node_resource(node, self.spec, "allocatable")
+        cap = _node_resource(node, self.spec, "capacity")
+        idle_v = alloc.vec.copy()
         used_v = self.spec.empty().vec
         rel_v = self.spec.empty().vec
         acct = self._acct
@@ -117,10 +121,25 @@ class NodeInfo:
                 idle_v -= r
                 used_v += r
             t.node_name = node.name
-        self.idle = Resource(np.maximum(idle_v, 0.0), self.spec)
-        self.used = Resource(used_v, self.spec)
-        self.releasing = Resource(np.maximum(rel_v, 0.0), self.spec)
+        np.maximum(idle_v, 0.0, out=idle_v)
+        np.maximum(rel_v, 0.0, out=rel_v)
+        if self._cols is None:
+            self.allocatable = alloc
+            self.capability = cap
+            self.idle = Resource(idle_v, self.spec)
+            self.used = Resource(used_v, self.spec)
+            self.releasing = Resource(rel_v, self.spec)
+        else:
+            # column-bound: write through the ledger views in place so the
+            # store's matrices stay the single source of truth
+            self.allocatable.vec[:] = alloc.vec
+            self.capability.vec[:] = cap.vec
+            self.idle.vec[:] = idle_v
+            self.used.vec[:] = used_v
+            self.releasing.vec[:] = rel_v
         self._set_state()
+        if self._cols is not None:
+            self._cols.sync_node_meta(self)
 
     # -- task algebra (node_info.go:165-222) ------------------------------
     # The reference clones each task into the node ("Node will hold a copy
@@ -201,6 +220,26 @@ class NodeInfo:
             self.used.add_(pipe_sum)
             self.releasing.sub_(pipe_sum)
 
+    def bulk_register_tasks(self, alloc_tasks, pipe_tasks) -> None:
+        """Task-dict/acct registration ONLY, for the columnar allocate
+        replay: the (Idle, Used, Releasing) algebra was already applied to
+        this node's ledger views by whole-matrix column ops.  End state
+        equals bulk_add_tasks'."""
+        tasks = self.tasks
+        acct = self._acct
+        name = self.name
+        for group, status in (
+            (alloc_tasks, TaskStatus.BINDING),
+            (pipe_tasks, TaskStatus.PIPELINED),
+        ):
+            for task in group:
+                key = task._key
+                if key in tasks:
+                    graft_assert(False, f"duplicate task {key} on node {name}")
+                task._node_name = name
+                tasks[key] = task
+                acct[key] = status
+
     def clone(self) -> "NodeInfo":
         # direct copy of the accounting triple instead of replaying every
         # resident task's status algebra (the triple already reflects it);
@@ -209,11 +248,19 @@ class NodeInfo:
         # never mutated in place, so the clone shares them. Tasks ARE cloned:
         # the session mutates its copies' statuses in place.
         n = NodeInfo.__new__(NodeInfo)
+        n._cols = None    # clones are never column-bound
+        n._row = -1
         n.spec = self.spec
         n.name = self.name
         n.node = self.node
-        n.allocatable = self.allocatable
-        n.capability = self.capability
+        # a bound node's allocatable/capability are live column views that
+        # set_node mutates in place — the clone needs value semantics
+        if self._cols is None:
+            n.allocatable = self.allocatable
+            n.capability = self.capability
+        else:
+            n.allocatable = self.allocatable.clone()
+            n.capability = self.capability.clone()
         n.idle = self.idle.clone()
         n.used = self.used.clone()
         n.releasing = self.releasing.clone()

@@ -13,6 +13,8 @@ class QueueInfo:
         self.name: str = queue.name
         self.weight: int = max(int(queue.weight), 1)
         self.queue: Queue = queue
+        self._cols = None  # ColumnStore binding (api/columns.py)
+        self._row: int = -1
 
     def clone(self) -> "QueueInfo":
         return QueueInfo(self.queue)

@@ -63,15 +63,21 @@ class TaskInfo:
         "namespace",
         "resreq",
         "init_resreq",
-        "node_name",
-        "status",
+        "_node_name",
+        "_status",
         "priority",
         "volume_ready",
         "pod",
         "_key",
+        "_row",
+        "_store",
     )
 
     def __init__(self, pod: Pod, spec: ResourceSpec):
+        # column binding first: the status/node_name property setters below
+        # mirror into the cache's ColumnStore once bound (api/columns.py)
+        self._row: int = -1
+        self._store = None
         self.uid: str = pod.uid
         self.job: str = job_id_for_pod(pod)
         self.name: str = pod.name
@@ -87,12 +93,39 @@ class TaskInfo:
             self.init_resreq.set_max_(_requests_to_resource(pod.init_requests, spec))
         else:
             self.init_resreq = self.resreq
-        self.node_name: Optional[str] = pod.node_name
-        self.status: TaskStatus = pod_phase_to_status(pod.phase, pod.node_name, pod.deleting)
+        self._node_name: Optional[str] = pod.node_name
+        self._status: TaskStatus = pod_phase_to_status(pod.phase, pod.node_name, pod.deleting)
         self.priority: int = pod.priority
         self.volume_ready: bool = False
         self.pod: Pod = pod
         self._key: str = f"{pod.namespace}/{pod.name}"
+
+    # ---- column-mirrored mutable state ----------------------------------
+    # status and node_name are the two fields that change after ingest;
+    # routing every write through these setters is what keeps the persistent
+    # ColumnStore current no matter which code path mutates a task
+    # (statements, bulk replay, residue revert, resync).
+    @property
+    def status(self) -> TaskStatus:
+        return self._status
+
+    @status.setter
+    def status(self, value: TaskStatus) -> None:
+        self._status = value
+        store = self._store
+        if store is not None:
+            store.t_status[self._row] = int(value)
+
+    @property
+    def node_name(self) -> Optional[str]:
+        return self._node_name
+
+    @node_name.setter
+    def node_name(self, value: Optional[str]) -> None:
+        self._node_name = value
+        store = self._store
+        if store is not None:
+            store.task_node_changed(self._row, value)
 
     @property
     def best_effort(self) -> bool:
@@ -135,14 +168,16 @@ class TaskInfo:
         node-side task copies at the 50k scale.  Anyone adding in-place
         mutation of task resreq must restore the deep copy here."""
         t = TaskInfo.__new__(TaskInfo)
+        t._row = -1       # clones are never column-bound (isolated sessions)
+        t._store = None
         t.uid = self.uid
         t.job = self.job
         t.name = self.name
         t.namespace = self.namespace
         t.resreq = self.resreq
         t.init_resreq = self.init_resreq
-        t.node_name = self.node_name
-        t.status = self.status
+        t._node_name = self._node_name
+        t._status = self._status
         t.priority = self.priority
         t.volume_ready = self.volume_ready
         t.pod = self.pod

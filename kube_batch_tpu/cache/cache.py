@@ -52,6 +52,11 @@ class SchedulerCache:
         self.spec = spec
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # the persistent columnar host model (api/columns.py): rows assigned
+        # at ingest, ledgers shared as views, snapshots built from columns
+        from kube_batch_tpu.api.columns import ColumnStore
+
+        self.columns = ColumnStore(spec)
         # --priority-class toggle (options.go:30, consumed cache.go:352,378)
         self.resolve_priority = resolve_priority
         self.binder = binder if binder is not None else FakeBinder()
@@ -208,6 +213,7 @@ class SchedulerCache:
         if job is None:
             job = JobInfo(task.job, self.spec)
             self.jobs[task.job] = job
+            self.columns.bind_job(job)
         if job.pod_group is None and pod.group_name is None and job.pdb is None:
             shadow = PodGroup(
                 name=pod.name,
@@ -234,6 +240,7 @@ class SchedulerCache:
     def _add_task(self, task: TaskInfo, pod: Pod) -> None:
         job = self._get_or_create_job(task, pod)
         job.add_task(task)
+        self.columns.bind_task(task, job)
         if task.node_name:
             node = self.nodes.get(task.node_name)
             if node is None:
@@ -242,6 +249,7 @@ class SchedulerCache:
                 node = NodeInfo(None, self.spec)
                 node.name = task.node_name
                 self.nodes[task.node_name] = node
+                self.columns.bind_node(node)
             node.add_task(task)
 
     def update_pod(self, pod: Pod) -> None:
@@ -286,6 +294,7 @@ class SchedulerCache:
                 node = self.nodes.get(task.node_name) if task.node_name else None
                 if node is not None and task.key() in node.tasks:
                     node.remove_task(task)
+                self.columns.free_task(task)
             self._maybe_collect_job(job)
 
     def _maybe_collect_job(self, job: JobInfo) -> None:
@@ -297,7 +306,8 @@ class SchedulerCache:
             and (job.pod_group is None or job.pod_group.shadow)
             and job.pdb is None
         ):
-            self.jobs.pop(job.uid, None)
+            if self.jobs.pop(job.uid, None) is not None:
+                self.columns.free_job(job)
             self._status_next_write.pop(job.uid, None)
 
     # ------------------------------------------------------------------
@@ -309,7 +319,9 @@ class SchedulerCache:
                 return
             existing = self.nodes.get(node.name)
             if existing is None:
-                self.nodes[node.name] = NodeInfo(node, self.spec)
+                info = NodeInfo(node, self.spec)
+                self.nodes[node.name] = info
+                self.columns.bind_node(info)
             else:
                 existing.set_node(node)
 
@@ -320,7 +332,9 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.delete_node, name):
                 return
-            self.nodes.pop(name, None)
+            node = self.nodes.pop(name, None)
+            if node is not None:
+                self.columns.free_node(node)
 
     # ------------------------------------------------------------------
     # ingest: podgroups (event_handlers.go:362-481)
@@ -336,6 +350,7 @@ class SchedulerCache:
             if job is None:
                 job = JobInfo(job_id, self.spec)
                 self.jobs[job_id] = job
+                self.columns.bind_job(job)
             job.set_pod_group(pg)
 
     def update_pod_group(self, pg: PodGroup) -> None:
@@ -349,7 +364,8 @@ class SchedulerCache:
             if job is not None:
                 job.pod_group = None
                 if not job.tasks:
-                    self.jobs.pop(key, None)
+                    if self.jobs.pop(key, None) is not None:
+                        self.columns.free_job(job)
             self._status_next_write.pop(key, None)
 
     # ------------------------------------------------------------------
@@ -373,6 +389,7 @@ class SchedulerCache:
             if job is None:
                 job = JobInfo(job_id, self.spec)
                 self.jobs[job_id] = job
+                self.columns.bind_job(job)
             # a shadow PodGroup synthesized for owner pods that arrived
             # before their PDB yields to the PDB as the gang source (its
             # min_member=1 would otherwise mask the PDB's min-available and
@@ -418,7 +435,9 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.add_queue, queue):
                 return
-            self.queues[queue.name] = QueueInfo(queue)
+            qinfo = QueueInfo(queue)
+            self.queues[queue.name] = qinfo
+            self.columns.bind_queue(qinfo)
 
     def update_queue(self, queue: Queue) -> None:
         self.add_queue(queue)
@@ -428,6 +447,7 @@ class SchedulerCache:
             if self._gate(self.delete_queue, name):
                 return
             self.queues.pop(name, None)
+            self.columns.free_queue(name)
 
     def add_priority_class(self, pc: PriorityClass) -> None:
         if not self.resolve_priority:
@@ -706,20 +726,28 @@ class SchedulerCache:
         with self._lock:
             spec = self.spec
             for job in self.jobs.values():
+                for task in job.tasks.values():
+                    self.columns.free_task(task)
                 job.tasks.clear()
                 job.task_status_index.clear()
-                job.allocated = spec.empty()
-                job.total_request = spec.empty()
-                job.pending_request = spec.empty()
+                # in-place zeroing: the ledgers may be live column views
+                # (api/columns.py) — rebinding would orphan them
+                job.allocated.vec[:] = 0.0
+                job.total_request.vec[:] = 0.0
+                job.pending_request.vec[:] = 0.0
+                if job._cols is not None:
+                    job._cols.j_counts[job._row] = 0
                 job.nodes_fit_delta = {}
                 job.nodes_fit_errors = {}
             for node in self.nodes.values():
                 node.tasks.clear()
                 node._acct.clear()
-                node.idle = node.allocatable.clone()
-                node.used = spec.empty()
-                node.releasing = spec.empty()
+                node.idle.vec[:] = node.allocatable.vec
+                node.used.vec[:] = 0.0
+                node.releasing.vec[:] = 0.0
                 node._set_state()
+                if node._cols is not None:
+                    node._cols.sync_node_meta(node)
             for pod in list(self.pods.values()):
                 if not self._owns(pod):
                     continue

@@ -64,13 +64,20 @@ class EventHandler:
     task.resreq (drf's job share, proportion's queue allocation) can expose
     one call per job with the presummed resreq, letting the vectorized
     allocate replay skip the per-task event loop. Handlers without it are
-    fired per task even on the bulk path — semantics never depend on it."""
+    fired per task even on the bulk path — semantics never depend on it.
+
+    `columnar_allocate_func(cols, job_sums)` is the fully-vectorized form:
+    one call per replay with the [capJ, R] per-job-row resreq sums (zeros for
+    untouched jobs).  The columnar allocate replay requires every handler
+    with allocate-side effects to provide it (actions/allocate.py gates on
+    that), so no handler can silently miss events."""
 
     def __init__(self, allocate_func=None, deallocate_func=None,
-                 batch_allocate_func=None):
+                 batch_allocate_func=None, columnar_allocate_func=None):
         self.allocate_func = allocate_func
         self.deallocate_func = deallocate_func
         self.batch_allocate_func = batch_allocate_func
+        self.columnar_allocate_func = columnar_allocate_func
 
 
 class FitFailure(Exception):
@@ -94,6 +101,10 @@ class Session:
         # the cache defers ingest until close and close_session unwinds
         # session-only state (pipelined placements)
         self.exclusive = exclusive
+        # the cache's persistent ColumnStore, exposed to plugins for
+        # vectorized session-open state (None for isolated sessions, whose
+        # cloned objects are not column-bound)
+        self.columns = getattr(cache, "columns", None) if exclusive else None
         # every task Pipelined this session (Statement.pipeline /
         # Session.pipeline / the bulk replay) — session-only state the
         # exclusive close must revert (a cloned session just dies)
@@ -289,6 +300,22 @@ class Session:
             elif eh.allocate_func is not None:
                 for t in tasks:
                     eh.allocate_func(Event(t))
+
+    def fire_columnar_allocations(self, cols, job_sums) -> None:
+        """One vectorized allocate-event pass for the whole replay
+        (job_sums: [capJ, R] per-job-row resreq sums)."""
+        for eh in self.event_handlers:
+            if eh.columnar_allocate_func is not None:
+                eh.columnar_allocate_func(cols, job_sums)
+
+    def all_handlers_columnar(self) -> bool:
+        """True when every handler with allocate-side effects supports the
+        columnar form — the allocate replay's gate for the vectorized path."""
+        return all(
+            eh.columnar_allocate_func is not None
+            or (eh.allocate_func is None and eh.batch_allocate_func is None)
+            for eh in self.event_handlers
+        )
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         job = self.jobs.get(task.job)
@@ -523,9 +550,21 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
 
 
 def job_status(ssn: Session, job: JobInfo) -> None:
-    """Derive and set the PodGroup phase/counts (session.go:151-189)."""
+    """Derive and set the PodGroup phase/counts (session.go:151-189).
+
+    Shadow PodGroups (synthesized for plain pods, cache/util.go:42-60) carry
+    NO durable phase: in the reference the jobUpdater's CRD write fails for
+    them and the informer-fed mirror keeps the phase empty, so an
+    unschedulable plain pod is retried every cycle even without the enqueue
+    action.  The no-clone session must reproduce that by not writing the
+    phase onto the synthesized object."""
     pg = job.pod_group
     if pg is None:
+        return
+    if pg.shadow:
+        pg.running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+        pg.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+        pg.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
         return
     unschedulable = any(
         c.type == "Unschedulable" and c.status == "True" and c.transition_id == ssn.uid

@@ -94,10 +94,16 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
     preempt = config.mode == "preempt"
 
     task_queue = snap.job_queue[snap.task_job]                      # [T]
+    # job_valid gates victims too: the columnar snapshot's row space carries
+    # tasks of jobs OUTSIDE the session (dropped at open / unknown queue),
+    # which the per-session object snapshot never contained — their rows'
+    # job metadata (min_avail, queue) is stale scratch and the host decode
+    # would drop them anyway, wasting the whole claim
     running = (
         snap.task_valid
         & (snap.task_status == int(TaskStatus.RUNNING))
         & (snap.task_node >= 0)
+        & snap.job_valid[snap.task_job]
     )
     static_ok = static_predicates(snap)
     score = score_matrix(snap, config.weights)

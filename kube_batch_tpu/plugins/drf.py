@@ -22,12 +22,13 @@ SHARE_DELTA = 1e-6  # drf.go:33
 
 
 class _JobAttr:
-    __slots__ = ("allocated", "_share", "_dirty")
+    __slots__ = ("allocated", "_share", "_dirty", "_gen")
 
     def __init__(self, allocated: Resource):
         self.allocated = allocated
         self._share = 0.0
         self._dirty = True
+        self._gen = 0
 
 
 class DrfPlugin(Plugin):
@@ -37,24 +38,47 @@ class DrfPlugin(Plugin):
         super().__init__(arguments)
         self.total: Resource | None = None
         self.job_attrs: Dict[str, _JobAttr] = {}
+        # columnar mode: per-job-row allocated matrix the attrs' Resources
+        # are views into; _generation invalidates every cached share after a
+        # vectorized update
+        self._arr = None
+        self._generation = 0
 
     def _share(self, attr: _JobAttr) -> float:
         # recomputed lazily on read: the allocate replay fires thousands of
         # batch events whose shares nothing reads until preempt/reclaim
-        if attr._dirty:
+        if attr._dirty or attr._gen != self._generation:
             attr._share = attr.allocated.share(self.total)
             attr._dirty = False
+            attr._gen = self._generation
         return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
+        import numpy as np
+
         self.total = ssn.spec.empty()
         for node in ssn.nodes.values():
             self.total.add_(node.allocatable)
-        for job in ssn.jobs.values():
-            # job.allocated IS the sum of allocated-status task resreqs —
-            # the ledger add_task/bulk_transition maintain (job_info.py);
-            # re-deriving it per task was the session-open hot loop
-            self.job_attrs[job.uid] = _JobAttr(job.allocated.clone())
+        cols = ssn.columns
+        if cols is not None:
+            # columnar session: one matrix copy seeds every job's allocated
+            # state; attrs wrap rows zero-copy (per-task deallocate events
+            # from evictions write the same rows the vectorized allocate
+            # updates, so both paths compose)
+            self._arr = cols.j_alloc.copy()
+            wrap = ssn.spec.wrap_vec
+            arr = self._arr
+            self.job_attrs = {
+                job.uid: _JobAttr(wrap(arr[job._row]))
+                for job in ssn.jobs.values()
+                if job._row >= 0
+            }
+        else:
+            for job in ssn.jobs.values():
+                # job.allocated IS the sum of allocated-status task resreqs —
+                # the ledger add_task/bulk_transition maintain (job_info.py);
+                # re-deriving it per task was the session-open hot loop
+                self.job_attrs[job.uid] = _JobAttr(job.allocated.clone())
 
         def preemptable(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
             """(drf.go:85-110)"""
@@ -109,13 +133,24 @@ class DrfPlugin(Plugin):
                 attr.allocated.add_(total_resreq)
                 attr._dirty = True
 
+        def on_columnar_allocate(cols, job_sums) -> None:
+            # one matrix add for the whole replay ≡ 12.5k batch events
+            self._arr += job_sums
+            self._generation += 1
+
         ssn.add_fn(fw.PREEMPTABLE, self.name, preemptable)
         ssn.add_fn(fw.JOB_ORDER, self.name, job_order)
         ssn.add_event_handler(
-            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
-                            batch_allocate_func=on_batch_allocate)
+            fw.EventHandler(
+                allocate_func=on_allocate, deallocate_func=on_deallocate,
+                batch_allocate_func=on_batch_allocate,
+                columnar_allocate_func=(
+                    on_columnar_allocate if self._arr is not None else None
+                ),
+            )
         )
 
     def on_session_close(self, ssn: fw.Session) -> None:
         self.total = None
         self.job_attrs = {}
+        self._arr = None

@@ -25,7 +25,7 @@ from kube_batch_tpu.framework import session as fw
 
 class _QueueAttr:
     __slots__ = ("queue", "weight", "deserved", "allocated", "request",
-                 "_share", "_dirty")
+                 "_share", "_dirty", "_gen")
 
     def __init__(self, queue: QueueInfo, spec):
         self.queue = queue
@@ -35,6 +35,7 @@ class _QueueAttr:
         self.request = spec.empty()
         self._share = 0.0
         self._dirty = True
+        self._gen = 0
 
 
 class ProportionPlugin(Plugin):
@@ -44,14 +45,21 @@ class ProportionPlugin(Plugin):
         super().__init__(arguments)
         self.total: Resource | None = None
         self.queue_attrs: Dict[str, _QueueAttr] = {}
+        # columnar mode: [nq, R] allocated matrix the attrs wrap + a
+        # job-row → attr-index map for the vectorized allocate events
+        self._qalloc = None
+        self._jq_rows = None
+        self._jq_vals = None
+        self._generation = 0
 
     def _share(self, attr: _QueueAttr) -> float:
         """share = dominant allocated/deserved (proportion.go:265-277),
         recomputed lazily on read — the allocate replay fires thousands of
         batch events whose shares nothing reads until queue ordering."""
-        if attr._dirty:
+        if attr._dirty or attr._gen != self._generation:
             attr._share = _dominant(attr.allocated, attr.deserved)
             attr._dirty = False
+            attr._gen = self._generation
         return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
@@ -59,19 +67,47 @@ class ProportionPlugin(Plugin):
         self.total = spec.empty()
         for node in ssn.nodes.values():
             self.total.add_(node.allocatable)
-        # queue attrs from jobs present this session (proportion.go:67-99)
-        for job in ssn.jobs.values():
-            if job.queue not in ssn.queues:
-                continue
-            attr = self.queue_attrs.get(job.queue)
-            if attr is None:
-                attr = _QueueAttr(ssn.queues[job.queue], spec)
-                self.queue_attrs[job.queue] = attr
-            # request = allocated + pending (proportion.go:87-99), both read
-            # straight off the JobInfo ledgers — no task iteration
-            attr.allocated.add_(job.allocated)
-            attr.request.add_(job.allocated)
-            attr.request.add_(job.pending_request)
+        cols = ssn.columns
+        if cols is not None:
+            # columnar session: segment-sum the job ledger matrices by queue
+            # instead of per-job Resource arithmetic (proportion.go:67-99)
+            qindex: Dict[str, int] = {}
+            job_qidx = np.full(cols.jobs.cap, -1, np.int32)
+            for job in ssn.jobs.values():
+                if job._row < 0 or job.queue not in ssn.queues:
+                    continue
+                qi = qindex.get(job.queue)
+                if qi is None:
+                    qi = qindex[job.queue] = len(qindex)
+                job_qidx[job._row] = qi
+            nq = max(len(qindex), 1)
+            alloc_m = np.zeros((nq, spec.n))
+            request_m = np.zeros((nq, spec.n))
+            rows = np.flatnonzero(job_qidx >= 0)
+            vals = job_qidx[rows]
+            np.add.at(alloc_m, vals, cols.j_alloc[rows])
+            np.add.at(request_m, vals, cols.j_alloc[rows] + cols.j_pend[rows])
+            self._qalloc, self._jq_rows, self._jq_vals = alloc_m, rows, vals
+            wrap = spec.wrap_vec
+            for qname, qi in qindex.items():
+                attr = _QueueAttr(ssn.queues[qname], spec)
+                attr.allocated = wrap(alloc_m[qi])
+                attr.request = wrap(request_m[qi])
+                self.queue_attrs[qname] = attr
+        else:
+            # queue attrs from jobs present this session (proportion.go:67-99)
+            for job in ssn.jobs.values():
+                if job.queue not in ssn.queues:
+                    continue
+                attr = self.queue_attrs.get(job.queue)
+                if attr is None:
+                    attr = _QueueAttr(ssn.queues[job.queue], spec)
+                    self.queue_attrs[job.queue] = attr
+                # request = allocated + pending (proportion.go:87-99), both
+                # read straight off the JobInfo ledgers — no task iteration
+                attr.allocated.add_(job.allocated)
+                attr.request.add_(job.allocated)
+                attr.request.add_(job.pending_request)
         self._waterfill(spec)
 
         def queue_order(l: QueueInfo, r: QueueInfo) -> int:
@@ -148,13 +184,23 @@ class ProportionPlugin(Plugin):
                 attr.allocated.add_(total_resreq)
                 attr._dirty = True
 
+        def on_columnar_allocate(cols, job_sums) -> None:
+            # one segment-sum for the whole replay ≡ 12.5k batch events
+            np.add.at(self._qalloc, self._jq_vals, job_sums[self._jq_rows])
+            self._generation += 1
+
         ssn.add_fn(fw.QUEUE_ORDER, self.name, queue_order)
         ssn.add_fn(fw.RECLAIMABLE, self.name, reclaimable)
         ssn.add_fn(fw.OVERUSED, self.name, overused_fn)
         ssn.add_fn(fw.JOB_ENQUEUEABLE, self.name, job_enqueueable)
         ssn.add_event_handler(
-            fw.EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate,
-                            batch_allocate_func=on_batch_allocate)
+            fw.EventHandler(
+                allocate_func=on_allocate, deallocate_func=on_deallocate,
+                batch_allocate_func=on_batch_allocate,
+                columnar_allocate_func=(
+                    on_columnar_allocate if self._qalloc is not None else None
+                ),
+            )
         )
 
     def _waterfill(self, spec) -> None:
@@ -189,6 +235,7 @@ class ProportionPlugin(Plugin):
     def on_session_close(self, ssn: fw.Session) -> None:
         self.total = None
         self.queue_attrs = {}
+        self._qalloc = self._jq_rows = self._jq_vals = None
 
 
 def _dominant(alloc: Resource, deserved: Resource) -> float:

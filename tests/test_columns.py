@@ -1,0 +1,168 @@
+"""ColumnStore consistency: the persistent columnar host model must agree
+with the object model after ingest, scheduling cycles, evictions, churn,
+and axis growth (api/columns.py check_consistency)."""
+
+import numpy as np
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import Node, PodGroup, PriorityClass
+from kube_batch_tpu.api.types import PodPhase, TaskStatus
+from kube_batch_tpu.framework.conf import parse_scheduler_conf
+from kube_batch_tpu.scheduler import Scheduler
+
+from tests.fixtures import GiB, build_cache, build_node, build_pod
+
+FULL_CONF = """
+actions: "enqueue, reclaim, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def assert_consistent(cache):
+    errs = cache.columns.check_consistency(cache)
+    assert not errs, "\n".join(errs)
+
+
+class TestColumnConsistency:
+    def test_ingest_and_cycle(self):
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg1", namespace="c1", min_member=3, queue="default")],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB),
+                   build_node("n2", cpu=4000, mem=8 * GiB)],
+            pods=[
+                build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="pg1")
+                for i in range(3)
+            ] + [build_pod("c1", "solo", None, PodPhase.PENDING,
+                           {"cpu": 500, "memory": GiB})],
+        )
+        assert_consistent(cache)
+        sched = Scheduler(cache)
+        sched.run_once()
+        assert_consistent(cache)
+        assert len(cache.binder.binds) == 4
+
+    def test_churn_and_growth(self):
+        """Enough pods to force several task-axis growths + delete/re-add
+        churn so rows are freed and reused."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node(f"n{i}", cpu=64000, mem=64 * GiB, pods=200)
+                   for i in range(4)],
+            pods=[],
+        )
+        for i in range(40):
+            cache.add_pod(build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                                    {"cpu": 100, "memory": GiB // 8}))
+        assert_consistent(cache)
+        # delete half (frees rows), re-add with different requests
+        for i in range(0, 40, 2):
+            cache.delete_pod(cache.pods[f"c1/p{i}"])
+        assert_consistent(cache)
+        for i in range(40, 120):
+            cache.add_pod(build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                                    {"cpu": 200, "memory": GiB // 4}))
+        assert_consistent(cache)
+        sched = Scheduler(cache)
+        sched.run_once()
+        assert_consistent(cache)
+        # every pending pod fit
+        assert len(cache.binder.binds) == 100
+
+    def test_full_pipeline_with_eviction(self):
+        """Eviction flows (preempt) + kubelet sim keep columns in sync."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=1, queue="default"),
+                PodGroup(name="high", namespace="c1", min_member=1, queue="default",
+                         priority_class="high-prio"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "high-1", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="high",
+                          priority=100),
+            ],
+        )
+        cache.add_priority_class(PriorityClass(name="high-prio", value=100))
+        conf = parse_scheduler_conf(FULL_CONF)
+        sched = Scheduler(cache, conf=conf)
+        sched.run_once()
+        assert_consistent(cache)
+        assert len(cache.evictor.evicts) == 1
+        cache.delete_pod(cache.pods[cache.evictor.evicts[0]])
+        assert_consistent(cache)
+        sched.run_once()
+        cache.flush_binds()
+        assert cache.binder.binds.get("c1/high-1") == "n1"
+        assert_consistent(cache)
+
+    def test_node_update_and_labels(self):
+        """set_node on a bound node rewrites ledger views in place and
+        re-interns labels; late-arriving labels un-impossible selectors."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB)],
+            pods=[build_pod("c1", "sel", None, PodPhase.PENDING,
+                            {"cpu": 500, "memory": GiB},
+                            node_selector={"zone": "a"})],
+        )
+        sched = Scheduler(cache)
+        sched.run_once()
+        assert cache.binder.binds == {}  # no node carries zone=a yet
+        assert_consistent(cache)
+        # node gains the label → selector becomes satisfiable
+        cache.add_node(Node(name="n1", allocatable={"cpu": 4000,
+                                                    "memory": 8 * GiB,
+                                                    "pods": 110},
+                            labels={"zone": "a"}))
+        sched.run_once()
+        cache.flush_binds()
+        assert cache.binder.binds == {"c1/sel": "n1"}
+        assert_consistent(cache)
+
+    def test_node_delete_row_reuse_no_alias(self):
+        """Deleting a node with resident bound pods must clear their t_node
+        rows — a later node reusing the freed row must not inherit them."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "resident", "n1", PodPhase.RUNNING,
+                            {"cpu": 500, "memory": GiB})],
+        )
+        cache.delete_node("n1")
+        assert (cache.columns.t_node == -1).all()
+        cache.add_node(build_node("n2"))  # reuses the freed row
+        row = cache.columns.node_rows["n2"]
+        assert not (cache.columns.t_node == row).any()
+        assert_consistent(cache)
+
+    def test_rebuild_from_pod_store(self):
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "a", "n1", PodPhase.RUNNING,
+                            {"cpu": 500, "memory": GiB}),
+                  build_pod("c1", "b", None, PodPhase.PENDING,
+                            {"cpu": 500, "memory": GiB})],
+        )
+        cache.rebuild_from_pod_store()
+        assert_consistent(cache)
+        idle = cache.nodes["n1"].idle
+        assert idle.milli_cpu == cache.nodes["n1"].allocatable.milli_cpu - 500
