@@ -874,6 +874,36 @@ class SchedulerCache:
         # cache.go:722-736); task_unschedulable dedups the conditions
         self.record_job_status_event(job)
 
+    def update_job_statuses_bulk(self, updates) -> None:
+        """The exclusive close's status pass: update_job_status semantics for
+        a pre-filtered batch under one lock.  `updates` is
+        [(job, changed, need_record)]; exclusive sessions mutate the
+        authoritative PodGroup in place, so the own_pg copy-back of the
+        per-job path is a no-op here and only the rate-limit bookkeeping,
+        the updater call, and event recording remain."""
+        import random
+        import time as _time
+
+        to_write = []
+        to_record = []
+        with self._lock:
+            now = _time.monotonic()
+            next_write = self._status_next_write
+            for job, changed, need_record in updates:
+                pg = job.pod_group
+                if pg is None or self.jobs.get(job.uid) is None:
+                    continue  # deleted mid-cycle: no write, no events
+                if need_record:
+                    to_record.append(job)
+                if not changed and now < next_write.get(job.uid, 0.0):
+                    continue  # condition-only churn, rate-limited
+                next_write[job.uid] = now + 60.0 + random.uniform(0, 30.0)
+                to_write.append(pg)
+        for pg in to_write:
+            self.status_updater.update_pod_group(pg)
+        for job in to_record:
+            self.record_job_status_event(job)
+
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)
     # ------------------------------------------------------------------

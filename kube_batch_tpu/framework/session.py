@@ -115,18 +115,6 @@ class Session:
         # state too — exclusive close reverts whatever is still ALLOCATED
         # (the reference's clone takes it to the grave, session.go:286-294)
         self.allocated_tasks: List[TaskInfo] = []
-        if exclusive:
-            # per-session diagnostic state on the live objects — a cloned
-            # session starts clean because clone() clears these
-            # (job_info.go:295-329); the no-clone path must do it explicitly
-            # or stale fit errors replay forever (and grow unboundedly)
-            for job in self.jobs.values():
-                if job.nodes_fit_delta:
-                    job.nodes_fit_delta = {}
-                if job.nodes_fit_errors:
-                    job.nodes_fit_errors = {}
-                if job.job_fit_errors:
-                    job.job_fit_errors = ""
         self.tiers = tiers
         self.plugins: List = []
         # plugin-fn registries: kind → {plugin_name: fn}
@@ -142,13 +130,25 @@ class Session:
         # PodGroup statuses as they stood at open (session.go:102-105), used
         # by the job updater to detect condition-only updates (rate-limited)
         # — essential in exclusive mode, where the session mutates the
-        # authoritative PodGroup in place and a post-hoc compare is vacuous
-        self.pod_group_status_at_open: Dict[str, tuple] = {
-            j.uid: (j.pod_group.phase, j.pod_group.running, j.pod_group.failed,
-                    j.pod_group.succeeded)
-            for j in self.jobs.values()
-            if j.pod_group
-        }
+        # authoritative PodGroup in place and a post-hoc compare is vacuous.
+        # Exclusive sessions also clear per-session diagnostic state on the
+        # live objects in the same pass — a cloned session starts clean
+        # because clone() does this (job_info.go:295-329); the no-clone path
+        # must, or stale fit errors replay forever (and grow unboundedly).
+        self.pod_group_status_at_open: Dict[str, tuple] = {}
+        at_open = self.pod_group_status_at_open
+        for job in self.jobs.values():
+            if exclusive:
+                if job.nodes_fit_delta:
+                    job.nodes_fit_delta = {}
+                if job.nodes_fit_errors:
+                    job.nodes_fit_errors = {}
+                if job.job_fit_errors:
+                    job.job_fit_errors = ""
+            pg = job.pod_group
+            if pg is not None:
+                at_open[job.uid] = (pg.phase, pg.running, pg.failed,
+                                    pg.succeeded)
 
     # ---- registration (session_plugins.go:25-97) ------------------------
     def add_fn(self, kind: str, plugin_name: str, fn: Callable) -> None:
@@ -526,8 +526,34 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
                 plugin = get_plugin_builder(opt.name)(opt.arguments)
                 ssn.plugins.append(plugin)
                 plugin.on_session_open(ssn)
-        # gang-validity gate after plugins registered their JobValid fns
-        for uid, job in list(ssn.jobs.items()):
+        # gang-validity gate after plugins registered their JobValid fns.
+        # Columnar sessions prefilter with one counts-matrix expression when
+        # gang is the only JobValid voter (its verdict IS the count compare,
+        # gang.go:48-69) — only the normally-sparse invalid set walks the
+        # full dispatch for its reason string.
+        cols = ssn.columns
+        valid_voters = set(ssn._fns.get(JOB_VALID, {}).keys())
+        if cols is not None and valid_voters <= {"gang"} and ssn.jobs:
+            if not valid_voters:
+                gate_jobs = []
+            else:
+                import numpy as np
+
+                from kube_batch_tpu.api.columns import VALID_STATUSES
+
+                jobs_list = list(ssn.jobs.items())
+                rows = np.fromiter(
+                    (j._row for _, j in jobs_list), np.int64, count=len(jobs_list)
+                )
+                minav = np.fromiter(
+                    (j.min_available for _, j in jobs_list), np.int32,
+                    count=len(jobs_list),
+                )
+                valid_num = cols.j_counts[rows][:, VALID_STATUSES].sum(axis=1)
+                gate_jobs = [jobs_list[i] for i in np.flatnonzero(valid_num < minav)]
+        else:
+            gate_jobs = list(ssn.jobs.items())
+        for uid, job in gate_jobs:
             reason = ssn.job_valid(job)
             if reason is not None:
                 ssn.update_job_condition(
@@ -618,6 +644,70 @@ def _revert_residue(ssn: Session, tasks: List[TaskInfo], expected: TaskStatus,
         _undo_placement(ssn, task, release_volumes)
 
 
+def _close_status_columnar(ssn: Session) -> None:
+    """The close-session status pass driven by the counts matrix: phase
+    derivation (job_status) becomes vectorized arithmetic; per-job work is
+    paid only by jobs whose status changed or that have something to report.
+    End state equals the per-job loop's."""
+    import numpy as np
+
+    cols = ssn.columns
+    jobs_list = list(ssn.jobs.values())
+    M = len(jobs_list)
+    rows = np.fromiter((j._row for j in jobs_list), np.int64, count=M)
+    counts = cols.j_counts[rows]
+    running_c = counts[:, int(TaskStatus.RUNNING)]
+    failed_c = counts[:, int(TaskStatus.FAILED)]
+    succ_c = counts[:, int(TaskStatus.SUCCEEDED)]
+    alloc_c = (
+        counts[:, int(TaskStatus.BOUND)]
+        + counts[:, int(TaskStatus.BINDING)]
+        + counts[:, int(TaskStatus.RUNNING)]
+        + counts[:, int(TaskStatus.ALLOCATED)]
+    )
+    # tasks stuck Pending/Allocated → fit-error conditions must be written
+    # (record_job_status_event's has_stuck gate, cache.go:704-719)
+    stuck_c = counts[:, int(TaskStatus.PENDING)] + counts[:, int(TaskStatus.ALLOCATED)]
+    prev_map = ssn.pod_group_status_at_open
+    updates = []
+    for i, job in enumerate(jobs_list):
+        pg = job.pod_group
+        if pg is None:
+            if job.pdb is not None and counts[i, int(TaskStatus.PENDING)]:
+                ssn.cache.record_job_status_event(job)
+            continue
+        r, f, s = int(running_c[i]), int(failed_c[i]), int(succ_c[i])
+        if pg.shadow:
+            # no durable phase for synthesized groups (see job_status) —
+            # but changed counts still write, like the per-job path
+            pg.running, pg.failed, pg.succeeded = r, f, s
+            changed = prev_map.get(job.uid) != (pg.phase, r, f, s)
+            if changed or stuck_c[i]:
+                updates.append((job, changed, bool(stuck_c[i])))
+            continue
+        unschedulable = any(
+            c.type == "Unschedulable" and c.status == "True"
+            and c.transition_id == ssn.uid
+            for c in pg.conditions
+        )
+        if r and unschedulable:
+            phase = PodGroupPhase.UNKNOWN
+        elif alloc_c[i] >= pg.min_member:
+            phase = PodGroupPhase.RUNNING
+        elif pg.phase != PodGroupPhase.INQUEUE:
+            phase = PodGroupPhase.PENDING
+        else:
+            phase = pg.phase
+        pg.phase, pg.running, pg.failed, pg.succeeded = phase, r, f, s
+        changed = prev_map.get(job.uid) != (phase, r, f, s)
+        need_record = bool(stuck_c[i]) or phase in (
+            PodGroupPhase.PENDING, PodGroupPhase.UNKNOWN
+        )
+        if changed or need_record or pg.conditions:
+            updates.append((job, changed, need_record))
+    ssn.cache.update_job_statuses_bulk(updates)
+
+
 def close_session(ssn: Session) -> None:
     """Plugin close hooks then the job updater (framework.go:55-62 +
     job_updater.go:33-122, sans the 16-worker pool — the host loop is cold).
@@ -626,20 +716,23 @@ def close_session(ssn: Session) -> None:
     try:
         for plugin in ssn.plugins:
             plugin.on_session_close(ssn)
-        for job in ssn.jobs.values():
-            if job.pod_group is None:
-                # PDB-defined jobs get events only, no status writeback
-                # (job_updater.go:108-111; unschedulable iff tasks stay
-                # Pending, cache.go:699)
-                if job.pdb is not None and job.task_status_index.get(
-                    TaskStatus.PENDING
-                ):
-                    ssn.cache.record_job_status_event(job)
-                continue
-            job_status(ssn, job)
-            ssn.cache.update_job_status(
-                job, prev_status=ssn.pod_group_status_at_open.get(job.uid)
-            )
+        if ssn.columns is not None and ssn.jobs:
+            _close_status_columnar(ssn)
+        else:
+            for job in ssn.jobs.values():
+                if job.pod_group is None:
+                    # PDB-defined jobs get events only, no status writeback
+                    # (job_updater.go:108-111; unschedulable iff tasks stay
+                    # Pending, cache.go:699)
+                    if job.pdb is not None and job.task_status_index.get(
+                        TaskStatus.PENDING
+                    ):
+                        ssn.cache.record_job_status_event(job)
+                    continue
+                job_status(ssn, job)
+                ssn.cache.update_job_status(
+                    job, prev_status=ssn.pod_group_status_at_open.get(job.uid)
+                )
     finally:
         if ssn.exclusive:
             # revert surviving Pipelined placements: they exist only inside

@@ -67,9 +67,32 @@ class GangPlugin(Plugin):
 
     def on_session_close(self, ssn: fw.Session) -> None:
         """(gang.go:132-175) mark still-unready jobs Unschedulable."""
-        for job in ssn.jobs.values():
-            if job.ready() or not job.tasks:
-                continue
+        cols = ssn.columns
+        if cols is not None and ssn.jobs:
+            # one counts-matrix expression finds the (normally sparse)
+            # unready set; only those jobs pay the condition rendering
+            import numpy as np
+
+            from kube_batch_tpu.api.columns import READY_STATUSES
+
+            jobs_list = list(ssn.jobs.values())
+            rows = np.fromiter((j._row for j in jobs_list), np.int64,
+                               count=len(jobs_list))
+            counts = cols.j_counts[rows]
+            ready = counts[:, READY_STATUSES].sum(axis=1) >= np.fromiter(
+                (j.min_available for j in jobs_list), np.int32,
+                count=len(jobs_list),
+            )
+            has_tasks = counts.sum(axis=1) > 0
+            candidates = [
+                jobs_list[i] for i in np.flatnonzero(~ready & has_tasks)
+            ]
+        else:
+            candidates = [
+                job for job in ssn.jobs.values()
+                if not job.ready() and job.tasks
+            ]
+        for job in candidates:
             fit_errors = [fe.error() for fe in job.nodes_fit_errors.values()]
             message = job.fit_error() + (
                 f"; {fit_errors[0]}" if fit_errors else ""
