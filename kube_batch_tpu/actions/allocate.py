@@ -55,6 +55,8 @@ class AllocateAction(Action):
         # per-phase ms of the most recent execute() — read by bench.py via
         # get_action("allocate").last_phase_ms
         self.last_phase_ms: Dict[str, float] = {}
+        # "single" | "sharded" — which solve the last execute() dispatched
+        self.last_solve_mode = "single"
 
     def execute(self, ssn) -> None:
         self.last_phase_ms = {}
@@ -87,7 +89,21 @@ class AllocateAction(Action):
             use_pallas=_pallas_enabled(ssn),
             weights=ssn.score_weights,
         )
-        result = allocate_solve(snap, config)
+        # multi-chip parts shard the node axis over the ICI mesh — the
+        # production analog of the reference's always-on 16-worker fan-out
+        # (scheduler_helper.go:34-64); single-chip or small-N stays local
+        from kube_batch_tpu.parallel.mesh import (
+            default_mesh,
+            sharded_allocate_solve,
+            should_shard,
+        )
+
+        if should_shard(snap.node_alloc.shape[0]):
+            result = sharded_allocate_solve(snap, config, default_mesh())
+            self.last_solve_mode = "sharded"
+        else:
+            result = allocate_solve(snap, config)
+            self.last_solve_mode = "single"
         # one blocking transfer for everything the host reads (assignment,
         # pipelined flags, and the fit-error histogram the diagnostics use)
         assigned, pipelined, fail_hist = jax.device_get(

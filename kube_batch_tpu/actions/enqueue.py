@@ -22,18 +22,28 @@ class EnqueueAction(Action):
         queues = PriorityQueue(less=ssn.queue_order_fn)
         queue_set = set()
         jobs_map = {}
+        any_min_res = False
         for job in ssn.jobs.values():
             if job.queue not in ssn.queues:
                 continue
             if job.pod_group is None or job.pod_group.phase != PodGroupPhase.PENDING:
                 continue
+            if job.pod_group.min_resources is None:
+                # unconditional promotion (enqueue.go:102-105): admission
+                # order is unobservable for jobs that consume no budget, so
+                # they skip the priority-queue machinery entirely — at 12.5k
+                # Pending podgroups the tiered order comparisons alone were
+                # ~0.8s of host time
+                job.pod_group.phase = PodGroupPhase.INQUEUE
+                continue
+            any_min_res = True
             queue = ssn.queues[job.queue]
             if queue.name not in queue_set:
                 queue_set.add(queue.name)
                 queues.push(queue)
             jobs_map.setdefault(queue.name, PriorityQueue(less=ssn.job_order_fn)).push(job)
 
-        if not jobs_map:
+        if not any_min_res:
             return
 
         # idle = total × 1.2 − used (enqueue.go:74-81)
@@ -54,14 +64,11 @@ class EnqueueAction(Action):
             if not jobs:
                 continue
             job = jobs.pop()
-            if job.pod_group.min_resources is None:
+            min_req = ssn.spec.empty()
+            for name, v in job.pod_group.min_resources.items():
+                if name in ssn.spec:
+                    min_req.vec[ssn.spec.index(name)] = float(v)
+            if ssn.job_enqueueable(job) and min_req.less_equal(idle):
                 job.pod_group.phase = PodGroupPhase.INQUEUE
-            else:
-                min_req = ssn.spec.empty()
-                for name, v in job.pod_group.min_resources.items():
-                    if name in ssn.spec:
-                        min_req.vec[ssn.spec.index(name)] = float(v)
-                if ssn.job_enqueueable(job) and min_req.less_equal(idle):
-                    job.pod_group.phase = PodGroupPhase.INQUEUE
-                    idle.sub_(min_req)
+                idle.sub_(min_req)
             queues.push(queue)

@@ -84,7 +84,16 @@ def solve_claims(ssn, mode: str):
         victim_drf="drf" in gates,
         weights=ssn.score_weights,
     )
-    result = evict_solve(snap, config)
+    from kube_batch_tpu.parallel.mesh import (
+        default_mesh,
+        sharded_evict_solve,
+        should_shard,
+    )
+
+    if should_shard(snap.node_alloc.shape[0]):
+        result = sharded_evict_solve(snap, config, default_mesh())
+    else:
+        result = evict_solve(snap, config)
     claim_node = np.asarray(result.claim_node)[: meta.n_tasks]
     evicted = np.asarray(result.evicted)[: meta.n_tasks]
     victim_claimant = np.asarray(result.victim_claimant)[: meta.n_tasks]

@@ -42,6 +42,14 @@ MEMORY = "memory"
 PODS = "pods"
 GPU = "nvidia.com/gpu"
 
+# The fixed head of every ResourceSpec axis is (cpu, memory, pods, *scalars);
+# PODS_INDEX is the one capacity-only dimension excluded from semantic
+# comparisons (Less/IsEmpty/Share and every fairness verdict — the
+# reference's Resource has no pods dim, resource_info.go:30-40).  Device-side
+# code (ops/fairness.py) masks the same index; this constant is the single
+# source of truth for that layout fact.
+PODS_INDEX = 2
+
 
 class ResourceSpec:
     """The fixed resource axis of a cluster: (cpu, memory, pods, *scalars).
@@ -70,7 +78,7 @@ class ResourceSpec:
         # reference defines over {cpu, memory, scalars} (Less / IsEmpty /
         # Share), where an always-equal dimension would change the answer.
         self.semantic_mask: np.ndarray = np.ones(len(names), dtype=bool)
-        self.semantic_mask[2] = False
+        self.semantic_mask[PODS_INDEX] = False
         self._mask_addr = self.semantic_mask.ctypes.data
 
     @property
@@ -318,6 +326,18 @@ class Resource:
                 )
             )
         return bool(np.all((self.vec <= other.vec) | (self.vec - other.vec < self.spec.quanta)))
+
+    def less_equal_semantic(self, other: "Resource") -> bool:
+        """LessEqual over the semantic dims only (cpu/mem/scalars) — the
+        reference's Resource has no pods dimension
+        (resource_info.go:252-285), so fairness comparisons (proportion
+        overused/reclaimable) must not let the capacity-only pods dim flip
+        the verdict."""
+        self._check(other)
+        m = self.spec.semantic_mask
+        d = self.vec[m]
+        o = other.vec[m]
+        return bool(np.all((d <= o) | (d - o < self.spec.quanta[m])))
 
     def less_equal_strict(self, other: "Resource") -> bool:
         self._check(other)

@@ -132,7 +132,11 @@ def _queue_gate(
     rq = jnp.where(cs[:, None], resreq[order], 0.0)
     q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
     prefix = _segmented_prefix(rq, q_start)  # [T, R] exclusive, per queue
-    pos_overused = jnp.all(deserved[qs] <= qalloc[qs] + prefix + quanta, axis=-1)
+    # overused over semantic dims only — pods is capacity, not fairness
+    sem = fairness.semantic_mask(R)
+    pos_overused = jnp.all(
+        (deserved[qs] <= qalloc[qs] + prefix + quanta)[..., sem], axis=-1
+    )
     # candidate position within the job (segmented candidate count)
     j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
     ci = cs.astype(jnp.float32)[:, None]

@@ -170,9 +170,12 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
         if config.victim_gang:
             victim_ok &= slack_rem[snap.task_job] > 0
         if config.victim_proportion and not preempt:
-            # victim's full resreq must fit its queue's remaining budget
+            # victim's resreq must fit its queue's remaining budget over the
+            # semantic dims (proportion.go:171-196 LessEqual has no pods)
+            sem = fairness.semantic_mask(R)
             victim_ok &= jnp.all(
-                snap.task_resreq <= qbudget_rem[task_queue] + snap.quanta, axis=-1
+                (snap.task_resreq <= qbudget_rem[task_queue] + snap.quanta)[..., sem],
+                axis=-1,
             )
         if preempt and config.victim_drf:
             # victim-job share after eviction must stay ≥ some preemptor's

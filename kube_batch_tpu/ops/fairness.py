@@ -16,15 +16,31 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_SEM = slice(0, 2)  # cpu, memory — the semantic share dims (helpers.go:28-60)
+# "pods" is a capacity-only dimension this rebuild adds on top of the
+# reference's model (its MaxTaskNum is not part of Resource arithmetic,
+# resource_info.go:30-40) — every fairness comparison masks it out, or an
+# uncontended pod-slot dimension poisons deserved/overused/reclaimable
+# verdicts the reference computes over cpu/mem/scalars only (LessEqual
+# resource_info.go:252-285, Share drf.go:161-171).  PODS_INDEX is the
+# layout's single source of truth (api/resources.py).
+from kube_batch_tpu.api.resources import PODS_INDEX
+
+
+def semantic_mask(R: int) -> np.ndarray:
+    m = np.ones(R, bool)
+    m[PODS_INDEX] = False
+    return m
 
 
 def dominant_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
-    """[., R], [R] → [.] max over semantic dims of alloc/total, 0 where the
-    cluster has none of a resource (drf.go:161-171 via Resource.Share)."""
-    t = total[_SEM]
-    ratios = jnp.where(t > 0, alloc[..., _SEM] / jnp.maximum(t, 1e-9), 0.0)
+    """[., R], [R] → [.] max over semantic dims (cpu/mem/scalars) of
+    alloc/total, 0 where the cluster has none of a resource (drf.go:161-171
+    via Resource.Share — every resource name of total participates)."""
+    m = semantic_mask(total.shape[-1])
+    t = total[m]
+    ratios = jnp.where(t > 0, alloc[..., m] / jnp.maximum(t, 1e-9), 0.0)
     return jnp.max(ratios, axis=-1)
 
 
@@ -80,8 +96,10 @@ def overused(
     quanta: jnp.ndarray,    # [R]
 ) -> jnp.ndarray:
     """[Q] bool — queue's allocation already covers its deserved share
-    (proportion.go:198-209: overused iff deserved ≤ allocated)."""
-    return jnp.all(deserved <= alloc + quanta, axis=-1)
+    (proportion.go:198-209: overused iff deserved ≤ allocated, over the
+    semantic dims — pods is capacity-only)."""
+    m = semantic_mask(quanta.shape[-1])
+    return jnp.all((deserved <= alloc + quanta)[..., m], axis=-1)
 
 
 def queue_share(
@@ -90,6 +108,7 @@ def queue_share(
 ) -> jnp.ndarray:
     """[Q] — proportion's queue order key: dominant allocated/deserved ratio
     (proportion.go:156-169, 265-277); lower share schedules first."""
-    d = deserved[..., _SEM]
-    ratios = jnp.where(d > 0, alloc[..., _SEM] / jnp.maximum(d, 1e-9), 0.0)
+    m = semantic_mask(alloc.shape[-1])
+    d = deserved[..., m]
+    ratios = jnp.where(d > 0, alloc[..., m] / jnp.maximum(d, 1e-9), 0.0)
     return jnp.max(ratios, axis=-1)

@@ -27,8 +27,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kube_batch_tpu.api.snapshot import DeviceSnapshot
 from kube_batch_tpu.ops.assignment import AllocateConfig, AllocateResult, allocate_solve
+from kube_batch_tpu.ops.eviction import EvictConfig, EvictResult, evict_solve
 
 NODE_AXIS = "nodes"
+
+# below this padded node-axis size a single chip wins: the per-round
+# cross-chip argmax reduction costs more than the sharded [T, N] work saves
+SHARD_MIN_NODES = 256
+
+_default_mesh = None
+
+
+def default_mesh() -> Optional[Mesh]:
+    """The production mesh over every visible device — None on single-chip
+    parts.  Cached: the device list is fixed for the process lifetime."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh() if len(jax.devices()) > 1 else False
+    return _default_mesh or None
+
+
+def should_shard(n_nodes_padded: int) -> bool:
+    """The production actions' auto-selection gate: a mesh exists and the
+    node axis is big enough that sharding beats one chip (the reference's
+    16-worker fan-out is always on, scheduler_helper.go:34-64; here the
+    analog turns on with the hardware)."""
+    return n_nodes_padded >= SHARD_MIN_NODES and default_mesh() is not None
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -131,3 +155,31 @@ def sharded_allocate_solve(
 
 def _solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
     return allocate_solve(snap, config)
+
+
+def sharded_evict_solve(
+    snap: DeviceSnapshot, config: EvictConfig, mesh: Mesh
+) -> EvictResult:
+    """The eviction solve (preempt/reclaim) jitted over the mesh: node-axis
+    inputs shard exactly like the allocate solve's; every EvictResult field
+    is task-axis, so outputs replicate."""
+    key = (mesh, config, "evict")
+    fn = _jit_cache.get(key)
+    if fn is None:
+        in_shardings = snapshot_shardings(mesh)
+        repl = NamedSharding(mesh, P())
+        out_shardings = EvictResult(
+            claim_node=repl, evicted=repl, victim_claimant=repl
+        )
+        fn = jax.jit(
+            partial(_evict, config=config),
+            in_shardings=(in_shardings,),
+            out_shardings=out_shardings,
+        )
+        _jit_cache[key] = fn
+    with mesh:
+        return fn(snap)
+
+
+def _evict(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
+    return evict_solve(snap, config)

@@ -134,7 +134,8 @@ class ProportionPlugin(Plugin):
                 if not ee.resreq.less_equal(alloc):
                     continue
                 alloc.sub_(ee.resreq)
-                if attr.deserved.less_equal(alloc):
+                # semantic dims only — pods is capacity, not fairness
+                if attr.deserved.less_equal_semantic(alloc):
                     victims.append(ee)
             return victims
 
@@ -142,7 +143,8 @@ class ProportionPlugin(Plugin):
             attr = self.queue_attrs.get(queue.name)
             if attr is None:
                 return False
-            return attr.deserved.less_equal(attr.allocated)
+            # semantic dims only — pods is capacity, not fairness
+            return attr.deserved.less_equal_semantic(attr.allocated)
 
         def job_enqueueable(job: JobInfo) -> bool:
             """(proportion.go:211-233) capability quota not exceeded."""

@@ -153,6 +153,34 @@ class TestColumnConsistency:
         assert not (cache.columns.t_node == row).any()
         assert_consistent(cache)
 
+    def test_allocate_action_picks_sharded_path(self):
+        """VERDICT r2 #3: on a multi-device part with a big-enough node
+        axis, the production AllocateAction must dispatch the mesh-sharded
+        solve — and produce correct bindings through it."""
+        import jax
+
+        from kube_batch_tpu.framework.interface import get_action
+        from kube_batch_tpu.parallel.mesh import SHARD_MIN_NODES
+
+        if len(jax.devices()) < 2:
+            import pytest
+
+            pytest.skip("needs the multi-device virtual mesh")
+        n_nodes = 200  # node axis pads to 256 == SHARD_MIN_NODES
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node(f"n{i}") for i in range(n_nodes)],
+            pods=[build_pod("c1", f"p{i}", None, PodPhase.PENDING,
+                            {"cpu": 500, "memory": GiB}) for i in range(4)],
+        )
+        sched = Scheduler(cache)
+        sched.run_once()
+        cache.flush_binds()
+        action = get_action("allocate")
+        assert action.last_solve_mode == "sharded", action.last_solve_mode
+        assert len(cache.binder.binds) == 4
+        assert_consistent(cache)
+
     def test_rebuild_from_pod_store(self):
         cache = build_cache(
             queues=["default"],
