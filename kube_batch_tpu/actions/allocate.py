@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import os
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +32,7 @@ from kube_batch_tpu.api.snapshot import build_snapshot
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import FitFailure, JOB_READY
+from kube_batch_tpu import metrics
 from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
 logger = logging.getLogger("kube_batch_tpu")
@@ -57,9 +58,16 @@ class AllocateAction(Action):
         self.last_phase_ms: Dict[str, float] = {}
         # "single" | "sharded" — which solve the last execute() dispatched
         self.last_solve_mode = "single"
+        # fallback pressure of the most recent execute() (VERDICT r2 #6)
+        self.last_fallback: Dict[str, int] = {}
+        self._host_place_count = 0
+        self._ports_by_node: Optional[Dict[int, set]] = None
 
     def execute(self, ssn) -> None:
         self.last_phase_ms = {}
+        self.last_fallback = {}
+        self._host_place_count = 0
+        self._ports_by_node = None
         # session → ClusterInfo view (the session's jobs/nodes/queues ARE the
         # snapshot clone; invalid jobs were already dropped at open). ALL jobs
         # are included so fairness state (queue_alloc/job_allocated) counts
@@ -188,11 +196,73 @@ class AllocateAction(Action):
         pjobs_l = pjobs.tolist()
         pipe_l = pipe_flags.tolist()
         node_l = assigned[placed].tolist()
-        slow_l = job_slow.tolist()
-        committed_l = committed.tolist()
         task_objs = meta.task_objs
         node_names = meta.node_names
         n_groups = len(bounds) - 1
+
+        # ---- promote host-ports-only jobs back to the bulk path --------
+        # A job is "slow" when any task carries host-only constraints, but
+        # the dominant such constraint (hostPorts) is checkable in one batch
+        # pass: a placement conflicts iff its (node, port) is already held
+        # by a resident task or claimed earlier this cycle.  Conflict-free
+        # jobs keep the solve's guarantees and bulk-apply; only conflicted
+        # or affinity-carrying jobs pay the sequential Statement replay
+        # (VERDICT r2 weak #6 — 30% ported tasks degraded the cycle ~5×).
+        promoted_jobs = 0
+        cols0 = ssn.columns
+        if (
+            job_slow.any() and gang_only_ready
+            and not ssn.host_only_predicates and cols0 is not None
+        ):
+            # resident occupancy snapshot, O(ported tasks) once — exact
+            # here because nothing has been applied yet this cycle; the
+            # slow phase later uses the live per-query view instead
+            # (_port_held_nodes) so Statement discards roll claims back
+            occupied = set()
+            t_node_col = cols0.t_node
+            task_by_row = cols0.task_by_row
+            for r in cols0._ported_rows:
+                ni = int(t_node_col[r])
+                if ni < 0:
+                    continue
+                rt = task_by_row[r]
+                if rt is not None:
+                    for p in rt.pod.host_ports:
+                        occupied.add((ni, p))
+            # claims of jobs promoted earlier in this pass — their t_node
+            # rows are only written when the bulk apply runs below
+            for g in range(n_groups):
+                lo, hi = bounds[g], bounds[g + 1]
+                ji = pjobs_l[lo]
+                # uncommitted jobs never apply — promoting them would only
+                # plant phantom port claims that demote real jobs
+                if not job_slow[ji] or not committed[ji]:
+                    continue
+                claims: Optional[set] = set()
+                for i in range(lo, hi):
+                    t = task_objs[placed_l[i]]
+                    if not t.needs_host_predicate:
+                        continue
+                    if t.pod.affinity is not None:
+                        claims = None  # rich constraints → sequential path
+                        break
+                    ni = node_l[i]
+                    for p in t.pod.host_ports:
+                        key = (ni, p)
+                        if key in occupied or key in claims:
+                            claims = None
+                            break
+                        claims.add(key)
+                    if claims is None:
+                        break
+                if claims is None:
+                    continue  # conflict → sequential replay re-decides
+                occupied.update(claims)
+                job_slow[ji] = False
+                promoted_jobs += 1
+
+        slow_l = job_slow.tolist()
+        committed_l = committed.tolist()
 
         # volume pre-check (AllocateVolumes, session.go:252-257): a rejected
         # group demotes to the sequential path BEFORE anything is mutated or
@@ -399,12 +469,21 @@ class AllocateAction(Action):
 
         # slow path after every bulk placement has landed: host predicates
         # observe them; jobs the bulk path demoted replay sequentially too
+        n_slow = 0
         for g in range(n_groups):
             ji = pjobs_l[bounds[g]]
             if slow_l[ji] or ji in demoted_jobs:
+                n_slow += 1
                 self._slow_replay_job(
                     ssn, meta, assigned, pipelined, ji, placed[bounds[g]:bounds[g + 1]]
                 )
+        self.last_fallback = {
+            "slow_jobs": n_slow,
+            "promoted_ports_jobs": promoted_jobs,
+            "host_place_tasks": self._host_place_count,
+        }
+        metrics.register_slow_replay_jobs(n_slow)
+        metrics.register_host_fallback_tasks(self._host_place_count)
 
     # ------------------------------------------------------------------
     def _slow_replay_job(self, ssn, meta, assigned, pipelined, ji, idxs) -> None:
@@ -485,11 +564,122 @@ class AllocateAction(Action):
             fe.set_histogram(counts, n_nodes)
             job.nodes_fit_errors[task.uid] = fe
 
+    def _port_rows(self, cols) -> Dict[int, list]:
+        """Lazily built per-execute: port → [task rows] of EVERY ported task
+        (resident, pending, placed).  Occupancy is derived LIVE from the
+        t_node column at query time — placements, discards, and object-scan
+        fallbacks all flow through the node_name property that keeps t_node
+        current, so there is exactly one source of truth and nothing to roll
+        back."""
+        idx = self._ports_by_node
+        if idx is None:
+            idx = self._ports_by_node = {}
+            for row in cols._ported_rows:
+                t = cols.task_by_row[row]
+                if t is None:
+                    continue
+                for p in t.pod.host_ports:
+                    idx.setdefault(p, []).append(row)
+        return idx
+
+    def _port_held_nodes(self, cols, port: int, exclude_row: int) -> set:
+        """Node rows currently holding `port` (live t_node view)."""
+        rows = self._port_rows(cols).get(port)
+        if not rows:
+            return set()
+        t_node = cols.t_node
+        return {
+            int(t_node[r]) for r in rows
+            if r != exclude_row and t_node[r] >= 0
+        }
+
+    def _host_place_columns(self, ssn, stmt, task) -> Optional[bool]:
+        """Vectorized residual placement over the column matrices for tasks
+        whose only host-side constraint is hostPorts: fit + static predicates
+        + port exclusion as array ops, device-weight scoring, then the same
+        Idle-vs-Releasing decision.  Returns None when the task needs the
+        full object scan (affinity, host-only predicate plugins, no
+        columns)."""
+        cols = ssn.columns
+        if (
+            cols is None
+            or ssn.host_only_predicates
+            or task.pod.affinity is not None
+            or getattr(task, "_row", -1) < 0
+        ):
+            return None
+        req = task.init_resreq.vec
+        quanta = cols.spec.quanta
+        fit_idle = np.all(req <= cols.n_idle + quanta, axis=1)
+        fit_rel = np.all(req <= cols.n_rel + quanta, axis=1)
+        cand = (fit_idle | fit_rel) & cols.n_valid & cols.n_sched
+        row = task._row
+        # selector / taint bitsets (same encoding the device predicate uses)
+        if cols.t_sel_impossible[row]:
+            return False
+        sel = cols.t_sel_bits[row]
+        if sel.any():
+            cand &= ~np.any(sel[None, :] & ~cols.n_label_bits, axis=1)
+        cand &= ~np.any(cols.n_taint_bits & ~cols.t_tol_bits[row][None, :], axis=1)
+        for p in task.pod.host_ports:
+            held = self._port_held_nodes(cols, p, exclude_row=task._row)
+            if held:
+                cand[list(held)] = False
+        if not cand.any():
+            return False
+        # device-weight scoring rows (ops/scoring.py's host twin)
+        w = ssn.score_weights
+        alloc = cols.n_alloc
+        with np.errstate(divide="ignore", invalid="ignore"):
+            used_after = cols.n_used + req
+            frac = np.where(alloc > 0, np.minimum(used_after / np.maximum(alloc, 1e-9), 1.0), 1.0)
+        free_cpu, free_mem = 1.0 - frac[:, 0], 1.0 - frac[:, 1]
+        score = (
+            w.least_requested * (free_cpu + free_mem) * 5.0
+            + w.balanced_resource * (10.0 - np.abs(free_cpu - free_mem) * 10.0)
+            + w.binpack * (frac[:, 0] + frac[:, 1]) * 5.0
+        )
+        score = np.where(cand, score, -np.inf)
+        volume_ok = getattr(ssn.cache.volume_binder, "noop", False)
+        for _ in range(8):  # volume-infeasible nodes retire and we re-pick
+            ni = int(np.argmax(score))
+            if score[ni] == -np.inf:
+                return False
+            name = cols.node_names[ni]
+            if volume_ok or ssn.cache.volume_feasible(task, name):
+                break
+            score[ni] = -np.inf
+        else:
+            return False
+        try:
+            if fit_idle[ni]:
+                stmt.allocate(task, name)
+            else:
+                job = ssn.jobs.get(task.job)
+                node = ssn.nodes.get(name)
+                if job is not None and node is not None:
+                    job.nodes_fit_delta[name] = task.init_resreq.fit_delta(node.idle)
+                stmt.pipeline(task, name)
+        except FitFailure as e:
+            logger.info("columns host placement %s→%s failed: %s",
+                        task.key(), name, e.reason)
+            return False
+        # no port-ledger update needed: the placement just wrote t_node via
+        # the node_name property, which is exactly what _port_held_nodes
+        # reads — discards roll it back the same way
+        return True
+
     def _host_place(self, ssn, stmt, task) -> bool:
         """Sequential placement for a task the device model couldn't encode:
         predicate every node, pick the best-scoring fit — exactly
         allocate.go:151-184 (PredicateNodes → PrioritizeNodes →
-        SelectBestNode → Allocate on Idle / Pipeline on Releasing)."""
+        SelectBestNode → Allocate on Idle / Pipeline on Releasing).  Tasks
+        whose only host constraint is hostPorts take the vectorized column
+        path instead of the O(nodes) object scan (VERDICT r2 weak #6)."""
+        self._host_place_count += 1
+        fast = self._host_place_columns(ssn, stmt, task)
+        if fast is not None:
+            return fast
         best, best_score = None, None
         for node in ssn.nodes.values():
             try:

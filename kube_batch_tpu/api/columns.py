@@ -133,6 +133,7 @@ class ColumnStore:
         self._tol_rows: Set[int] = set()
         self._aff_rows: Set[int] = set()
         self._pref_rows: Set[int] = set()
+        self._ported_rows: Set[int] = set()  # tasks carrying hostPorts
 
         # ---- job axis ---------------------------------------------------
         self.jobs = _Axis()
@@ -225,6 +226,8 @@ class ColumnStore:
                 self._aff_rows.add(row)
             if pod.affinity.has_preferences():
                 self._pref_rows.add(row)
+        if pod.host_ports:
+            self._ported_rows.add(row)
         self.task_by_row[row] = task
         # bind LAST: property setters (status/node_name) skip the store
         # until both row and store are attached.  The job's status counts
@@ -251,6 +254,7 @@ class ColumnStore:
             self.t_tol_bits[row] = 0
         self._aff_rows.discard(row)
         self._pref_rows.discard(row)
+        self._ported_rows.discard(row)
         self.task_by_row[row] = None
         self.tasks.free(row)
 

@@ -126,6 +126,23 @@ UNSCHEDULE_JOB_COUNT = Counter(
     f"{_SUBSYSTEM}_unschedule_job_count",
     "Number of jobs could not be scheduled",
 )
+# metrics.go:113-121 — declared by the reference (never incremented there);
+# here it counts jobs re-entering a cycle still unschedulable
+JOB_RETRY_COUNTS = Counter(
+    f"{_SUBSYSTEM}_job_retry_counts",
+    "Number of retry attempts per job",
+    ("job_id",),
+)
+# fallback-pressure counters (round-3): how much of the allocate replay ran
+# outside the vectorized bulk path
+SLOW_REPLAY_JOBS = Counter(
+    f"{_SUBSYSTEM}_slow_replay_jobs_total",
+    "Jobs replayed through the sequential Statement path",
+)
+HOST_FALLBACK_TASKS = Counter(
+    f"{_SUBSYSTEM}_host_fallback_tasks_total",
+    "Tasks placed by the O(nodes) host fallback scan",
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -137,6 +154,9 @@ METRICS = [
     PREEMPTION_ATTEMPTS,
     UNSCHEDULE_TASK_COUNT,
     UNSCHEDULE_JOB_COUNT,
+    JOB_RETRY_COUNTS,
+    SLOW_REPLAY_JOBS,
+    HOST_FALLBACK_TASKS,
 ]
 
 
@@ -174,6 +194,20 @@ def update_unschedule_task_count(job_id: str, count: int) -> None:
 
 def update_unschedule_job_count(count: int) -> None:
     UNSCHEDULE_JOB_COUNT.set(count)
+
+
+def register_job_retry(job_id: str) -> None:
+    JOB_RETRY_COUNTS.inc(job_id)
+
+
+def register_slow_replay_jobs(count: int) -> None:
+    if count:
+        SLOW_REPLAY_JOBS.add(count)
+
+
+def register_host_fallback_tasks(count: int) -> None:
+    if count:
+        HOST_FALLBACK_TASKS.add(count)
 
 
 def render_prometheus() -> str:

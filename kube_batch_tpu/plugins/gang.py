@@ -93,6 +93,17 @@ class GangPlugin(Plugin):
                 if not job.ready() and job.tasks
             ]
         for job in candidates:
+            # still unschedulable with a prior Unschedulable condition ⇒ a
+            # retry of a previously-failed job (job_retry_counts analog,
+            # metrics.go:113-121 — declared but never written there)
+            if job.pod_group is not None and any(
+                c.type == "Unschedulable" and c.status == "True"
+                and c.transition_id != ssn.uid
+                for c in job.pod_group.conditions
+            ):
+                from kube_batch_tpu import metrics
+
+                metrics.register_job_retry(job.uid)
             fit_errors = [fe.error() for fe in job.nodes_fit_errors.values()]
             message = job.fit_error() + (
                 f"; {fit_errors[0]}" if fit_errors else ""

@@ -239,9 +239,15 @@ def synthetic_cluster(
     gang_size: int = 4,
     n_queues: int = 2,
     seed: int = 0,
+    host_ports_frac: float = 0.0,
 ):
     """Small synthetic cluster through the real cache handlers (full-loop
-    tests). Returns a SchedulerCache with fake binder/evictor."""
+    tests). Returns a SchedulerCache with fake binder/evictor.
+
+    `host_ports_frac` gives that fraction of tasks a hostPort (drawn from a
+    64-port pool) — a host-only constraint that routes their whole job
+    through the allocate replay's slow path (BASELINE config #5's
+    heterogeneous-constraints case)."""
     from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
     from kube_batch_tpu.cache.cache import SchedulerCache
 
@@ -268,6 +274,9 @@ def synthetic_cluster(
                 creation_index=j,
             )
         )
+    ported = (
+        rng.random(n_tasks) < host_ports_frac if host_ports_frac > 0 else None
+    )
     for i in range(n_tasks):
         j = i // gang_size
         cache.add_pod(
@@ -281,6 +290,7 @@ def synthetic_cluster(
                 annotations={GROUP_NAME_ANNOTATION: f"pg{j}"},
                 phase=PodPhase.PENDING,
                 creation_index=i,
+                host_ports=(7000 + int(rng.integers(64)),) if ported is not None and ported[i] else (),
             )
         )
     return cache

@@ -277,6 +277,54 @@ class TestPredicateScenarios:
         assert len(cache.binder.binds) == 2
         assert cache.binder.binds["c1/web-0"] != cache.binder.binds["c1/web-1"]
 
+    def test_hostport_blocked_by_resident(self):
+        """A resident pod's host port blocks the only node — the pending
+        claimant must stay unbound (exercises the port index the vectorized
+        fallback placement consults)."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[
+                build_pod("c1", "resident", "n1", PodPhase.RUNNING,
+                          {"cpu": 500, "memory": GiB}, host_ports=(9090,)),
+                build_pod("c1", "wants", None, PodPhase.PENDING,
+                          {"cpu": 500, "memory": GiB}, host_ports=(9090,)),
+            ],
+        )
+        run_actions(cache)
+        assert "c1/wants" not in cache.binder.binds
+
+    def test_hostport_gangs_promoted_to_bulk(self):
+        """Conflict-free ported gangs take the bulk path (ports promotion);
+        placements stay correct and port-exclusive per node."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name=f"g{j}", namespace="c1", min_member=2,
+                         queue="default") for j in range(4)
+            ],
+            nodes=[build_node(f"n{i}", pods=4) for i in range(8)],
+            pods=[
+                build_pod("c1", f"g{j}-{i}", None, PodPhase.PENDING,
+                          {"cpu": 500, "memory": GiB}, group_name=f"g{j}",
+                          host_ports=(7000 + j,))
+                for j in range(4) for i in range(2)
+            ],
+        )
+        run_actions(cache)
+        assert len(cache.binder.binds) == 8
+        # no two pods sharing a port landed on the same node
+        seen = {}
+        for j in range(4):
+            for i in range(2):
+                node = cache.binder.binds[f"c1/g{j}-{i}"]
+                assert (node, 7000 + j) not in seen
+                seen[(node, 7000 + j)] = True
+        from kube_batch_tpu.framework.interface import get_action
+
+        fb = get_action("allocate").last_fallback
+        assert fb["promoted_ports_jobs"] >= 1, fb
+
     def test_taints_block_untolerated(self):
         """predicates.go e2e:161 Taints/Tolerations."""
         cache = build_cache(
