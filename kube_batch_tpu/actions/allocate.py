@@ -650,7 +650,10 @@ class AllocateAction(Action):
                 break
             score[ni] = -np.inf
         else:
-            return False
+            # more than 8 volume-infeasible picks: defer to the full object
+            # scan, which probes volume feasibility on every node — a 9th
+            # node may fit and must not be missed forever
+            return None
         try:
             if fit_idle[ni]:
                 stmt.allocate(task, name)

@@ -308,6 +308,9 @@ class SchedulerCache:
         ):
             if self.jobs.pop(job.uid, None) is not None:
                 self.columns.free_job(job)
+                from kube_batch_tpu import metrics
+
+                metrics.prune_job_series(job.uid)
             self._status_next_write.pop(job.uid, None)
 
     # ------------------------------------------------------------------

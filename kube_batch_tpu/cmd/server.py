@@ -277,11 +277,29 @@ def run(opt: ServerOption) -> None:
 
     from kube_batch_tpu.cache.volume import StandalonePVBinder
 
+    # with a k8s front end (--master), binds/evictions write back to the
+    # apiserver (pods/binding POST, pod DELETE); standalone deployments keep
+    # the recording fakes behind the ingest API
+    k8s_mode = opt.master.startswith("http")
+    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    if k8s_mode:
+        import os as _os
+
+        from kube_batch_tpu.k8s.bind import K8sBackend
+
+        backend = K8sBackend(
+            opt.master,
+            token_file=f"{sa}/token" if _os.path.exists(f"{sa}/token") else None,
+            ca_file=f"{sa}/ca.crt" if _os.path.exists(f"{sa}/ca.crt") else None,
+        )
+        binder, evictor = backend, backend
+    else:
+        binder, evictor = FakeBinder(), FakeEvictor()
     cache = SchedulerCache(
         scheduler_name=opt.scheduler_name,
         default_queue=opt.default_queue,
-        binder=RateLimitedBackend(FakeBinder(), opt.kube_api_qps, opt.kube_api_burst),
-        evictor=RateLimitedBackend(FakeEvictor(), opt.kube_api_qps, opt.kube_api_burst),
+        binder=RateLimitedBackend(binder, opt.kube_api_qps, opt.kube_api_burst),
+        evictor=RateLimitedBackend(evictor, opt.kube_api_qps, opt.kube_api_burst),
         volume_binder=StandalonePVBinder(),  # real PV ledger behind /v1/persistentvolumes
         resolve_priority=opt.enable_priority_class,
     )
@@ -303,6 +321,26 @@ def run(opt: ServerOption) -> None:
     admin = AdminServer(cache, host, port)
     admin.start()
     logger.info("admin/metrics listening on %s:%d", host, admin.port)
+    # Kubernetes front end (cache.go:256-339 informers): --master pointing
+    # at an apiserver URL starts the list+watch adapter.  start() BLOCKS
+    # until every resource finished its initial LIST and then marks the
+    # cache synced — the reference's unconditional WaitForCacheSync gate
+    # before the first cycle (scheduler.go:64); scheduling against a
+    # half-seeded cache would overstate node idle capacity.
+    watcher = None
+    if k8s_mode:
+        import os as _os
+
+        from kube_batch_tpu.k8s.watch import WatchAdapter
+
+        watcher = WatchAdapter(
+            cache, api_server=opt.master,
+            token_file=f"{sa}/token" if _os.path.exists(f"{sa}/token") else None,
+            ca_file=f"{sa}/ca.crt" if _os.path.exists(f"{sa}/ca.crt") else None,
+        )
+        logger.info("seeding from kubernetes apiserver %s ...", opt.master)
+        watcher.start()
+        logger.info("kubernetes watch adapter synced against %s", opt.master)
     # WaitForCacheSync (scheduler.go:64 / cache.go:363-384): give clients a
     # bounded window to land their initial listing (or POST /v1/sync) before
     # the first cycle; on timeout schedule whatever arrived. Off by default —
@@ -319,4 +357,6 @@ def run(opt: ServerOption) -> None:
         else:
             sched.run_forever()
     finally:
+        if watcher is not None:
+            watcher.stop()
         admin.stop()

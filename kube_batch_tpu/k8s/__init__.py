@@ -1,0 +1,24 @@
+from kube_batch_tpu.k8s.translate import (
+    apply_event,
+    node_from_k8s,
+    parse_quantity,
+    pdb_from_k8s,
+    pod_from_k8s,
+    pod_group_from_k8s,
+    priority_class_from_k8s,
+    queue_from_k8s,
+)
+from kube_batch_tpu.k8s.watch import RESOURCES, WatchAdapter
+
+__all__ = [
+    "apply_event",
+    "node_from_k8s",
+    "parse_quantity",
+    "pdb_from_k8s",
+    "pod_from_k8s",
+    "pod_group_from_k8s",
+    "priority_class_from_k8s",
+    "queue_from_k8s",
+    "RESOURCES",
+    "WatchAdapter",
+]

