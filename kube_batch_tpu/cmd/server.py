@@ -281,17 +281,12 @@ def run(opt: ServerOption) -> None:
     # apiserver (pods/binding POST, pod DELETE); standalone deployments keep
     # the recording fakes behind the ingest API
     k8s_mode = opt.master.startswith("http")
-    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
     if k8s_mode:
-        import os as _os
-
         from kube_batch_tpu.k8s.bind import K8sBackend
+        from kube_batch_tpu.k8s.transport import in_cluster_auth
 
-        backend = K8sBackend(
-            opt.master,
-            token_file=f"{sa}/token" if _os.path.exists(f"{sa}/token") else None,
-            ca_file=f"{sa}/ca.crt" if _os.path.exists(f"{sa}/ca.crt") else None,
-        )
+        auth = in_cluster_auth()
+        backend = K8sBackend(opt.master, **auth)
         binder, evictor = backend, backend
     else:
         binder, evictor = FakeBinder(), FakeEvictor()
@@ -329,15 +324,9 @@ def run(opt: ServerOption) -> None:
     # half-seeded cache would overstate node idle capacity.
     watcher = None
     if k8s_mode:
-        import os as _os
-
         from kube_batch_tpu.k8s.watch import WatchAdapter
 
-        watcher = WatchAdapter(
-            cache, api_server=opt.master,
-            token_file=f"{sa}/token" if _os.path.exists(f"{sa}/token") else None,
-            ca_file=f"{sa}/ca.crt" if _os.path.exists(f"{sa}/ca.crt") else None,
-        )
+        watcher = WatchAdapter(cache, api_server=opt.master, **auth)
         logger.info("seeding from kubernetes apiserver %s ...", opt.master)
         watcher.start()
         logger.info("kubernetes watch adapter synced against %s", opt.master)

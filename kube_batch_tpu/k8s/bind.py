@@ -7,12 +7,11 @@ repair queue exactly like a failed client-go call (cache.go:478-484)."""
 
 from __future__ import annotations
 
-import json
 import logging
-import ssl
 import urllib.error
-import urllib.request
 from typing import Optional
+
+from kube_batch_tpu.k8s.transport import ApiTransport
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -30,40 +29,15 @@ class K8sBackend:
         ca_file: Optional[str] = None,
         insecure: bool = False,
     ):
-        self.api_server = api_server.rstrip("/")
-        self._token = token
-        self._token_file = token_file
-        self._ctx: Optional[ssl.SSLContext] = None
-        if api_server.startswith("https"):
-            self._ctx = ssl.create_default_context(cafile=ca_file)
-            if insecure:
-                self._ctx.check_hostname = False
-                self._ctx.verify_mode = ssl.CERT_NONE
-
-    def _headers(self):
-        tok = self._token
-        if tok is None and self._token_file:
-            with open(self._token_file) as f:
-                tok = f.read().strip()
-        h = {"Content-Type": "application/json"}
-        if tok:
-            h["Authorization"] = f"Bearer {tok}"
-        return h
-
-    def _request(self, method: str, path: str, body: Optional[dict] = None) -> None:
-        req = urllib.request.Request(
-            self.api_server + path,
-            data=json.dumps(body).encode() if body is not None else None,
-            headers=self._headers(),
-            method=method,
+        self.transport = ApiTransport(
+            api_server, token=token, token_file=token_file,
+            ca_file=ca_file, insecure=insecure,
         )
-        with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
-            r.read()
 
     # ---- Binder seam ---------------------------------------------------
     def bind(self, pod, hostname: str) -> None:
         """POST the Binding subresource (the defaultBinder, cache.go:115-126)."""
-        self._request(
+        self.transport.request(
             "POST",
             f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
             {
@@ -79,7 +53,7 @@ class K8sBackend:
     def evict(self, pod) -> None:
         """DELETE the pod (the defaultEvictor, cache.go:128-140)."""
         try:
-            self._request(
+            self.transport.request(
                 "DELETE",
                 f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
             )

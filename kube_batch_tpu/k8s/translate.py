@@ -47,13 +47,18 @@ _SUFFIX = {
 
 def parse_quantity(q) -> float:
     """A Kubernetes resource.Quantity string → float (base units).
-    Handles milli ('100m'), binary ('1Gi') and decimal ('2G') suffixes,
+    Handles sub-unit ('100m', '500u', '50n' — the apiserver canonicalizes
+    sub-milli values to u/n), binary ('1Gi') and decimal ('2G') suffixes,
     plain and exponent forms ('0.5', '1e3')."""
     if isinstance(q, (int, float)):
         return float(q)
     s = str(q).strip()
     if not s:
         return 0.0
+    if s.endswith("n"):
+        return float(s[:-1]) / 1e9
+    if s.endswith("u"):
+        return float(s[:-1]) / 1e6
     if s.endswith("m"):
         return float(s[:-1]) / 1000.0
     for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei"):

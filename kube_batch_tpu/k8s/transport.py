@@ -1,0 +1,87 @@
+"""Shared apiserver transport: bearer-token auth + TLS context + request
+helpers used by both the watch ingest (k8s/watch.py) and the binding
+writeback (k8s/bind.py) — one copy of the in-cluster auth logic."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.request
+from typing import Dict, Optional
+
+logger = logging.getLogger("kube_batch_tpu")
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_auth() -> Dict[str, Optional[str]]:
+    """token_file/ca_file kwargs for the mounted serviceaccount, when present."""
+    token = f"{SERVICEACCOUNT_DIR}/token"
+    ca = f"{SERVICEACCOUNT_DIR}/ca.crt"
+    return {
+        "token_file": token if os.path.exists(token) else None,
+        "ca_file": ca if os.path.exists(ca) else None,
+    }
+
+
+class ApiTransport:
+    def __init__(
+        self,
+        api_server: str,
+        token: Optional[str] = None,
+        token_file: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+    ):
+        self.api_server = api_server.rstrip("/")
+        self._token = token
+        self._token_file = token_file
+        self._ctx: Optional[ssl.SSLContext] = None
+        if api_server.startswith("https"):
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure:
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
+
+    def headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
+        tok = self._token
+        if tok is None and self._token_file:
+            # re-read per request: kubelet rotates projected tokens
+            with open(self._token_file) as f:
+                tok = f.read().strip()
+        h: Dict[str, str] = {}
+        if content_type:
+            h["Content-Type"] = content_type
+        if tok:
+            h["Authorization"] = f"Bearer {tok}"
+        return h
+
+    def get_json(self, path: str, timeout: float = 60):
+        req = urllib.request.Request(
+            self.api_server + path, headers=self.headers()
+        )
+        with urllib.request.urlopen(req, context=self._ctx, timeout=timeout) as r:
+            return json.load(r)
+
+    def stream_lines(self, path: str, timeout: float = 330):
+        """Yield decoded JSON objects from a chunked watch stream."""
+        req = urllib.request.Request(
+            self.api_server + path, headers=self.headers()
+        )
+        with urllib.request.urlopen(req, context=self._ctx, timeout=timeout) as r:
+            for line in r:
+                if line.strip():
+                    yield json.loads(line)
+
+    def request(self, method: str, path: str, body: Optional[dict] = None,
+                timeout: float = 30) -> None:
+        req = urllib.request.Request(
+            self.api_server + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers=self.headers("application/json" if body is not None else None),
+            method=method,
+        )
+        with urllib.request.urlopen(req, context=self._ctx, timeout=timeout) as r:
+            r.read()

@@ -17,14 +17,12 @@ dispatch path.
 
 from __future__ import annotations
 
-import json
 import logging
-import ssl
 import threading
-import urllib.request
 from typing import Callable, Dict, Iterable, Optional, Tuple
 
 from kube_batch_tpu.k8s.translate import apply_event
+from kube_batch_tpu.k8s.transport import ApiTransport
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -54,15 +52,10 @@ class WatchAdapter:
         stream_factory: Optional[Callable] = None,
     ):
         self.cache = cache
-        self.api_server = api_server.rstrip("/")
-        self._token = token
-        self._token_file = token_file
-        self._ctx: Optional[ssl.SSLContext] = None
-        if api_server.startswith("https"):
-            self._ctx = ssl.create_default_context(cafile=ca_file)
-            if insecure:
-                self._ctx.check_hostname = False
-                self._ctx.verify_mode = ssl.CERT_NONE
+        self.transport = ApiTransport(
+            api_server, token=token, token_file=token_file,
+            ca_file=ca_file, insecure=insecure,
+        )
         self.resources = tuple(resources)
         # injectable for tests: kind → iterable of (event_type, object);
         # replaces the LIST+WATCH transport, not the dispatch
@@ -71,28 +64,11 @@ class WatchAdapter:
         self._threads: list = []
 
     # ---- transport ----------------------------------------------------
-    def _headers(self) -> Dict[str, str]:
-        tok = self._token
-        if tok is None and self._token_file:
-            with open(self._token_file) as f:
-                tok = f.read().strip()
-        return {"Authorization": f"Bearer {tok}"} if tok else {}
-
     def _get_json(self, path: str):
-        req = urllib.request.Request(
-            self.api_server + path, headers=self._headers()
-        )
-        with urllib.request.urlopen(req, context=self._ctx, timeout=60) as r:
-            return json.load(r)
+        return self.transport.get_json(path)
 
     def _watch_events(self, path: str):
-        req = urllib.request.Request(
-            self.api_server + path, headers=self._headers()
-        )
-        with urllib.request.urlopen(req, context=self._ctx, timeout=330) as r:
-            for line in r:
-                if line.strip():
-                    yield json.loads(line)
+        return self.transport.stream_lines(path)
 
     # ---- per-resource loop --------------------------------------------
     def _seed(self, kind: str) -> Optional[str]:
@@ -107,7 +83,14 @@ class WatchAdapter:
         listing = self._get_json(RESOURCES[kind])
         items = listing.get("items") or []
         for item in items:
-            apply_event(self.cache, kind, "MODIFIED", item)
+            try:
+                apply_event(self.cache, kind, "MODIFIED", item)
+            except Exception:  # noqa: BLE001 — one bad object must not
+                # poison the whole resource's seed (and so the sync barrier)
+                logger.exception(
+                    "seed: dropping unparseable %s object %s", kind,
+                    (item.get("metadata") or {}).get("name"),
+                )
         self._reconcile_deletions(kind, items)
         return (listing.get("metadata") or {}).get("resourceVersion")
 
@@ -120,29 +103,38 @@ class WatchAdapter:
             }
 
         cache = self.cache
+        # snapshot the key sets under the cache lock: other threads (admin
+        # ingest, resync repair) mutate these dicts concurrently
         if kind == "pods":
             listed = names()
-            for key in [k for k in cache.pods if k not in listed]:
-                apply_event(cache, kind, "DELETED", {
-                    "metadata": {"namespace": key.split("/", 1)[0],
-                                 "name": key.split("/", 1)[1]},
-                })
+            with cache._lock:
+                # the STORED pod objects, not synthetic ones — deletion must
+                # resolve the real job key (group annotation / owner) or the
+                # task leaks in its job and on its node
+                stale = [p for k, p in cache.pods.items() if k not in listed]
+            for pod in stale:
+                cache.delete_pod(pod)
         elif kind == "nodes":
             listed = {(i.get("metadata") or {}).get("name", "") for i in items}
-            for name in [n for n in cache.nodes if n not in listed]:
+            with cache._lock:
+                stale_names = [n for n in cache.nodes if n not in listed]
+            for name in stale_names:
                 cache.delete_node(name)
         elif kind == "queues":
             listed = {(i.get("metadata") or {}).get("name", "") for i in items}
-            for name in [q for q in cache.queues if q not in listed]:
+            with cache._lock:
+                stale_names = [q for q in cache.queues if q not in listed]
+            for name in stale_names:
                 cache.delete_queue(name)
         elif kind == "podgroups":
             listed = names()
-            stale = [
-                uid for uid, job in cache.jobs.items()
-                if job.pod_group is not None and not job.pod_group.shadow
-                and uid not in listed
-            ]
-            for uid in stale:
+            with cache._lock:
+                stale_uids = [
+                    uid for uid, job in cache.jobs.items()
+                    if job.pod_group is not None and not job.pod_group.shadow
+                    and uid not in listed
+                ]
+            for uid in stale_uids:
                 cache.delete_pod_group(uid)
         # priorityclasses/pdbs: stale entries are harmless until their next
         # watch event; deletions reconcile through the objects they affect

@@ -2,10 +2,19 @@
 
 `masked_best_node` fuses the per-round feasibility test + score masking +
 two-key tie-broken argmax (ops/assignment.py round_body's first half) into
-one VMEM pass per task tile: the [T, N] fit matrices are never materialized
-in HBM — req/idle/releasing live in VMEM and the fit predicate is computed
-on the fly per node tile; only the score and static-predicate matrices
-stream in, and three [T]-shaped vectors stream out.
+VMEM-tiled passes: the [T, N] fit matrices are never materialized in HBM —
+req/idle/releasing live in VMEM and the fit predicate is computed on the fly
+per (task, node) tile; only the score and static-predicate matrices stream
+in, and three [T]-shaped vectors stream out.
+
+Round-3 change: the node axis is TILED too (grid (T/TM, N/TN)) with the
+argmax carried across node tiles through revisited output blocks — the
+round-2 kernel put the whole node axis (5 120 wide at the bench shape) in
+one block, and that single-block layout was what pushed the Mosaic compile
+past 10 minutes; with both axes tiled the kernel compiles in seconds at
+50k×5k.  The cross-tile merge is the exact two-key order: strictly greater
+score wins, equal score resolves by the tie hash, equal (score, hash) keeps
+the earlier tile — reproducing jnp.argmax's first-max-index semantics.
 
 The XLA path computes the same values with fused broadcasts; this kernel
 exists to cut the intermediate [T, N] bool traffic on real TPU. It is
@@ -37,19 +46,21 @@ from jax.experimental import pallas as pl
 NEG = -3.0e38
 
 TASK_TILE = 256
+NODE_TILE = 512
 
 
 def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
-            quanta_ref, best_ref, has_ref, chose_idle_ref):
+            quanta_ref, best_ref, val_ref, hash_ref, chose_idle_ref):
     TM = score_ref.shape[0]
-    N = score_ref.shape[1]
+    TN = score_ref.shape[1]
     R = req_ref.shape[1]
+    j = pl.program_id(1)
 
     req = req_ref[:]                      # [TM, R]
     quanta = quanta_ref[:]                # [1, R]
 
     # fit[t, n] = all_r req[t, r] <= budget[n, r] + quanta[r]  (tolerant
-    # LessEqual); R is tiny and static — unrolled, no [TM, N, R] tensor
+    # LessEqual); R is tiny and static — unrolled, no [TM, TN, R] tensor
     def fit_matrix(budget_ref):
         fit = None
         for r in range(R):
@@ -63,31 +74,54 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
     feas = (static_ref[:] > 0.0) & (fit_idle | fit_rel) & pending
     masked = jnp.where(feas, score_ref[:], NEG)
 
-    # two-key argmax: exact max score, then per-(task, node) hash among ties
-    # (ops/assignment._tie_break_hash — same constants, same int32 wrapping
-    # arithmetic)
+    # two-key argmax within this node tile: exact max score, then the
+    # per-(task, node) hash among ties (ops/assignment._tie_break_hash —
+    # same constants, same int32 wrapping arithmetic)
     from kube_batch_tpu.ops.assignment import _H1, _H2, _H3
 
     ti = (
-        jax.lax.broadcasted_iota(jnp.int32, (TM, N), 0)
+        jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 0)
         + pl.program_id(0) * TM
     )
-    ni = jax.lax.broadcasted_iota(jnp.int32, (TM, N), 1)
+    ni = jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1) + j * TN
     h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
     h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
     # Mosaic's argmax lowering is f32-only; the 16 hash bits are exactly
     # representable in f32, so the cast preserves the ordering
     tie_hash = jax.lax.shift_right_logical(h, 16).astype(jnp.float32)
 
-    best_val = jnp.max(masked, axis=1)    # [TM]
-    tie = masked >= best_val[:, None]
-    best = jnp.argmax(jnp.where(tie, tie_hash, -1.0), axis=1).astype(jnp.int32)
-    col = jax.lax.broadcasted_iota(jnp.int32, (TM, N), 1)
-    chose_idle = jnp.any(fit_idle & (col == best[:, None]), axis=1)
+    lval = jnp.max(masked, axis=1)                            # [TM]
+    tie = masked >= lval[:, None]
+    hash_masked = jnp.where(tie, tie_hash, -1.0)
+    lhash = jnp.max(hash_masked, axis=1)                      # [TM]
+    pick = jnp.argmax(hash_masked, axis=1).astype(jnp.int32)  # local col
+    lbest = pick + j * TN
+    col = jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1)
+    lchose = jnp.any(fit_idle & (col == pick[:, None]), axis=1)
+    lval_c = lval[:, None]
+    lhash_c = lhash[:, None]
+    lbest_c = lbest[:, None]
+    lchose_c = jnp.where(lchose, 1.0, 0.0)[:, None]
 
-    best_ref[:] = best[:, None]
-    has_ref[:] = jnp.where(best_val > NEG, 1.0, 0.0)[:, None]
-    chose_idle_ref[:] = jnp.where(chose_idle, 1.0, 0.0)[:, None]
+    # cross-tile merge through the revisited output blocks (the node-tile
+    # grid axis iterates sequentially on TPU): strictly-better (val, hash)
+    # replaces; ties keep the earlier tile = first-max-index semantics
+    @pl.when(j == 0)
+    def _init():
+        best_ref[:] = lbest_c
+        val_ref[:] = lval_c
+        hash_ref[:] = lhash_c
+        chose_idle_ref[:] = lchose_c
+
+    @pl.when(j > 0)
+    def _merge():
+        pval = val_ref[:]
+        phash = hash_ref[:]
+        better = (lval_c > pval) | ((lval_c == pval) & (lhash_c > phash))
+        best_ref[:] = jnp.where(better, lbest_c, best_ref[:])
+        val_ref[:] = jnp.where(better, lval_c, pval)
+        hash_ref[:] = jnp.where(better, lhash_c, phash)
+        chose_idle_ref[:] = jnp.where(better, lchose_c, chose_idle_ref[:])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -102,33 +136,36 @@ def masked_best_node(
     interpret: bool = False,
 ):
     """(best [T] i32, has [T] bool, chose_idle [T] bool) — the fused round
-    head. T must be a multiple of TASK_TILE (snapshot buckets guarantee it
-    at scale; callers pad otherwise)."""
+    head. T must be a multiple of the task tile and N of the node tile
+    (snapshot buckets guarantee both at scale; callers pad otherwise)."""
     T, N = score.shape
     R = task_req.shape[1]
-    tile = min(TASK_TILE, T)
-    grid = (T // tile,)
+    tile_t = min(TASK_TILE, T)
+    tile_n = min(NODE_TILE, N)
+    grid = (T // tile_t, N // tile_n)
     q2 = quanta.reshape(1, R).astype(jnp.float32)
 
-    best, has, chose = pl.pallas_call(
+    best, val, _, chose = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((tile, N), lambda i: (i, 0)),                 # score
-            pl.BlockSpec((tile, N), lambda i: (i, 0)),                 # static_ok
-            pl.BlockSpec((tile, R), lambda i: (i, 0)),                 # req
-            pl.BlockSpec((N, R), lambda i: (0, 0)),                    # idle
-            pl.BlockSpec((N, R), lambda i: (0, 0)),                    # releasing
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),                 # pending
-            pl.BlockSpec((1, R), lambda i: (0, 0)),                    # quanta
+            pl.BlockSpec((tile_t, tile_n), lambda i, j: (i, j)),  # score
+            pl.BlockSpec((tile_t, tile_n), lambda i, j: (i, j)),  # static_ok
+            pl.BlockSpec((tile_t, R), lambda i, j: (i, 0)),       # req
+            pl.BlockSpec((tile_n, R), lambda i, j: (j, 0)),       # idle
+            pl.BlockSpec((tile_n, R), lambda i, j: (j, 0)),       # releasing
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # pending
+            pl.BlockSpec((1, R), lambda i, j: (0, 0)),            # quanta
         ],
         out_specs=[
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # best
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # val
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # hash
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # chose_idle
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, 1), jnp.int32),
+            jax.ShapeDtypeStruct((T, 1), jnp.float32),
             jax.ShapeDtypeStruct((T, 1), jnp.float32),
             jax.ShapeDtypeStruct((T, 1), jnp.float32),
         ],
@@ -142,4 +179,4 @@ def masked_best_node(
         pending.astype(jnp.float32)[:, None],
         q2,
     )
-    return best[:, 0], has[:, 0] > 0.0, chose[:, 0] > 0.0
+    return best[:, 0], val[:, 0] > NEG, chose[:, 0] > 0.0

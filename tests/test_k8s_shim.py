@@ -35,6 +35,8 @@ FIXTURES = json.load(
 class TestQuantityParsing:
     def test_forms(self):
         assert parse_quantity("100m") == 0.1
+        assert parse_quantity("500u") == 5e-4
+        assert parse_quantity("50n") == 5e-8
         assert parse_quantity("2") == 2.0
         assert parse_quantity("1Gi") == 2**30
         assert parse_quantity("500Mi") == 500 * 2**20
@@ -272,6 +274,49 @@ tiers:
         assert cache.nodes["node-a"].used.milli_cpu == 100.0
         errs = cache.columns.check_consistency(cache)
         assert not errs, errs[:3]
+
+    def test_seed_reconcile_deletes_gang_pod(self):
+        """Reconcile-deletion of a pod carrying a group annotation must
+        resolve the REAL job key (via the stored pod object), releasing its
+        gang's task and the node accounting."""
+        cache = _make_cache()
+        adapter = WatchAdapter(cache, api_server="http://unused")
+        member = _gang_pod(0)
+        member["spec"]["nodeName"] = "node-a"
+        member["status"]["phase"] = "Running"
+        adapter.replay([
+            ("queues", "ADDED", FIXTURES["queue"]),
+            ("podgroups", "ADDED", FIXTURES["podgroup"]),
+            ("nodes", "ADDED", FIXTURES["node"]),
+            ("pods", "ADDED", member),
+        ])
+        job = cache.jobs["ml/train-job"]
+        assert "ml/trainer-0" in job.tasks
+        used_before = cache.nodes["node-a"].used.milli_cpu
+        assert used_before > 0
+        # pod vanished during a watch gap → re-list without it
+        adapter._get_json = lambda path: {
+            "items": [], "metadata": {"resourceVersion": "5"}
+        }
+        adapter._seed("pods")
+        assert "ml/trainer-0" not in job.tasks
+        assert cache.nodes["node-a"].used.milli_cpu == 0.0
+        errs = cache.columns.check_consistency(cache)
+        assert not errs, errs[:3]
+
+    def test_seed_isolates_bad_objects(self):
+        """One unparseable object must not poison the seed."""
+        cache = _make_cache()
+        adapter = WatchAdapter(cache, api_server="http://unused")
+        bad = {"metadata": {"name": "bad"}, "spec": {"containers": [
+            {"resources": {"requests": {"cpu": "not-a-quantity"}}}
+        ]}}
+        adapter._get_json = lambda path: {
+            "items": [bad, FIXTURES["pod_bound"]],
+            "metadata": {"resourceVersion": "3"},
+        }
+        adapter._seed("pods")
+        assert "default/web-1" in cache.pods
 
     def test_modify_and_delete_events(self):
         cache = _make_cache()
