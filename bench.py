@@ -128,14 +128,19 @@ def measure(conf, make_cache, cycles):
 
 def main() -> None:
     conf = load_scheduler_conf(None)  # default: allocate, backfill
+    # CPU fallback (wedged tunnel): one trimmed headline pass only — the
+    # committed BENCH_TPU.json capture carries the full matrix; a ~20s/cycle
+    # CPU run of every case would blow the driver's timeout
+    note = os.environ.get("KB_BENCH_BACKEND_NOTE", "")
+    fallback = note == "cpu_fallback"  # only the self-re-exec sets this
+    cycles = 2 if fallback else CYCLES
 
     def make_cache():
         return synthetic_cluster(
             n_tasks=N_TASKS, n_nodes=N_NODES, gang_size=4, n_queues=3
         )
 
-    p50, phase_p50, placed = measure(conf, make_cache, CYCLES)
-    note = os.environ.get("KB_BENCH_BACKEND_NOTE", "")
+    p50, phase_p50, placed = measure(conf, make_cache, cycles)
     metric = (
         f"full_cycle_ms_{N_TASKS // 1000}k_pods_"
         f"{N_NODES // 1000}k_nodes_placed_{placed}"
@@ -153,17 +158,21 @@ def main() -> None:
     # ---- ≥10×-vs-Go-loop target (BASELINE.md): time the faithful
     # sequential re-creation of the reference's allocate loop over the same
     # workload (testing/go_baseline.py) and report the ratio
-    from kube_batch_tpu.testing.go_baseline import run_go_baseline
+    if not fallback:
+        from kube_batch_tpu.testing.go_baseline import run_go_baseline
 
-    go_stats = run_go_baseline(N_TASKS, N_NODES, gang_size=4, n_queues=3)
-    result["go_loop_ms"] = round(go_stats["elapsed_ms"], 1)
-    result["speedup_vs_go_loop"] = round(go_stats["elapsed_ms"] / p50, 1)
+        go_stats = run_go_baseline(N_TASKS, N_NODES, gang_size=4, n_queues=3)
+        result["go_loop_ms"] = round(go_stats["elapsed_ms"], 1)
+        result["speedup_vs_go_loop"] = round(go_stats["elapsed_ms"] / p50, 1)
 
     # ---- the SHIPPED 5-action pipeline (enqueue, reclaim, allocate,
     # backfill, preempt — config/kube-batch-tpu-conf.yaml) at the same
     # 50k×5k scale; podgroups start Pending so enqueue has real work
     from kube_batch_tpu.api.types import PodGroupPhase
 
+    if fallback:
+        _emit(result, tpu_capture_note=True)
+        return
     conf5 = load_scheduler_conf(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "config", "kube-batch-tpu-conf.yaml")
@@ -194,17 +203,21 @@ def main() -> None:
         )
 
     p50_het, _, placed_het = measure(conf, het_cluster, 3)
-    from kube_batch_tpu.framework.interface import get_action
-
     result["het30_ms"] = round(p50_het, 2)
     result["het30_placed"] = placed_het
     result["het30_vs_headline"] = round(p50_het / p50, 2)
     result["het30_fallback"] = get_action("allocate").last_fallback
+    _emit(result, tpu_capture_note=False)
+
+
+def _emit(result: dict, tpu_capture_note: bool) -> None:
+    """Persist a TPU capture (real backend) or cite the last committed one
+    (CPU fallback), then print the single JSON line."""
     tpu_capture_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                     "BENCH_TPU.json")
     import jax
 
-    if not note and jax.default_backend() != "cpu":
+    if not tpu_capture_note and jax.default_backend() != "cpu":
         # durable, timestamped TPU capture — committed to the repo so a
         # wedged-tunnel round still carries driver-checkable TPU evidence
         import datetime
@@ -219,7 +232,7 @@ def main() -> None:
                 json.dump(capture, f, indent=1)
         except OSError:
             pass
-    elif note and os.path.exists(tpu_capture_path):
+    elif tpu_capture_note and os.path.exists(tpu_capture_path):
         # CPU fallback: cite the last committed TPU capture as corroborating
         # evidence next to the live (fallback-labeled) number
         try:
