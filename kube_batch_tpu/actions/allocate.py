@@ -78,6 +78,14 @@ class AllocateAction(Action):
 
         t0 = time.perf_counter()
         cols = ssn.columns
+        if cols is not None and not cols.has_schedulable_pending():
+            # steady-state idle cycle: nothing schedulable anywhere — skip
+            # the snapshot/solve/replay entirely (the reference's loop with
+            # an empty pending set is ~free; ours must be too at a 1 s
+            # schedule period)
+            self.last_phase_ms = {"snapshot_build": 0.0, "solve": 0.0,
+                                  "replay": 0.0}
+            return
         if cols is not None:
             # persistent columnar host model: row space == device axis, no
             # per-object rebuild (api/columns.py)

@@ -69,6 +69,8 @@ def solve_claims(ssn, mode: str):
         return [], None
     cols = ssn.columns
     if cols is not None:
+        if not cols.has_schedulable_pending():
+            return [], None  # no claimants anywhere — idle cycle
         snap, meta = cols.device_snapshot(ssn)
     else:
         snap, meta = build_snapshot(_cluster_view(ssn))

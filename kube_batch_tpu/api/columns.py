@@ -555,6 +555,20 @@ class ColumnStore:
     # ==================================================================
     # per-cycle device snapshot
     # ==================================================================
+    def schedulable_pending_mask(self) -> np.ndarray:
+        """[capT] bool — tasks the allocate/evict solves can act on (Pending,
+        not BestEffort, live row). The single definition behind both the
+        device snapshot's task_pending and the actions' idle-cycle skip —
+        the skip is sound precisely because it is this same mask."""
+        return (
+            (self.t_status == int(TaskStatus.PENDING))
+            & ~self.t_best_effort
+            & self.t_valid
+        )
+
+    def has_schedulable_pending(self) -> bool:
+        return bool(np.any(self.schedulable_pending_mask()))
+
     def refresh_task_bits(self) -> None:
         """Recompute sparse task bitsets after the label/taint universe
         changed (new pair can un-impossible a selector; new taint needs a
@@ -621,11 +635,7 @@ class ColumnStore:
 
         # ---- derived task masks -----------------------------------------
         t_status = self.t_status
-        task_pending = (
-            (t_status == int(TaskStatus.PENDING))
-            & ~self.t_best_effort
-            & self.t_valid
-        )
+        task_pending = self.schedulable_pending_mask()
 
         # ---- sparse affinity / preference rows --------------------------
         aff_live = [r for r in self._aff_rows if self.t_valid[r]]

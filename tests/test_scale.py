@@ -80,6 +80,12 @@ def test_allocate_invariants_at_scale():
     assert placed >= N_TASKS // 2
     close_session(ssn)
 
+    # 4. the persistent column store stayed consistent through a columnar
+    # replay that crossed the 4096 task bucket (axis growth + vectorized
+    # apply + close unwind)
+    errs = cache.columns.check_consistency(cache)
+    assert not errs, errs[:5]
+
 
 @pytest.mark.slow
 def test_overused_queue_gains_nothing_at_scale():
