@@ -181,6 +181,77 @@ class TestColumnConsistency:
         assert len(cache.binder.binds) == 4
         assert_consistent(cache)
 
+    def test_randomized_churn_soak(self):
+        """Seeded soak: many cycles of random adds / deletes / updates /
+        node churn / kubelet transitions, asserting full column/object
+        consistency after every cycle.  The strongest drift guard the
+        columnar model has — any missed choke point shows up here."""
+        import numpy as np
+
+        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+
+        rng = np.random.default_rng(7)
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node(f"n{i}", cpu=8000, mem=16 * GiB, pods=30)
+                   for i in range(6)],
+            pods=[],
+        )
+        sched = Scheduler(cache)
+        next_id = [0]
+
+        def add_gang():
+            g = next_id[0]
+            next_id[0] += 1
+            size = int(rng.integers(1, 4))
+            cache.add_pod_group(PodGroup(
+                name=f"g{g}", namespace="c", min_member=size, queue="default",
+                creation_index=g,
+            ))
+            for i in range(size):
+                cache.add_pod(Pod(
+                    name=f"g{g}-{i}", namespace="c",
+                    requests={"cpu": float(rng.choice([250, 500, 1000])),
+                              "memory": float(GiB)},
+                    annotations={GROUP_NAME_ANNOTATION: f"g{g}"},
+                    creation_index=g * 10 + i,
+                ))
+
+        for cycle in range(25):
+            op = rng.random()
+            if op < 0.5:
+                add_gang()
+            elif op < 0.7 and cache.pods:
+                # kubelet: a bound pod starts running, or a pod dies
+                key = list(cache.pods)[int(rng.integers(len(cache.pods)))]
+                pod = cache.pods[key]
+                if pod.node_name and rng.random() < 0.6:
+                    upd = Pod(
+                        name=pod.name, namespace=pod.namespace, uid=pod.uid,
+                        requests=dict(pod.requests), node_name=pod.node_name,
+                        phase=PodPhase.RUNNING,
+                        annotations=dict(pod.annotations),
+                        creation_index=pod.creation_index,
+                    )
+                    cache.update_pod(upd)
+                else:
+                    cache.delete_pod(pod)
+            elif op < 0.8:
+                # node churn: cordon or delete + re-add
+                name = f"n{int(rng.integers(6))}"
+                if name in cache.nodes and rng.random() < 0.5:
+                    cache.delete_node(name)
+                else:
+                    cache.add_node(build_node(name, cpu=8000, mem=16 * GiB,
+                                              pods=30))
+            # else: idle cycle
+            sched.run_once()
+            cache.flush_binds()
+            errs = cache.columns.check_consistency(cache)
+            assert not errs, (cycle, errs[:5])
+        # the soak actually scheduled things
+        assert len(cache.binder.binds) > 10
+
     def test_rebuild_from_pod_store(self):
         cache = build_cache(
             queues=["default"],
