@@ -71,6 +71,11 @@ def solve_claims(ssn, mode: str):
     if cols is not None:
         if not cols.has_schedulable_pending():
             return [], None  # no claimants anywhere — idle cycle
+        if not cols.has_running_victims():
+            # nothing is running, so the evict solve is vacuous (victims
+            # must be RUNNING on a node) — e.g. every first cycle of a
+            # fresh cluster under the shipped 5-action conf
+            return [], None
         snap, meta = cols.device_snapshot(ssn)
     else:
         snap, meta = build_snapshot(_cluster_view(ssn))

@@ -569,6 +569,16 @@ class ColumnStore:
     def has_schedulable_pending(self) -> bool:
         return bool(np.any(self.schedulable_pending_mask()))
 
+    def has_running_victims(self) -> bool:
+        """True when any live task is RUNNING on a node — the necessary
+        condition for the evict solve to produce a claim (victims must be
+        running, ops/eviction.py's `running` mask)."""
+        return bool(np.any(
+            (self.t_status == int(TaskStatus.RUNNING))
+            & self.t_valid
+            & (self.t_node >= 0)
+        ))
+
     def refresh_task_bits(self) -> None:
         """Recompute sparse task bitsets after the label/taint universe
         changed (new pair can un-impossible a selector; new taint needs a

@@ -186,8 +186,6 @@ class TestColumnConsistency:
         node churn / kubelet transitions, asserting full column/object
         consistency after every cycle.  The strongest drift guard the
         columnar model has — any missed choke point shows up here."""
-        import numpy as np
-
         from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
 
         rng = np.random.default_rng(7)
@@ -237,7 +235,7 @@ class TestColumnConsistency:
                 else:
                     cache.delete_pod(pod)
             elif op < 0.8:
-                # node churn: cordon or delete + re-add
+                # node churn: delete or (re-)add
                 name = f"n{int(rng.integers(6))}"
                 if name in cache.nodes and rng.random() < 0.5:
                     cache.delete_node(name)
