@@ -96,7 +96,9 @@ class AllocateAction(Action):
             cluster.nodes = ssn.nodes
             cluster.queues = ssn.queues
             cluster.jobs = ssn.jobs
-            snap, meta = build_snapshot(cluster)
+            snap, meta = build_snapshot(
+                cluster, excluded_nodes=ssn.session_excluded_nodes
+            )
         t1 = time.perf_counter()
         config = AllocateConfig(
             gang=ssn.plugin_enabled("gang"),
@@ -621,6 +623,9 @@ class AllocateAction(Action):
         fit_idle = np.all(req <= cols.n_idle + quanta, axis=1)
         fit_rel = np.all(req <= cols.n_rel + quanta, axis=1)
         cand = (fit_idle | fit_rel) & cols.n_valid & cols.n_sched
+        excluded_rows = cols.excluded_node_rows(ssn)
+        if excluded_rows:
+            cand[excluded_rows] = False
         row = task._row
         # selector / taint bitsets (same encoding the device predicate uses)
         if cols.t_sel_impossible[row]:

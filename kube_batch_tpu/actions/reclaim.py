@@ -78,7 +78,9 @@ def solve_claims(ssn, mode: str):
             return [], None
         snap, meta = cols.device_snapshot(ssn)
     else:
-        snap, meta = build_snapshot(_cluster_view(ssn))
+        snap, meta = build_snapshot(
+            _cluster_view(ssn), excluded_nodes=ssn.session_excluded_nodes
+        )
     gates = victim_gates(ssn, mode)
     config = EvictConfig(
         mode=mode,

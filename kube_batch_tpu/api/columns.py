@@ -569,6 +569,18 @@ class ColumnStore:
     def has_schedulable_pending(self) -> bool:
         return bool(np.any(self.schedulable_pending_mask()))
 
+    def excluded_node_rows(self, ssn) -> List[int]:
+        """Row indices of the session's excluded nodes (pressure gates) —
+        the single fold every columnar placement path uses, so a new path
+        can't silently miss the exclusion."""
+        if not ssn.session_excluded_nodes:
+            return []
+        rows_get = self.node_rows.get
+        return [
+            r for r in (rows_get(n) for n in ssn.session_excluded_nodes)
+            if r is not None
+        ]
+
     def has_running_victims(self) -> bool:
         """True when any live task is RUNNING on a node — the necessary
         condition for the evict solve to produce a claim (victims must be
@@ -689,6 +701,13 @@ class ColumnStore:
             task_pref_pod = minmax_scale_rows(task_pref_pod)
 
         node_valid = self.n_valid
+        # session-level node exclusions (pressure gates): fold into
+        # node_sched so the device predicate is exact
+        node_sched = self.n_sched
+        excluded_rows = self.excluded_node_rows(ssn)
+        if excluded_rows:
+            node_sched = node_sched.copy()
+            node_sched[excluded_rows] = False
         total = (
             self.n_alloc[node_valid].sum(axis=0).astype(np.float32)
             if node_valid.any() else np.zeros(self.R, np.float32)
@@ -719,7 +738,7 @@ class ColumnStore:
             node_used=self.n_used.astype(np.float32),
             node_alloc=self.n_alloc.astype(np.float32),
             node_valid=node_valid,
-            node_sched=self.n_sched,
+            node_sched=node_sched,
             node_label_bits=self.n_label_bits,
             node_taint_bits=self.n_taint_bits,
             job_min_avail=j_min,

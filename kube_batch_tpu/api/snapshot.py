@@ -161,6 +161,7 @@ def _pack_bits(bit_indices: List[int], words: int) -> np.ndarray:
 def build_snapshot(
     cluster: ClusterInfo,
     pad: bool = True,
+    excluded_nodes=(),
 ) -> Tuple[DeviceSnapshot, SnapshotMeta]:
     """Flatten a host ClusterInfo into the SoA tensor image.
 
@@ -337,7 +338,11 @@ def build_snapshot(
         node_alloc[i] = n.allocatable.vec
         node_valid[i] = n.ready
         if n.node is not None:
-            node_sched[i] = not n.node.unschedulable
+            # session-level exclusions (pressure gates) fold into the
+            # schedulability bit like Unschedulable (predicates.go:233-276)
+            node_sched[i] = (
+                not n.node.unschedulable and n.name not in excluded_nodes
+            )
             node_label_bits[i] = _pack_bits(
                 [label_pair_bit[(k, v)] for k, v in n.node.labels.items()], W
             )

@@ -124,9 +124,14 @@ class Session:
         from kube_batch_tpu.ops.scoring import ScoreWeights
 
         self.score_weights = ScoreWeights()
-        # set by plugins whose predicates the device mask can't encode
-        # (e.g. pressure gates); forces per-placement host re-validation
+        # set by plugins whose predicates the device mask can't encode;
+        # forces per-placement host re-validation for every job
         self.host_only_predicates = False
+        # node names a plugin excludes for this whole session (task-
+        # independent vetoes like the pressure gates) — both snapshot
+        # builders fold these into node_sched, so the device mask stays
+        # exact and the replay stays on the fast path
+        self.session_excluded_nodes: set = set()
         # PodGroup statuses as they stood at open (session.go:102-105), used
         # by the job updater to detect condition-only updates (rate-limited)
         # — essential in exclusive mode, where the session mutates the

@@ -132,9 +132,22 @@ class PredicatesPlugin(Plugin):
         check_mem = self.arguments.get_bool(MEMORY_PRESSURE_KEY, False)
         check_disk = self.arguments.get_bool(DISK_PRESSURE_KEY, False)
         check_pid = self.arguments.get_bool(PID_PRESSURE_KEY, False)
-        # pressure gates aren't in the device mask — when any is enabled the
-        # replay must host-validate every placement, not just flagged tasks
-        ssn.host_only_predicates = check_mem or check_disk or check_pid
+        # pressure gates are task-independent node vetoes
+        # (predicates.go:233-276): encode them as a session-level node
+        # exclusion both snapshot builders fold into node_sched — the device
+        # mask stays exact and no job is demoted to the host replay for them
+        if check_mem or check_disk or check_pid:
+            for node in ssn.nodes.values():
+                obj = node.node
+                if obj is None:
+                    continue
+                conds = obj.conditions
+                if (
+                    (check_mem and conds.get("MemoryPressure"))
+                    or (check_disk and conds.get("DiskPressure"))
+                    or (check_pid and conds.get("PIDPressure"))
+                ):
+                    ssn.session_excluded_nodes.add(node.name)
 
         def predicate(task: TaskInfo, node: NodeInfo) -> None:
             if node.node is None or not node.node.ready:

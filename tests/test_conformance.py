@@ -325,6 +325,40 @@ class TestPredicateScenarios:
         fb = get_action("allocate").last_fallback
         assert fb["promoted_ports_jobs"] >= 1, fb
 
+    def test_memory_pressure_gate_excludes_node(self):
+        """predicates.go:233-276 pressure gates, enabled via plugin args:
+        a MemoryPressure node is excluded and the placement still rides the
+        fast (device) path — no job is demoted to the host replay for it."""
+        from kube_batch_tpu.framework.conf import parse_scheduler_conf
+        from kube_batch_tpu.framework.interface import get_action
+
+        conf = parse_scheduler_conf("""
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: predicates
+    arguments:
+      predicate.MemoryPressureEnable: "true"
+""")
+        cache = build_cache(
+            queues=["default"],
+            nodes=[
+                build_node("pressured", conditions={"MemoryPressure": True}),
+                build_node("healthy"),
+            ],
+            pods=[build_pod("c1", "p0", None, PodPhase.PENDING,
+                            {"cpu": 500, "memory": GiB})],
+        )
+        from kube_batch_tpu.scheduler import Scheduler
+
+        Scheduler(cache, conf=conf).run_once()
+        cache.flush_binds()
+        assert cache.binder.binds == {"c1/p0": "healthy"}
+        fb = get_action("allocate").last_fallback
+        assert fb["slow_jobs"] == 0, fb  # pressure no longer demotes jobs
+        assert not cache.columns.check_consistency(cache)
+
     def test_taints_block_untolerated(self):
         """predicates.go e2e:161 Taints/Tolerations."""
         cache = build_cache(
