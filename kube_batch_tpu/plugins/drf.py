@@ -54,25 +54,19 @@ class DrfPlugin(Plugin):
         return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
-        import numpy as np
-
         self.total = ssn.spec.empty()
         for node in ssn.nodes.values():
             self.total.add_(node.allocatable)
         cols = ssn.columns
         if cols is not None:
             # columnar session: one matrix copy seeds every job's allocated
-            # state; attrs wrap rows zero-copy (per-task deallocate events
-            # from evictions write the same rows the vectorized allocate
-            # updates, so both paths compose)
+            # state; attrs are built LAZILY on first read, wrapping rows
+            # zero-copy — the headline allocate cycle never reads a share
+            # (ordering runs on device), so eagerly building 12.5k attr
+            # objects was pure open-session overhead.  Per-task events from
+            # evictions write the same rows the vectorized allocate updates,
+            # so every path composes.
             self._arr = cols.j_alloc.copy()
-            wrap = ssn.spec.wrap_vec
-            arr = self._arr
-            self.job_attrs = {
-                job.uid: _JobAttr(wrap(arr[job._row]))
-                for job in ssn.jobs.values()
-                if job._row >= 0
-            }
         else:
             for job in ssn.jobs.values():
                 # job.allocated IS the sum of allocated-status task resreqs —
@@ -80,9 +74,23 @@ class DrfPlugin(Plugin):
                 # re-deriving it per task was the session-open hot loop
                 self.job_attrs[job.uid] = _JobAttr(job.allocated.clone())
 
+        wrap = ssn.spec.wrap_vec
+
+        def attr_for(uid: str):
+            """The job's attr, lazily wrapping its _arr row in columnar
+            sessions; None for unknown jobs."""
+            attr = self.job_attrs.get(uid)
+            if attr is None and self._arr is not None:
+                job = ssn.jobs.get(uid)
+                if job is not None and job._row >= 0:
+                    attr = self.job_attrs[uid] = _JobAttr(
+                        wrap(self._arr[job._row])
+                    )
+            return attr
+
         def preemptable(preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
             """(drf.go:85-110)"""
-            lattr = self.job_attrs.get(preemptor.job)
+            lattr = attr_for(preemptor.job)
             if lattr is None:
                 return []
             lalloc = lattr.allocated.add(preemptor.resreq)
@@ -90,7 +98,7 @@ class DrfPlugin(Plugin):
             allocations: Dict[str, Resource] = {}
             victims: List[TaskInfo] = []
             for ee in preemptees:
-                rattr = self.job_attrs.get(ee.job)
+                rattr = attr_for(ee.job)
                 if rattr is None:
                     continue
                 if ee.job not in allocations:
@@ -106,8 +114,8 @@ class DrfPlugin(Plugin):
 
         def job_order(l: JobInfo, r: JobInfo) -> int:
             """(drf.go:114-132) lower dominant share first."""
-            la = self.job_attrs.get(l.uid)
-            ra = self.job_attrs.get(r.uid)
+            la = attr_for(l.uid)
+            ra = attr_for(r.uid)
             ls = self._share(la) if la is not None else 0.0
             rs = self._share(ra) if ra is not None else 0.0
             if ls == rs:
@@ -115,20 +123,20 @@ class DrfPlugin(Plugin):
             return -1 if ls < rs else 1
 
         def on_allocate(event: fw.Event) -> None:
-            attr = self.job_attrs.get(event.task.job)
+            attr = attr_for(event.task.job)
             if attr is not None:
                 attr.allocated.add_(event.task.resreq)
                 attr._dirty = True
 
         def on_deallocate(event: fw.Event) -> None:
-            attr = self.job_attrs.get(event.task.job)
+            attr = attr_for(event.task.job)
             if attr is not None:
                 attr.allocated.sub_(event.task.resreq)
                 attr._dirty = True
 
         def on_batch_allocate(job: JobInfo, tasks, total_resreq) -> None:
             # linear in resreq: one presummed add per job ≡ per-task events
-            attr = self.job_attrs.get(job.uid)
+            attr = attr_for(job.uid)
             if attr is not None:
                 attr.allocated.add_(total_resreq)
                 attr._dirty = True
