@@ -813,7 +813,17 @@ class ColumnStore:
                 seen_rows.add(trow)
                 if int(self.t_status[trow]) != int(t.status):
                     errs.append(f"task {t._key} status col {self.t_status[trow]} != {int(t.status)}")
-                want_node = self.node_rows.get(t.node_name, -1) if t.node_name else -1
+                # t_node means "node row the task is ACCOUNTED on": a task
+                # whose node was deleted and re-added keeps its node_name but
+                # is not resident on the fresh NodeInfo until its next pod
+                # event re-attaches it (the reference's convergence), so the
+                # column is rightly -1 there
+                want_node = -1
+                if t.node_name:
+                    wr = self.node_rows.get(t.node_name)
+                    node_obj = self.node_by_row[wr] if wr is not None else None
+                    if node_obj is not None and t._key in node_obj.tasks:
+                        want_node = wr
                 if int(self.t_node[trow]) != want_node:
                     errs.append(f"task {t._key} node col {self.t_node[trow]} != {want_node}")
                 if self.t_job[trow] != row:

@@ -522,15 +522,16 @@ class SchedulerCache:
         replaces the TaskInfo with a fresh Resource, making the session's sum
         stale) — otherwise the group falls back to accumulation."""
         with self._lock:
-            pods_get = self.pods.get
             if self._session_active:
                 # exclusive (no-clone) session: the replay already applied
                 # job/node accounting on these very objects — only stage the
-                # binder dispatch + Scheduled events
-                self._dispatch_async(
-                    [(t, h, pods_get(t._key)) for t, h in tasks_hosts]
-                )
+                # binder dispatch + Scheduled events.  task.pod IS the stored
+                # pod here (ingest replaces the TaskInfo with the pod, and
+                # deletes are deferred while the session owns the cache), so
+                # the per-task store lookup is skipped
+                self._dispatch_async([(t, h, t.pod) for t, h in tasks_hosts])
                 return
+            pods_get = self.pods.get
             staged = []
             jobs_get = self.jobs.get
             nodes_get = self.nodes.get

@@ -181,6 +181,31 @@ class TestColumnConsistency:
         assert len(cache.binder.binds) == 4
         assert_consistent(cache)
 
+    def test_node_delete_readd_keeps_task_detached_until_pod_event(self):
+        """A re-added node starts with no resident tasks (the reference's
+        convergence: pods re-attach on their next event); t_node stays -1 —
+        'accounted on', not 'named by' — until the pod update re-attaches."""
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1")],
+            pods=[build_pod("c1", "res", "n1", PodPhase.RUNNING,
+                            {"cpu": 500, "memory": GiB})],
+        )
+        task = cache.jobs["c1/res"].tasks["c1/res"]
+        row = task._row
+        cache.delete_node("n1")
+        cache.add_node(build_node("n1"))
+        assert int(cache.columns.t_node[row]) == -1
+        assert "c1/res" not in cache.nodes["n1"].tasks
+        assert_consistent(cache)
+        # the pod's next event re-attaches it (informer resync analog)
+        pod = cache.pods["c1/res"]
+        cache.update_pod(pod)
+        task = cache.jobs["c1/res"].tasks["c1/res"]
+        assert "c1/res" in cache.nodes["n1"].tasks
+        assert int(cache.columns.t_node[task._row]) == cache.columns.node_rows["n1"]
+        assert_consistent(cache)
+
     def test_randomized_churn_soak(self):
         """Seeded soak: many cycles of random adds / deletes / updates /
         node churn / kubelet transitions, asserting full column/object
