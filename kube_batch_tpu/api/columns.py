@@ -817,13 +817,14 @@ class ColumnStore:
                 # whose node was deleted and re-added keeps its node_name but
                 # is not resident on the fresh NodeInfo until its next pod
                 # event re-attaches it (the reference's convergence), so the
-                # column is rightly -1 there
+                # column is rightly -1 there.  The expectation derives from
+                # the OBJECT model (cache.nodes), not the store's own
+                # indexes, so index corruption can't self-validate.
                 want_node = -1
                 if t.node_name:
-                    wr = self.node_rows.get(t.node_name)
-                    node_obj = self.node_by_row[wr] if wr is not None else None
+                    node_obj = cache.nodes.get(t.node_name)
                     if node_obj is not None and t._key in node_obj.tasks:
-                        want_node = wr
+                        want_node = getattr(node_obj, "_row", -1)
                 if int(self.t_node[trow]) != want_node:
                     errs.append(f"task {t._key} node col {self.t_node[trow]} != {want_node}")
                 if self.t_job[trow] != row:

@@ -275,6 +275,41 @@ class TestColumnConsistency:
         # the soak actually scheduled things
         assert len(cache.binder.binds) > 10
 
+    def test_persistence_roundtrip_columns(self):
+        """--state-file save/restore rebuilds a consistent column store and
+        the restored cache schedules."""
+        import os
+        import tempfile
+
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="pg", namespace="c1", min_member=2,
+                                 queue="default")],
+            nodes=[build_node("n1"), build_node("n2")],
+            pods=[
+                build_pod("c1", "bound", "n1", PodPhase.RUNNING,
+                          {"cpu": 500, "memory": GiB}),
+                build_pod("c1", "g-0", None, PodPhase.PENDING,
+                          {"cpu": 500, "memory": GiB}, group_name="pg"),
+                build_pod("c1", "g-1", None, PodPhase.PENDING,
+                          {"cpu": 500, "memory": GiB}, group_name="pg"),
+            ],
+        )
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "state.json")
+            save_state(cache, path)
+            restored = SchedulerCache()
+            load_state(restored, path)
+        assert_consistent(restored)
+        assert restored.nodes["n1"].used.milli_cpu == 500.0
+        Scheduler(restored).run_once()
+        restored.flush_binds()
+        assert set(restored.binder.binds) == {"c1/g-0", "c1/g-1"}
+        assert_consistent(restored)
+
     def test_rebuild_from_pod_store(self):
         cache = build_cache(
             queues=["default"],
