@@ -150,6 +150,12 @@ class AllocateAction(Action):
             "solve": (t2 - t1) * 1e3,
             "replay": (t3 - t2) * 1e3,
         }
+        n_placed = int((assigned >= 0).sum())
+        if n_placed:
+            # amortized per-task latency (metrics.go:66-72 analog)
+            metrics.observe_task_latencies(
+                (t3 - t0) * 1e6 / n_placed, n_placed
+            )
 
     # ------------------------------------------------------------------
     def _replay(self, ssn, snap, meta, assigned, pipelined, task_job) -> None:

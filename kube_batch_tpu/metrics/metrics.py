@@ -30,11 +30,19 @@ class Histogram:
         self._count: Dict[Tuple[str, ...], int] = defaultdict(int)
 
     def observe(self, value: float, *label_values: str) -> None:
+        self.observe_many(value, 1, *label_values)
+
+    def observe_many(self, value: float, count: int, *label_values: str) -> None:
+        """Record `count` samples of `value` in one update — the vectorized
+        cycle's amortized per-task observations (50k individual observe()
+        calls per cycle would be pure lock churn)."""
+        if count <= 0:
+            return
         with self._lock:
             b = self._buckets[label_values]
-            b[bisect.bisect_left(EXP_BUCKETS, value)] += 1
-            self._sum[label_values] += value
-            self._count[label_values] += 1
+            b[bisect.bisect_left(EXP_BUCKETS, value)] += count
+            self._sum[label_values] += value * count
+            self._count[label_values] += count
 
     def render(self) -> str:
         lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
@@ -180,6 +188,13 @@ def observe_plugin_latency(plugin: str, on_session: str, us: float) -> None:
 
 def observe_task_latency(us: float) -> None:
     TASK_LATENCY.observe(us)
+
+
+def observe_task_latencies(us_each: float, count: int) -> None:
+    """Amortized per-task latency for `count` placements of one cycle —
+    the vectorized analog of the reference's per-task observation
+    (metrics.go:66-72, session.go:321)."""
+    TASK_LATENCY.observe_many(us_each, count)
 
 
 def register_schedule_attempt(result: str) -> None:
