@@ -127,6 +127,15 @@ def measure(conf, make_cache, cycles):
 
 
 def main() -> None:
+    start = time.perf_counter()
+    # soft deadline for the optional sections: the headline number and the
+    # TPU capture must land even if compiles run long — better a JSON line
+    # missing pipeline5/het30 than a driver timeout with no line at all
+    deadline_s = float(os.environ.get("KB_BENCH_DEADLINE", "420"))
+
+    def over_deadline() -> bool:
+        return time.perf_counter() - start > deadline_s
+
     conf = load_scheduler_conf(None)  # default: allocate, backfill
     # CPU fallback (wedged tunnel): one trimmed headline pass only — the
     # committed BENCH_TPU.json capture carries the full matrix; a ~20s/cycle
@@ -158,6 +167,10 @@ def main() -> None:
     # ---- ≥10×-vs-Go-loop target (BASELINE.md): time the faithful
     # sequential re-creation of the reference's allocate loop over the same
     # workload (testing/go_baseline.py) and report the ratio
+    if not fallback and over_deadline():
+        result["sections_skipped"] = "go_loop,pipeline5,het30 (deadline)"
+        _emit(result, tpu_capture_note=False)
+        return
     if not fallback:
         from kube_batch_tpu.testing.go_baseline import run_go_baseline
 
@@ -172,6 +185,10 @@ def main() -> None:
 
     if fallback:
         _emit(result, tpu_capture_note=True)
+        return
+    if over_deadline():
+        result["sections_skipped"] = "pipeline5,het30 (deadline)"
+        _emit(result, tpu_capture_note=False)
         return
     conf5 = load_scheduler_conf(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -196,6 +213,11 @@ def main() -> None:
     # ---- heterogeneous-constraints case (BASELINE config #5 / VERDICT r2
     # weak #6): 30% of tasks carry hostPorts, routing their jobs through the
     # fallback machinery — must stay within ~2× the homogeneous cycle
+    if over_deadline():
+        result["sections_skipped"] = "het30 (deadline)"
+        _emit(result, tpu_capture_note=False)
+        return
+
     def het_cluster():
         return synthetic_cluster(
             n_tasks=N_TASKS, n_nodes=N_NODES, gang_size=4, n_queues=3,
@@ -217,7 +239,12 @@ def _emit(result: dict, tpu_capture_note: bool) -> None:
                                     "BENCH_TPU.json")
     import jax
 
-    if not tpu_capture_note and jax.default_backend() != "cpu":
+    if (
+        not tpu_capture_note
+        and "sections_skipped" not in result  # partial runs must not
+        # overwrite the committed full-matrix capture the fallback cites
+        and jax.default_backend() != "cpu"
+    ):
         # durable, timestamped TPU capture — committed to the repo so a
         # wedged-tunnel round still carries driver-checkable TPU evidence
         import datetime
