@@ -210,10 +210,15 @@ class TestPriorityAndFairness:
 
 class TestDistributed:
     def test_initialize_noop_single_process(self):
+        import jax
+
         from kube_batch_tpu.parallel.distributed import global_mesh, initialize
         initialize()  # single-process: must not raise
+        assert jax.process_count() == 1
         mesh = global_mesh()
-        assert mesh.devices.size >= 1
+        # the global mesh spans EVERY visible device (the follower-host
+        # contribution path)
+        assert mesh.devices.size == len(jax.devices())
         assert mesh.axis_names == ("nodes",)
 
 
