@@ -46,7 +46,7 @@ from kube_batch_tpu.api.snapshot import DeviceSnapshot
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.ops import fairness, ordering
 from kube_batch_tpu.ops.assignment import _best_node, _tie_break_hash
-from kube_batch_tpu.ops.feasibility import fits, static_predicates
+from kube_batch_tpu.ops.feasibility import static_predicates
 from kube_batch_tpu.ops.ordering import segmented_prefix
 from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
 
@@ -226,13 +226,27 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
             cap = tot_v[None] - per_qn        # cross-queue victims
 
         # ---- bids ----------------------------------------------------
-        # feasible[t, n] iff claimant t's InitResreq fits cap[queue_t, n]
-        feas = jnp.zeros((T, N), bool)
-        for q in range(Q):  # Q is a small static bucket
-            feas |= (task_queue == q)[:, None] & fits(
-                snap.task_req, cap[q], snap.quanta
-            )
-        feas &= static_ok & claimant_ok[:, None]
+        # feasible[t, n] iff claimant t's InitResreq fits cap[queue_t, n].
+        # Each claimant's queue-specific capacity row is gathered with a
+        # one-hot matmul over the queue axis ([T,Q]@[Q,N] on the MXU, one
+        # per resource dim): compile cost and kernel count stay flat as the
+        # queue bucket grows, unlike the unrolled per-queue fits pass this
+        # replaces (Q=128 would mean 128 full [T,N] passes). The one-hot
+        # contraction selects exactly one row, so it is exact, not a sum.
+        onehot_q = (task_queue[:, None] == jnp.arange(Q)[None, :]).astype(
+            jnp.float32
+        )                                                            # [T, Q]
+        feas = static_ok & claimant_ok[:, None]
+        for r in range(R):  # R is the small static resource dim
+            # HIGHEST precision: TPU default matmul truncates the f32
+            # capacity operand to bf16 (~2^-8 relative), which at byte-unit
+            # memory magnitudes (~1e11) dwarfs the 10 MiB quantum the
+            # epsilon compare below relies on — exact f32 keeps the one-hot
+            # contraction a true row selection
+            cap_tr = jnp.matmul(
+                onehot_q, cap[:, :, r], precision=jax.lax.Precision.HIGHEST
+            )                                                        # [T, N]
+            feas &= snap.task_req[:, r, None] <= cap_tr + snap.quanta[r]
         masked = jnp.where(feas, score, NEG)
         # tie-hash spread: without it every equal-score claimant bids the
         # same argmax node and only one claim lands per round
