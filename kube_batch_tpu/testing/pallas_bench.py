@@ -1,0 +1,122 @@
+"""Pallas round-head vs XLA round-head — the hardware decider (VERDICT r3 #2).
+
+`ops/pallas_kernels.masked_best_node` fuses the auction round's first half
+(fit + mask + two-key argmax) into VMEM tiles; the XLA path computes the same
+values through fused broadcasts (`ops/assignment.round_body`). Both are timed
+here on the SAME inputs at the same shapes the solve uses, so the number
+decides whether the kernel earns its place as the default (flip
+`AllocateConfig.use_pallas`) or gets deleted with the measurement recorded in
+PARITY.md.
+
+Each side is timed as the jitted round-head alone — score/static mask/tie
+hash precomputed outside the timed region, exactly how `allocate_solve`
+hoists them out of the rounds.
+
+Run: python -m kube_batch_tpu.testing.pallas_bench [--tasks 50000] [--nodes 5000]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+
+def compare_roundhead(
+    n_tasks: int = 50_000,
+    n_nodes: int = 5_000,
+    reps: int = 20,
+    seed: int = 0,
+) -> dict:
+    """Time one auction round head (fit + mask + lexicographic argmax +
+    chose-idle gather) via XLA broadcasts vs the fused Pallas kernel.
+
+    Returns p50 step ms, compile seconds, and bit-equality of the outputs
+    (the kernel must match the XLA path exactly — same tie-hash constants,
+    same epsilon fit — or its number is meaningless)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_batch_tpu.ops.assignment import NEG, _best_node, _tie_break_hash
+    from kube_batch_tpu.ops.feasibility import fits, static_predicates
+    from kube_batch_tpu.ops.pallas_kernels import masked_best_node
+    from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
+    from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
+
+    snap_np, _meta = synthetic_device_snapshot(
+        n_tasks=n_tasks, n_nodes=n_nodes, gang_size=4, n_queues=3, seed=seed
+    )
+    snap = jax.device_put(snap_np)
+    on_tpu = jax.default_backend() == "tpu"
+
+    # hoisted round invariants (assignment.py:195-225)
+    static_ok = static_predicates(snap)
+    score = score_matrix(snap, ScoreWeights())
+    score_static = jnp.where(static_ok, score, NEG)
+    T, N = score.shape
+    tie_hash = _tie_break_hash(T, N)
+    pending = snap.task_pending & snap.task_valid
+
+    @jax.jit
+    def xla_head(score_static, tie_hash, task_req, idle, releasing, pending, quanta):
+        fit_idle = fits(task_req, idle, quanta)
+        fit_rel = fits(task_req, releasing, quanta)
+        masked = jnp.where(
+            (fit_idle | fit_rel) & pending[:, None], score_static, NEG
+        )
+        best, has = _best_node(masked, tie_hash)
+        chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
+        return best, has, chose_idle
+
+    xla_args = (score_static, tie_hash, snap.task_req, snap.node_idle,
+                snap.node_releasing, pending, snap.quanta)
+    pallas_args = (score, static_ok, snap.task_req, snap.node_idle,
+                   snap.node_releasing, pending, snap.quanta)
+
+    def timed(fn, args, kwargs=None):
+        kwargs = kwargs or {}
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
+        steps = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args, **kwargs))
+            steps.append((time.perf_counter() - t0) * 1e3)
+        return out, compile_s, statistics.median(steps)
+
+    xla_out, xla_compile_s, xla_ms = timed(xla_head, xla_args)
+    pallas_out, pallas_compile_s, pallas_ms = timed(
+        masked_best_node, pallas_args, {"interpret": not on_tpu}
+    )
+
+    import numpy as np
+
+    match = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(xla_out, pallas_out)
+    )
+    return {
+        "tasks": n_tasks, "nodes": n_nodes, "backend": jax.default_backend(),
+        "xla_ms": round(xla_ms, 3), "pallas_ms": round(pallas_ms, 3),
+        "xla_compile_s": round(xla_compile_s, 1),
+        "pallas_compile_s": round(pallas_compile_s, 1),
+        "outputs_match": match,
+        "pallas_speedup": round(xla_ms / pallas_ms, 2) if pallas_ms else None,
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tasks", type=int, default=50_000)
+    parser.add_argument("--nodes", type=int, default=5_000)
+    parser.add_argument("--reps", type=int, default=20)
+    args = parser.parse_args(argv)
+    print(json.dumps(compare_roundhead(args.tasks, args.nodes, args.reps)))
+
+
+if __name__ == "__main__":
+    main()
