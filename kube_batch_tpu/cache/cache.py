@@ -37,6 +37,87 @@ from kube_batch_tpu.utils.assertions import graft_assert
 logger = logging.getLogger("kube_batch_tpu")
 
 
+class EventLog:
+    """The k8s Events recorder analog: an append-only record of
+    (kind, object_key, message) tuples with BOUNDED retention (the k8s
+    event recorder's queue is bounded too; this is a diagnostic record, not
+    a durable store).
+
+    `append_scheduled_batch` records a whole cycle's Scheduled events by
+    REFERENCE to the dispatcher's staged list and expands them lazily on
+    iteration — building 50k tuples inside the bind drain cost ~30 ms of
+    the close phase for a record nothing reads on the hot path.  Because a
+    batch pins its staged (task, hostname, pod) triples, the retention
+    bound matters doubly: once the log exceeds `max_events`, the oldest
+    entries (and the object graphs a batch holds) are dropped and counted."""
+
+    __slots__ = ("_entries", "_n", "max_events", "dropped")
+
+    def __init__(self, max_events: int = 200_000):
+        from collections import deque
+
+        self._entries = deque()
+        self._n = 0
+        self.max_events = max_events
+        self.dropped = 0
+
+    def _trim(self) -> None:
+        while self._n > self.max_events and len(self._entries) > 1:
+            e = self._entries.popleft()
+            k = len(e) if type(e) is _ScheduledBatch else 1
+            self._n -= k
+            self.dropped += k
+
+    def append(self, ev: tuple) -> None:
+        self._entries.append(ev)
+        self._n += 1
+        self._trim()
+
+    def extend(self, evs) -> None:
+        for ev in evs:
+            self.append(ev)
+
+    def append_scheduled_batch(self, staged) -> None:
+        """staged: [(task, hostname, pod)] — key/hostname are read at
+        iteration time (both immutable once the bind dispatched)."""
+        batch = _ScheduledBatch(staged)
+        self._entries.append(batch)
+        self._n += len(batch)
+        self._trim()
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._n = 0
+
+    def __iter__(self):
+        for e in list(self._entries):
+            if type(e) is _ScheduledBatch:
+                yield from e
+            else:
+                yield e
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return bool(self._entries)
+
+
+class _ScheduledBatch:
+    __slots__ = ("_staged",)
+
+    def __init__(self, staged):
+        self._staged = staged
+
+    def __iter__(self):
+        for task, hostname, pod in self._staged:
+            if pod is not None:
+                yield ("Scheduled", task._key, hostname)
+
+    def __len__(self):
+        return sum(1 for _t, _h, pod in self._staged if pod is not None)
+
+
 class SchedulerCache:
     def __init__(
         self,
@@ -74,7 +155,7 @@ class SchedulerCache:
         # pod store: the standalone source of truth the resync loop re-GETs
         # from (the apiserver analog)
         self.pods: Dict[str, Pod] = {}
-        self.events: List[tuple] = []  # (kind, object_key, message) record
+        self.events = EventLog()  # (kind, object_key, message) record
         # last written PodScheduled condition per pod key (dedup,
         # cache.go:151-173 podConditionHaveUpdate)
         self.pod_conditions: Dict[str, dict] = {}
@@ -85,6 +166,9 @@ class SchedulerCache:
         # the scheduling cycle; failures re-enter via resync_task
         self._dispatch_pool = None
         self._dispatch_futures: List = []
+        # close-time status-writeback pool (jobUpdater's 16 workers,
+        # job_updater.go:18) — created lazily for parallel-safe updaters
+        self._status_pool = None
         # background repair loop (cache.go:342-384) — started by run()
         self._repair_thread: Optional[threading.Thread] = None
         self._repair_stop = threading.Event()
@@ -186,6 +270,9 @@ class SchedulerCache:
         if pool is not None:
             pool.shutdown(wait=True)
         self._dispatch_futures = []
+        spool, self._status_pool = self._status_pool, None
+        if spool is not None:
+            spool.shutdown(wait=True)
 
     # ------------------------------------------------------------------
     # ingest: pods (event_handlers.go:42-200)
@@ -625,10 +712,7 @@ class SchedulerCache:
                     # resync/rebuild and stale client updates now see it
                     for pod, hostname in pairs:
                         pod.node_name = hostname
-                    self.events.extend(
-                        ("Scheduled", task._key, hostname)
-                        for task, hostname, pod in staged if pod is not None
-                    )
+                    self.events.append_scheduled_batch(staged)
                     return
                 except Exception:  # noqa: BLE001 — retry per-task below
                     logger.exception("bind_many failed; retrying per task")
@@ -884,8 +968,12 @@ class SchedulerCache:
         [(job, changed, need_record)]; exclusive sessions mutate the
         authoritative PodGroup in place, so the own_pg copy-back of the
         per-job path is a no-op here and only the rate-limit bookkeeping,
-        the updater call, and event recording remain."""
-        import random
+        the updater call, and event recording remain.
+
+        The rate-limit jitter (60s + U[0,30), job_updater.go:20-31) is drawn
+        as one numpy batch, and network-backed updaters fan the writes over
+        the 16-worker pool the reference's jobUpdater uses
+        (job_updater.go:18,51-53) — each write is an independent REST call."""
         import time as _time
 
         to_write = []
@@ -893,7 +981,8 @@ class SchedulerCache:
         with self._lock:
             now = _time.monotonic()
             next_write = self._status_next_write
-            for job, changed, need_record in updates:
+            jitter = np.random.uniform(60.0, 90.0, size=len(updates)).tolist()
+            for i, (job, changed, need_record) in enumerate(updates):
                 pg = job.pod_group
                 if pg is None or self.jobs.get(job.uid) is None:
                     continue  # deleted mid-cycle: no write, no events
@@ -901,12 +990,38 @@ class SchedulerCache:
                     to_record.append(job)
                 if not changed and now < next_write.get(job.uid, 0.0):
                     continue  # condition-only churn, rate-limited
-                next_write[job.uid] = now + 60.0 + random.uniform(0, 30.0)
+                next_write[job.uid] = now + jitter[i]
                 to_write.append(pg)
-        for pg in to_write:
-            self.status_updater.update_pod_group(pg)
+        updater = self.status_updater
+        if len(to_write) > 16 and getattr(updater, "parallel_safe", False):
+            self._update_pod_groups_pooled(to_write)
+        else:
+            for pg in to_write:
+                updater.update_pod_group(pg)
         for job in to_record:
             self.record_job_status_event(job)
+
+    def _update_pod_groups_pooled(self, pgs) -> None:
+        """16-worker status writeback (the jobUpdater's ParallelizeUntil,
+        job_updater.go:18,51-53). Per-object failures log and continue —
+        the next cycle re-derives and re-writes (convergence by re-running,
+        the reference ignores UpdatePodGroup errors the same way)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._status_pool is None:
+            self._status_pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="kb-status"
+            )
+        update = self.status_updater.update_pod_group
+
+        def write(pg):
+            try:
+                update(pg)
+            except Exception as e:  # noqa: BLE001
+                logger.error("podgroup status write %s/%s failed: %s",
+                             pg.namespace, pg.name, e)
+
+        list(self._status_pool.map(write, pgs))
 
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)
@@ -954,17 +1069,30 @@ class SchedulerCache:
     def session_view(self) -> ClusterInfo:
         """The exclusive (no-clone) session's ClusterInfo: the same
         membership filters as snapshot(), as shallow views over the live
-        objects — caller must hold the exclusive-session gate."""
+        objects — caller must hold the exclusive-session gate.  The
+        membership/priority checks are inlined (vs the shared helpers the
+        cold snapshot() uses): this loop runs over every job every cycle."""
         with self._lock:
             ci = ClusterInfo(self.spec)
             ci.nodes = {
                 name: n for name, n in self.nodes.items() if n.ready
             }
             ci.queues = dict(self.queues)
-            ci.jobs = {}
+            jobs = {}
+            queues = self.queues
+            pcs_get = self.priority_classes.get
+            default_prio = self.default_priority
             for uid, job in self.jobs.items():
-                if not self._job_in_session(uid, job):
+                pg = job.pod_group
+                if pg is None and job.pdb is None:
                     continue
-                job.priority = self._resolve_job_priority(job)
-                ci.jobs[uid] = job
+                if job.queue not in queues:
+                    logger.warning(
+                        "job %s queue %s not found, skipped", uid, job.queue
+                    )
+                    continue
+                pc = pcs_get(pg.priority_class) if pg is not None and pg.priority_class else None
+                job.priority = pc.value if pc is not None else default_prio
+                jobs[uid] = job
+            ci.jobs = jobs
             return ci

@@ -269,6 +269,25 @@ class RateLimitedBackend:
         return self._backend.evict(pod)
 
 
+class RateLimitedStatusUpdater(RateLimitedBackend):
+    """The same token bucket on the StatusUpdater seam (the reference's
+    status writes ride the identical throttled rest.Config client,
+    server.go:69-70).  parallel_safe passes through: the bucket is
+    thread-safe, so the close-time jobUpdater pool may call concurrently."""
+
+    @property
+    def parallel_safe(self):
+        return getattr(self._backend, "parallel_safe", False)
+
+    def update_pod_group(self, pg):
+        self._take()
+        return self._backend.update_pod_group(pg)
+
+    def update_pod_condition(self, pod, cond):
+        self._take()
+        return self._backend.update_pod_condition(pod, cond)
+
+
 def run(opt: ServerOption) -> None:
     """app.Run (server.go:76-151): metrics/admin listener up front, then the
     scheduling loop — behind leader election when enabled. Option validation
@@ -288,13 +307,18 @@ def run(opt: ServerOption) -> None:
         auth = in_cluster_auth()
         backend = K8sBackend(opt.master, **auth)
         binder, evictor = backend, backend
+        status_updater = RateLimitedStatusUpdater(
+            backend, opt.kube_api_qps, opt.kube_api_burst
+        )
     else:
         binder, evictor = FakeBinder(), FakeEvictor()
+        status_updater = None  # cache default: recording fake
     cache = SchedulerCache(
         scheduler_name=opt.scheduler_name,
         default_queue=opt.default_queue,
         binder=RateLimitedBackend(binder, opt.kube_api_qps, opt.kube_api_burst),
         evictor=RateLimitedBackend(evictor, opt.kube_api_qps, opt.kube_api_burst),
+        status_updater=status_updater,
         volume_binder=StandalonePVBinder(),  # real PV ledger behind /v1/persistentvolumes
         resolve_priority=opt.enable_priority_class,
     )

@@ -156,6 +156,57 @@ class Session:
             if pg is not None:
                 at_open[job.uid] = (pg.phase, pg.running, pg.failed,
                                     pg.succeeded)
+        # per-session caches shared by the vectorized open gate / plugin
+        # hooks / close status pass (each used to rebuild the same job-row
+        # arrays and cluster-total Resource independently)
+        self._jobs_rows_cache: Optional[tuple] = None
+        self._total_alloc_cache = None
+        # job uids given an Unschedulable=True condition THIS session —
+        # saves the close pass a per-job scan over conditions lists
+        self.unschedulable_marked: set = set()
+
+    def jobs_rows(self):
+        """(jobs_list, rows[int64], min_avail[int32]) over the CURRENT job
+        set, cached for the session — invalidated when the job set changes
+        size (open-gate deletions, enqueue additions). Columnar sessions
+        only."""
+        import numpy as np
+
+        cached = self._jobs_rows_cache
+        if cached is not None and len(cached[0]) == len(self.jobs):
+            return cached
+        jobs_list = list(self.jobs.values())
+        m = len(jobs_list)
+        rows = np.fromiter((j._row for j in jobs_list), np.int64, count=m)
+        minav = np.fromiter(
+            (j.min_available for j in jobs_list), np.int32, count=m
+        )
+        self._jobs_rows_cache = (jobs_list, rows, minav)
+        return self._jobs_rows_cache
+
+    def total_allocatable(self):
+        """Σ allocatable over the session's nodes (the drf/proportion
+        cluster total, drf.go:57-62 / proportion.go:67-74), computed once
+        per session — vectorized over the node columns when bound, else the
+        object loop."""
+        if self._total_alloc_cache is not None:
+            return self._total_alloc_cache
+        cols = self.columns
+        total = self.spec.empty()
+        # session nodes are exactly the Ready rows (session_view filters on
+        # node.ready, which n_valid mirrors) — checked cheaply; any mismatch
+        # falls back to the authoritative object loop
+        if (
+            cols is not None
+            and len(self.nodes) > 64
+            and int(cols.n_valid.sum()) == len(self.nodes)
+        ):
+            total.vec = cols.n_alloc[cols.n_valid].sum(axis=0)
+        else:
+            for node in self.nodes.values():
+                total.add_(node.allocatable)
+        self._total_alloc_cache = total
+        return total
 
     # ---- registration (session_plugins.go:25-97) ------------------------
     def add_fn(self, kind: str, plugin_name: str, fn: Callable) -> None:
@@ -376,6 +427,12 @@ class Session:
         """Upsert by type (session.go:366-388)."""
         if job.pod_group is None:
             return
+        if (
+            condition.type == "Unschedulable"
+            and condition.status == "True"
+            and condition.transition_id == self.uid
+        ):
+            self.unschedulable_marked.add(job.uid)
         for i, c in enumerate(job.pod_group.conditions):
             if c.type == condition.type:
                 job.pod_group.conditions[i] = condition
@@ -553,16 +610,12 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
 
                 from kube_batch_tpu.api.columns import VALID_STATUSES
 
-                jobs_list = list(ssn.jobs.items())
-                rows = np.fromiter(
-                    (j._row for _, j in jobs_list), np.int64, count=len(jobs_list)
-                )
-                minav = np.fromiter(
-                    (j.min_available for _, j in jobs_list), np.int32,
-                    count=len(jobs_list),
-                )
+                jobs_list, rows, minav = ssn.jobs_rows()
                 valid_num = cols.j_counts[rows][:, VALID_STATUSES].sum(axis=1)
-                gate_jobs = [jobs_list[i] for i in np.flatnonzero(valid_num < minav)]
+                gate_jobs = [
+                    (jobs_list[i].uid, jobs_list[i])
+                    for i in np.flatnonzero(valid_num < minav)
+                ]
         else:
             gate_jobs = list(ssn.jobs.items())
         for uid, job in gate_jobs:
@@ -660,63 +713,73 @@ def _close_status_columnar(ssn: Session) -> None:
     """The close-session status pass driven by the counts matrix: phase
     derivation (job_status) becomes vectorized arithmetic; per-job work is
     paid only by jobs whose status changed or that have something to report.
-    End state equals the per-job loop's."""
-    import numpy as np
+    End state equals the per-job loop's.
 
+    The count columns are pulled into plain Python lists once (numpy scalar
+    indexing inside a 12.5k-job loop costs more than the loop body) and the
+    per-job conditions scan is replaced by the session's unschedulable-mark
+    set (update_job_condition records the uids as it writes the conditions —
+    transition_id == ssn.uid is exactly 'marked this session')."""
     cols = ssn.columns
-    jobs_list = list(ssn.jobs.values())
-    M = len(jobs_list)
-    rows = np.fromiter((j._row for j in jobs_list), np.int64, count=M)
+    jobs_list, rows, minav = ssn.jobs_rows()
     counts = cols.j_counts[rows]
-    running_c = counts[:, int(TaskStatus.RUNNING)]
-    failed_c = counts[:, int(TaskStatus.FAILED)]
-    succ_c = counts[:, int(TaskStatus.SUCCEEDED)]
-    alloc_c = (
+    running_l = counts[:, int(TaskStatus.RUNNING)].tolist()
+    failed_l = counts[:, int(TaskStatus.FAILED)].tolist()
+    succ_l = counts[:, int(TaskStatus.SUCCEEDED)].tolist()
+    pending_l = counts[:, int(TaskStatus.PENDING)].tolist()
+    # phase derives from pg.min_member, NOT job.min_available (minav): a job
+    # carrying both a PodGroup and a PDB has min_available overwritten by
+    # the PDB while job_status (session.go:151-189) still compares against
+    # the PodGroup's MinMember
+    alloc_l = (
         counts[:, int(TaskStatus.BOUND)]
         + counts[:, int(TaskStatus.BINDING)]
         + counts[:, int(TaskStatus.RUNNING)]
         + counts[:, int(TaskStatus.ALLOCATED)]
-    )
+    ).tolist()
     # tasks stuck Pending/Allocated → fit-error conditions must be written
     # (record_job_status_event's has_stuck gate, cache.go:704-719)
-    stuck_c = counts[:, int(TaskStatus.PENDING)] + counts[:, int(TaskStatus.ALLOCATED)]
+    stuck_l = (
+        counts[:, int(TaskStatus.PENDING)] + counts[:, int(TaskStatus.ALLOCATED)]
+    ).tolist()
     prev_map = ssn.pod_group_status_at_open
+    prev_get = prev_map.get
+    unsched_marked = ssn.unschedulable_marked
+    RUNNING, PENDING, UNKNOWN, INQUEUE = (
+        PodGroupPhase.RUNNING, PodGroupPhase.PENDING,
+        PodGroupPhase.UNKNOWN, PodGroupPhase.INQUEUE,
+    )
+    record_event = ssn.cache.record_job_status_event
     updates = []
+    append = updates.append
     for i, job in enumerate(jobs_list):
         pg = job.pod_group
         if pg is None:
-            if job.pdb is not None and counts[i, int(TaskStatus.PENDING)]:
-                ssn.cache.record_job_status_event(job)
+            if job.pdb is not None and pending_l[i]:
+                record_event(job)
             continue
-        r, f, s = int(running_c[i]), int(failed_c[i]), int(succ_c[i])
+        r, f, s = running_l[i], failed_l[i], succ_l[i]
         if pg.shadow:
             # no durable phase for synthesized groups (see job_status) —
             # but changed counts still write, like the per-job path
             pg.running, pg.failed, pg.succeeded = r, f, s
-            changed = prev_map.get(job.uid) != (pg.phase, r, f, s)
-            if changed or stuck_c[i]:
-                updates.append((job, changed, bool(stuck_c[i])))
+            changed = prev_get(job.uid) != (pg.phase, r, f, s)
+            if changed or stuck_l[i]:
+                append((job, changed, bool(stuck_l[i])))
             continue
-        unschedulable = any(
-            c.type == "Unschedulable" and c.status == "True"
-            and c.transition_id == ssn.uid
-            for c in pg.conditions
-        )
-        if r and unschedulable:
-            phase = PodGroupPhase.UNKNOWN
-        elif alloc_c[i] >= pg.min_member:
-            phase = PodGroupPhase.RUNNING
-        elif pg.phase != PodGroupPhase.INQUEUE:
-            phase = PodGroupPhase.PENDING
+        if r and job.uid in unsched_marked:
+            phase = UNKNOWN
+        elif alloc_l[i] >= pg.min_member:
+            phase = RUNNING
+        elif pg.phase != INQUEUE:
+            phase = PENDING
         else:
             phase = pg.phase
         pg.phase, pg.running, pg.failed, pg.succeeded = phase, r, f, s
-        changed = prev_map.get(job.uid) != (phase, r, f, s)
-        need_record = bool(stuck_c[i]) or phase in (
-            PodGroupPhase.PENDING, PodGroupPhase.UNKNOWN
-        )
+        changed = prev_get(job.uid) != (phase, r, f, s)
+        need_record = bool(stuck_l[i]) or phase is PENDING or phase is UNKNOWN
         if changed or need_record or pg.conditions:
-            updates.append((job, changed, need_record))
+            append((job, changed, need_record))
     ssn.cache.update_job_statuses_bulk(updates)
 
 

@@ -61,3 +61,49 @@ class K8sBackend:
             if e.code == 404:
                 return  # already gone — eviction's goal is met
             raise
+
+    # ---- StatusUpdater seam --------------------------------------------
+    # Status writes are independent per object, so the cache's close-time
+    # jobUpdater pool (job_updater.go:18,51-53) may fan them out over
+    # threads; the transport opens a connection per request.
+    parallel_safe = True
+
+    def update_pod_group(self, pg) -> None:
+        """PATCH the PodGroup status subresource (the defaultStatusUpdater's
+        UpdatePodGroup, cache.go:176-187; CRD group per config/crds)."""
+        if getattr(pg, "shadow", False):
+            return  # synthesized for a plain pod — no CRD object exists
+        self.transport.request(
+            "PATCH",
+            "/apis/scheduling.incubator.k8s.io/v1alpha1/namespaces/"
+            f"{pg.namespace}/podgroups/{pg.name}/status",
+            {
+                "status": {
+                    "phase": pg.phase.value if pg.phase is not None else None,
+                    "running": pg.running,
+                    "succeeded": pg.succeeded,
+                    "failed": pg.failed,
+                    "conditions": [
+                        {
+                            "type": c.type,
+                            "status": c.status,
+                            "transitionID": c.transition_id,
+                            "reason": c.reason,
+                            "message": c.message,
+                        }
+                        for c in pg.conditions
+                    ],
+                }
+            },
+            content_type="application/merge-patch+json",
+        )
+
+    def update_pod_condition(self, pod, cond: dict) -> None:
+        """PATCH the pod's PodScheduled condition (taskUnschedulable,
+        cache.go:500-525)."""
+        self.transport.request(
+            "PATCH",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/status",
+            {"status": {"conditions": [cond]}},
+            content_type="application/strategic-merge-patch+json",
+        )

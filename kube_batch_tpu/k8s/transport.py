@@ -76,11 +76,14 @@ class ApiTransport:
                     yield json.loads(line)
 
     def request(self, method: str, path: str, body: Optional[dict] = None,
-                timeout: float = 30) -> None:
+                timeout: float = 30,
+                content_type: Optional[str] = None) -> None:
+        if content_type is None and body is not None:
+            content_type = "application/json"
         req = urllib.request.Request(
             self.api_server + path,
             data=json.dumps(body).encode() if body is not None else None,
-            headers=self.headers("application/json" if body is not None else None),
+            headers=self.headers(content_type),
             method=method,
         )
         with urllib.request.urlopen(req, context=self._ctx, timeout=timeout) as r:

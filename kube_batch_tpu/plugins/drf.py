@@ -54,9 +54,7 @@ class DrfPlugin(Plugin):
         return attr._share
 
     def on_session_open(self, ssn: fw.Session) -> None:
-        self.total = ssn.spec.empty()
-        for node in ssn.nodes.values():
-            self.total.add_(node.allocatable)
+        self.total = ssn.total_allocatable().clone()
         cols = ssn.columns
         if cols is not None:
             # columnar session: one matrix copy seeds every job's allocated

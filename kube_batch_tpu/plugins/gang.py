@@ -75,14 +75,9 @@ class GangPlugin(Plugin):
 
             from kube_batch_tpu.api.columns import READY_STATUSES
 
-            jobs_list = list(ssn.jobs.values())
-            rows = np.fromiter((j._row for j in jobs_list), np.int64,
-                               count=len(jobs_list))
+            jobs_list, rows, minav = ssn.jobs_rows()
             counts = cols.j_counts[rows]
-            ready = counts[:, READY_STATUSES].sum(axis=1) >= np.fromiter(
-                (j.min_available for j in jobs_list), np.int32,
-                count=len(jobs_list),
-            )
+            ready = counts[:, READY_STATUSES].sum(axis=1) >= minav
             has_tasks = counts.sum(axis=1) > 0
             candidates = [
                 jobs_list[i] for i in np.flatnonzero(~ready & has_tasks)

@@ -64,9 +64,7 @@ class ProportionPlugin(Plugin):
 
     def on_session_open(self, ssn: fw.Session) -> None:
         spec = ssn.spec
-        self.total = spec.empty()
-        for node in ssn.nodes.values():
-            self.total.add_(node.allocatable)
+        self.total = ssn.total_allocatable().clone()
         cols = ssn.columns
         if cols is not None:
             # columnar session: segment-sum the job ledger matrices by queue
