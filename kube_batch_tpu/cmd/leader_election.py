@@ -16,6 +16,7 @@ on the contending hosts."""
 from __future__ import annotations
 
 import json
+import logging
 import os
 import socket
 import tempfile
@@ -23,6 +24,8 @@ import threading
 import time
 import uuid
 from typing import Callable, Optional
+
+logger = logging.getLogger("kube_batch_tpu")
 
 LEASE_DURATION = 15.0  # server.go:49
 RENEW_DEADLINE = 10.0  # server.go:50
@@ -44,11 +47,17 @@ class LeaderElector:
         retry_period: float = RETRY_PERIOD,
     ):
         self.lock_path = os.path.join(lock_dir, "kube-batch-tpu-lock")
+        self._init_common(identity, lease_duration, renew_deadline, retry_period)
+
+    def _init_common(self, identity, lease_duration, renew_deadline,
+                     retry_period) -> None:
+        """Identity/timing/stop state shared by every lock flavor."""
         self.identity = identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
         self._stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
 
     # -- lease record ---------------------------------------------------
     def _read(self) -> Optional[dict]:
@@ -139,6 +148,7 @@ class LeaderElector:
                     return
 
         t = threading.Thread(target=renew_loop, daemon=True, name="lease-renew")
+        self._renew_thread = t
         t.start()
         try:
             on_started_leading()
@@ -146,6 +156,15 @@ class LeaderElector:
             self.release()
         if failure:
             raise LostLeadership(f"{self.identity} lost the lease")
+
+    def _join_renew(self) -> None:
+        """Stop and reap the renew thread BEFORE vacating the lock: a renew
+        attempt in flight after the vacate would re-take the lease and delay
+        standby takeover by a full lease_duration."""
+        self._stop.set()
+        t = self._renew_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
     def is_leader(self) -> bool:
         rec = self._read()
@@ -156,10 +175,195 @@ class LeaderElector:
         )
 
     def release(self) -> None:
-        self._stop.set()
+        self._join_renew()
         rec = self._read()
         if rec is not None and rec["holder"] == self.identity:
             try:
                 os.unlink(self.lock_path)
             except OSError:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Kubernetes-native election (--master mode)
+# ---------------------------------------------------------------------------
+
+_LEASE_GROUP = "/apis/coordination.k8s.io/v1"
+
+
+def _rfc3339_micro(ts: float) -> str:
+    import datetime
+
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%f")
+        + "Z"
+    )
+
+
+def _parse_rfc3339(s: Optional[str]) -> float:
+    import datetime
+
+    if not s:
+        return 0.0
+    try:
+        return datetime.datetime.fromisoformat(s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class K8sLeaseElector(LeaderElector):
+    """Leader election through a coordination.k8s.io/v1 Lease — the
+    cross-host resource lock the reference takes through the cluster API
+    (server.go:106-151 uses the older ConfigMap resourcelock; the Lease
+    object is its successor with first-class holder/renew fields). Same
+    15s/10s/5s timings and crash-on-loss contract as the file elector; the
+    apiserver's resourceVersion conflict (409) is the compare-and-swap the
+    file elector approximates with its O_EXCL claim file.
+
+    Like client-go, lease validity compares the apiserver-stored renewTime
+    against the local clock — the file elector's NTP caveat (module
+    docstring) applies unchanged."""
+
+    def __init__(
+        self,
+        transport,
+        namespace: str,
+        name: str = "kube-batch-tpu",
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        # the Lease wire format carries whole seconds (leaseDurationSeconds);
+        # a sub-second duration would serialize as 0 and every contender
+        # would then judge validity by its own local config — dual leader
+        if lease_duration < 1.0:
+            raise ValueError("k8s lease_duration must be >= 1 second")
+        self.transport = transport
+        self.namespace = namespace
+        self.name = name
+        self._init_common(identity, lease_duration, renew_deadline, retry_period)
+
+    @property
+    def _path(self) -> str:
+        return f"{_LEASE_GROUP}/namespaces/{self.namespace}/leases/{self.name}"
+
+    def _get(self) -> Optional[dict]:
+        import urllib.error
+
+        try:
+            return self.transport.get_json(self._path, timeout=10)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def _try_acquire_or_renew(self) -> bool:
+        """One acquire/renew attempt (leaderelection.go tryAcquireOrRenew):
+        create if absent, take over if expired, renew if held — every write
+        CAS-guarded by resourceVersion (a 409 means another contender won
+        the race; report failure and retry next period). Transport errors
+        also report failure: an unreachable apiserver must run the renew
+        deadline down, not crash the standby loop."""
+        import urllib.error
+
+        now = time.time()
+        spec_new = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(round(self.lease_duration)),
+            "renewTime": _rfc3339_micro(now),
+        }
+        try:
+            obj = self._get()
+            if obj is None:
+                spec_new["acquireTime"] = spec_new["renewTime"]
+                spec_new["leaseTransitions"] = 0
+                self.transport.request(
+                    "POST",
+                    f"{_LEASE_GROUP}/namespaces/{self.namespace}/leases",
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": self.name,
+                                     "namespace": self.namespace},
+                        "spec": spec_new,
+                    },
+                    timeout=10,
+                )
+                return True
+            spec = obj.get("spec") or {}
+            holder = spec.get("holderIdentity")
+            duration = float(spec.get("leaseDurationSeconds")
+                             or self.lease_duration)
+            if (
+                holder
+                and holder != self.identity
+                and now - _parse_rfc3339(spec.get("renewTime")) < duration
+            ):
+                return False  # current leader's lease still valid
+            if holder == self.identity:  # renewal
+                spec_new["acquireTime"] = (
+                    spec.get("acquireTime") or spec_new["renewTime"]
+                )
+                spec_new["leaseTransitions"] = int(
+                    spec.get("leaseTransitions") or 0
+                )
+            else:  # takeover of an expired or vacated lease
+                spec_new["acquireTime"] = spec_new["renewTime"]
+                spec_new["leaseTransitions"] = int(
+                    spec.get("leaseTransitions") or 0
+                ) + 1
+            obj["spec"] = spec_new
+            self.transport.request("PUT", self._path, obj, timeout=10)
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return False  # lost the CAS race
+            logger.warning("lease %s write failed: %s", self.name, e)
+            return False
+        except OSError as e:
+            logger.warning("lease %s unreachable: %s", self.name, e)
+            return False
+
+    def is_leader(self) -> bool:
+        try:
+            obj = self._get()
+        except OSError:
+            return False
+        if obj is None:
+            return False
+        spec = obj.get("spec") or {}
+        duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
+        return (
+            spec.get("holderIdentity") == self.identity
+            and time.time() - _parse_rfc3339(spec.get("renewTime")) < duration
+        )
+
+    def release(self) -> None:
+        """Vacate the lease on clean shutdown (client-go ReleaseOnCancel
+        clears holderIdentity) so a standby can take over immediately.
+        The renew thread is reaped FIRST — an in-flight renew landing after
+        the vacate would re-take the lease; its CAS bump also explains the
+        one 409 retry here."""
+        import urllib.error
+
+        self._join_renew()
+        for _ in range(2):
+            try:
+                obj = self._get()
+                if obj is None:
+                    return
+                spec = obj.get("spec") or {}
+                if spec.get("holderIdentity") != self.identity:
+                    return
+                spec["holderIdentity"] = ""
+                obj["spec"] = spec
+                self.transport.request("PUT", self._path, obj, timeout=10)
+                return
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    continue  # lost a CAS race — re-GET and retry once
+                return  # best-effort; the lease simply expires
+            except OSError:
+                return

@@ -362,7 +362,20 @@ def run(opt: ServerOption) -> None:
         cache.wait_for_cache_sync(timeout=opt.cache_sync_timeout)
     try:
         if opt.enable_leader_election:
-            elector = LeaderElector(opt.lock_object_namespace)
+            if k8s_mode:
+                # cross-host HA rides the cluster API: a coordination.k8s.io
+                # Lease in --lock-object-namespace (the reference's ConfigMap
+                # resourcelock, server.go:106-151) — works across nodes with
+                # no shared filesystem
+                from kube_batch_tpu.cmd.leader_election import K8sLeaseElector
+                from kube_batch_tpu.k8s.transport import ApiTransport
+
+                elector = K8sLeaseElector(
+                    ApiTransport(opt.master, **auth),
+                    namespace=opt.lock_object_namespace,
+                )
+            else:
+                elector = LeaderElector(opt.lock_object_namespace)
             # on lease loss the elector stops the loop so run() can raise —
             # the crash-on-loss contract (server.go:145); a supervisor restarts
             # the process as a standby

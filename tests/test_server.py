@@ -264,6 +264,174 @@ class TestLeaderElection:
         assert order == ["a", "b"]  # release → standby takes over
 
 
+class _LeaseStub:
+    """In-memory coordination.k8s.io/v1 Lease apiserver with resourceVersion
+    compare-and-swap — the contract K8sLeaseElector relies on (a stale PUT
+    must 409, exactly like the real apiserver)."""
+
+    def __init__(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        store = self.store = {}
+        lock = threading.Lock()
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj=None):
+                body = _json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _name(self):
+                return self.path.rstrip("/").split("/")[-1]
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return _json.loads(self.rfile.read(n))
+
+            def do_GET(self):
+                with lock:
+                    obj = store.get(self._name())
+                self._send(200, obj) if obj else self._send(404)
+
+            def do_POST(self):
+                obj = self._body()
+                name = (obj.get("metadata") or {}).get("name", "")
+                with lock:
+                    if name in store:
+                        return self._send(409)
+                    obj.setdefault("metadata", {})["resourceVersion"] = "1"
+                    store[name] = obj
+                    stub.writes += 1
+                self._send(201, obj)
+
+            def do_PUT(self):
+                obj = self._body()
+                name = self._name()
+                with lock:
+                    cur = store.get(name)
+                    if cur is None:
+                        return self._send(404)
+                    if (obj.get("metadata") or {}).get("resourceVersion") != (
+                        cur["metadata"]["resourceVersion"]
+                    ):
+                        return self._send(409)
+                    obj["metadata"]["resourceVersion"] = str(
+                        int(cur["metadata"]["resourceVersion"]) + 1
+                    )
+                    store[name] = obj
+                    stub.writes += 1
+                self._send(200, obj)
+
+        self.writes = 0
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def shutdown(self):
+        self.srv.shutdown()
+
+
+class TestK8sLeaseElection:
+    def _elector(self, url, ident, **kw):
+        from kube_batch_tpu.cmd.leader_election import K8sLeaseElector
+        from kube_batch_tpu.k8s.transport import ApiTransport
+
+        # whole seconds: the Lease wire format is leaseDurationSeconds
+        kw.setdefault("lease_duration", 1.0)
+        kw.setdefault("renew_deadline", 0.75)
+        kw.setdefault("retry_period", 0.1)
+        return K8sLeaseElector(
+            ApiTransport(url), namespace="kube-system", identity=ident, **kw
+        )
+
+    def test_single_leader_and_failover(self):
+        """Two electors on different 'hosts' (no shared filesystem — only
+        the apiserver): one leads, the standby blocks while the lease is
+        valid, release hands over (server.go:106-151 semantics)."""
+        stub = _LeaseStub()
+        try:
+            a = self._elector(stub.url, "host-a")
+            b = self._elector(stub.url, "host-b")
+            order = []
+
+            def lead(elector, name, hold):
+                def body():
+                    order.append(name)
+                    time.sleep(hold)
+                elector.run(body)
+
+            ta = threading.Thread(target=lead, args=(a, "host-a", 0.6), daemon=True)
+            ta.start()
+            time.sleep(0.25)
+            assert a.is_leader() and not b.is_leader()
+            tb = threading.Thread(target=lead, args=(b, "host-b", 0.2), daemon=True)
+            tb.start()
+            time.sleep(0.2)
+            assert order == ["host-a"]  # b blocked while a's lease is valid
+            ta.join(4)
+            tb.join(4)
+            assert order == ["host-a", "host-b"]  # release → takeover
+            # the release vacated the lease; b then took it and released
+            spec = stub.store["kube-batch-tpu"]["spec"]
+            assert spec["holderIdentity"] == ""
+            assert spec["leaseTransitions"] >= 1
+        finally:
+            stub.shutdown()
+
+    def test_sub_second_duration_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            self._elector("http://x", "a", lease_duration=0.4)
+
+    def test_expired_lease_takeover_and_cas(self):
+        """A dead leader's expired lease is taken over; a stale
+        resourceVersion write loses the CAS and reports failure, not a
+        split brain."""
+        stub = _LeaseStub()
+        try:
+            a = self._elector(stub.url, "host-a")
+            b = self._elector(stub.url, "host-b")
+            assert a._try_acquire_or_renew()          # a creates the lease
+            assert not b._try_acquire_or_renew()      # valid → b fails
+            time.sleep(1.1)                           # a dies; lease expires
+            assert b._try_acquire_or_renew()          # b takes over
+            assert stub.store["kube-batch-tpu"]["spec"]["holderIdentity"] == "host-b"
+            assert stub.store["kube-batch-tpu"]["spec"]["leaseTransitions"] == 1
+            # CAS: a PUT carrying a stale resourceVersion must 409 → False
+            import urllib.request
+            stale = dict(stub.store["kube-batch-tpu"])
+            stale["metadata"] = dict(stale["metadata"], resourceVersion="0")
+            req = urllib.request.Request(
+                stub.url + "/apis/coordination.k8s.io/v1/namespaces/"
+                "kube-system/leases/kube-batch-tpu",
+                data=__import__("json").dumps(stale).encode(),
+                headers={"Content-Type": "application/json"}, method="PUT",
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("stale PUT must 409")
+            except urllib.error.HTTPError as e:
+                assert e.code == 409
+        finally:
+            stub.shutdown()
+
+    def test_unreachable_apiserver_reports_failure(self):
+        """Transport errors run the renew deadline down instead of raising
+        out of the loop (the standby keeps retrying)."""
+        e = self._elector("http://127.0.0.1:1", "host-x")  # nothing listens
+        assert e._try_acquire_or_renew() is False
+        assert e.is_leader() is False
+
+
 class TestPersistence:
     def test_save_load_round_trip(self, tmp_path):
         """SURVEY.md §5.4: restart = reload durable state; the Inqueue phase
