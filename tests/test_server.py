@@ -219,6 +219,64 @@ class TestQueueCLI:
         finally:
             srv.stop()
 
+    def test_master_mode_round_trip(self, capsys):
+        """--master: create writes the Queue CRD to the cluster (the
+        authoritative store, create.go:47-68), list reads CRDs back
+        (list.go:51-87), and the scheduler ingests the created object
+        through its normal translate path."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        store = {}
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                obj = _json.loads(self.rfile.read(n))
+                store[obj["metadata"]["name"]] = obj
+                body = _json.dumps(obj).encode()
+                self.send_response(201)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                body = _json.dumps({"items": list(store.values())}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            master = f"http://127.0.0.1:{srv.server_address[1]}"
+            # connection flags AFTER the subcommand (the documented form the
+            # shared parent parser exists to support)
+            assert queue_cli.main(["create", "--master", master,
+                                   "--name", "gold", "--weight", "5"]) == 0
+            stored = store["gold"]
+            assert stored["apiVersion"] == "scheduling.incubator.k8s.io/v1alpha1"
+            assert stored["kind"] == "Queue"
+            assert stored["spec"]["weight"] == 5
+            capsys.readouterr()  # drop create's output — list must stand alone
+            assert queue_cli.main(["--master", master, "list"]) == 0
+            out = capsys.readouterr().out
+            row = [ln for ln in out.splitlines() if ln.startswith("gold")]
+            assert row and "5" in row[0].split(), out
+            # the object the CLI wrote is exactly what the scheduler's watch
+            # ingests: apply it through the translate path
+            from kube_batch_tpu.k8s.translate import apply_event
+
+            cache = SchedulerCache()
+            apply_event(cache, "queues", "ADDED", stored)
+            assert cache.queues["gold"].weight == 5
+        finally:
+            srv.shutdown()
+
 
 class TestRateLimiter:
     def test_bind_throttled_to_qps(self):
