@@ -622,11 +622,19 @@ class AllocateAction(Action):
         full object scan (affinity, host-only predicate plugins, no
         columns)."""
         cols = ssn.columns
+        from kube_batch_tpu.framework.session import NODE_ORDER
+
         if (
             cols is None
             or ssn.host_only_predicates
             or task.pod.affinity is not None
             or getattr(task, "_row", -1) < 0
+            # a custom scoring policy (an extension score row or a NODE_ORDER
+            # scorer beyond the built-in nodeorder plugin) isn't encoded in
+            # the vectorized score below — the object scan consults
+            # ssn.node_order, so policy stays consistent with the device solve
+            or ssn.score_weights.extra_rows
+            or set(ssn._fns.get(NODE_ORDER, {})) - {"nodeorder"}
         ):
             return None
         req = task.init_resreq.vec

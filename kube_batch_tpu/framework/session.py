@@ -212,6 +212,19 @@ class Session:
     def add_fn(self, kind: str, plugin_name: str, fn: Callable) -> None:
         self._fns.setdefault(kind, {})[plugin_name] = fn
 
+    def add_score_row(self, name: str, fn: Callable, weight: float = 1.0) -> None:
+        """Register a DEVICE score row: fn(snap: DeviceSnapshot) -> [T, N]
+        f32, summed into the compiled solve's score matrix with `weight` —
+        the NodeOrder/BatchNodeOrder extension surface
+        (session_plugins.go:392-492) at the tensor level.  A plugin whose
+        scoring policy also matters on the host replay paths should
+        additionally register a host scorer via add_fn(NODE_ORDER, ...).
+        Use a module-level fn: the row set is part of the jit cache key, so
+        a fresh lambda per session forces a recompile every cycle."""
+        self.score_weights = self.score_weights._replace(
+            extra_rows=self.score_weights.extra_rows + ((name, fn, weight),)
+        )
+
     def add_event_handler(self, handler: EventHandler) -> None:
         self.event_handlers.append(handler)
 

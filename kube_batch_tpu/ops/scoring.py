@@ -24,13 +24,23 @@ MAX_PRIORITY = 10.0
 
 
 class ScoreWeights(NamedTuple):
-    """Per-row weights (plugin args nodeorder.go:34-43 + binpack)."""
+    """Per-row weights (plugin args nodeorder.go:34-43 + binpack).
+
+    `extra_rows` is the score-row EXTENSION SEAM (the reference's
+    NodeOrderFn/BatchNodeOrderFn registration surface,
+    session_plugins.go:392-492): a tuple of (name, fn, weight) where
+    fn(snap: DeviceSnapshot) -> [T, N] f32 is traced into the compiled
+    solve and summed like the built-in rows.  Register through
+    Session.add_score_row.  ScoreWeights is a static jit argument, so the
+    registered set keys the compile cache — use module-level functions
+    (not per-session lambdas) to reuse compiles across sessions."""
 
     least_requested: float = 1.0
     balanced_resource: float = 1.0
     node_affinity: float = 1.0
     pod_affinity: float = 1.0
     binpack: float = 0.0  # off by default, like the reference snapshot
+    extra_rows: tuple = ()  # ((name, fn, weight), ...)
 
 
 def _semantic(snap: DeviceSnapshot) -> jnp.ndarray:
@@ -109,4 +119,7 @@ def score_matrix(snap: DeviceSnapshot, w: ScoreWeights) -> jnp.ndarray:
         s = s + w.node_affinity * node_affinity_preferred(snap)
     if w.pod_affinity:
         s = s + w.pod_affinity * pod_affinity_preferred(snap)
+    for _name, fn, weight in w.extra_rows:
+        if weight:
+            s = s + weight * fn(snap)
     return s

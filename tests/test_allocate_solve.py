@@ -273,3 +273,72 @@ class TestOuterLoopContinuation:
         assigned = np.asarray(res.assigned)[: meta.n_tasks]
         assert (assigned >= 0).all(), assigned
         assert_no_overcommit(snap, res)
+
+
+def _prefer_last_node_row(snap):
+    """Module-level custom score row (jit-cache friendly): strongly prefer
+    the highest live node index."""
+    import jax.numpy as jnp
+
+    N = snap.node_alloc.shape[0]
+    col = jnp.where(snap.node_valid, jnp.arange(N, dtype=jnp.float32), 0.0)
+    return jnp.broadcast_to(col[None, :], (snap.task_req.shape[0], N)) * 100.0
+
+
+class TestScoreRowExtensionSeam:
+    def test_custom_row_changes_placement(self):
+        """The session_plugins.go:392-492 extension surface at the tensor
+        level: a registered device score row must actually steer the solve."""
+        from kube_batch_tpu.ops.scoring import ScoreWeights
+
+        ci = build_cluster(
+            nodes=[(f"n{i}", 64000, 64 * GiB) for i in range(4)],
+            jobs=[(f"j{i}", "default", 1, [("t", 1000, GiB, 0)])
+                  for i in range(8)],
+        )
+        # baseline: least-requested spreads the 8 tasks across empty nodes
+        snap, meta, base = solve(ci)
+        base_nodes = set(np.asarray(base.assigned)[: meta.n_tasks].tolist())
+        assert len(base_nodes) > 1
+
+        ci2 = build_cluster(
+            nodes=[(f"n{i}", 64000, 64 * GiB) for i in range(4)],
+            jobs=[(f"j{i}", "default", 1, [("t", 1000, GiB, 0)])
+                  for i in range(8)],
+        )
+        snap2, meta2, custom = solve(
+            ci2,
+            weights=ScoreWeights(
+                extra_rows=(("prefer-last", _prefer_last_node_row, 1.0),)
+            ),
+        )
+        assigned = np.asarray(custom.assigned)[: meta2.n_tasks]
+        # the custom row dominates the bounded 0..10 built-ins: every task
+        # lands on the last live node (it has capacity for all 8)
+        last = max(
+            int(i) for i, name in enumerate(meta2.node_names)
+            if name
+        )
+        assert np.all(assigned == last), assigned
+        assert_no_overcommit(snap2, custom)
+
+    def test_session_level_registration(self):
+        """A plugin registering through Session.add_score_row changes real
+        action placement end-to-end."""
+        from kube_batch_tpu import actions as _a  # noqa: F401
+        from kube_batch_tpu import plugins as _p  # noqa: F401
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.framework.interface import get_action
+        from kube_batch_tpu.framework.session import close_session, open_session
+        from kube_batch_tpu.testing.synthetic import synthetic_cluster
+
+        cache = synthetic_cluster(n_tasks=16, n_nodes=4, gang_size=1, n_queues=1)
+        conf = load_scheduler_conf(None)
+        ssn = open_session(cache, conf.tiers)
+        ssn.add_score_row("prefer-last", _prefer_last_node_row, weight=1.0)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        cache.flush_binds()
+        hosts = set(cache.binder.binds.values())
+        # every task funneled onto one node (nodes are big enough)
+        assert hosts == {"n3"}, hosts
