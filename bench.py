@@ -200,6 +200,12 @@ def main() -> None:
             result["speedup_vs_go_loop_native_pooled"] = round(
                 go_stats["native_pooled_ms"] / p50, 2
             )
+        # a diverging C run reports a divergence count INSTEAD of a time —
+        # surface it so the invalid-denominator state is visible in the
+        # artifact rather than reading like a missing toolchain
+        for k in ("native_single_divergence", "native_pooled_divergence"):
+            if k in go_stats:
+                result[f"go_loop_{k}"] = go_stats[k]
 
     # ---- Pallas round-head vs XLA on the real backend (VERDICT r3 #2):
     # the hardware number that decides the kernel's fate

@@ -915,3 +915,75 @@ class TestPDBGang:
         assert cache.binder.binds.get("c1/solo") == "n1"
         # no reservation lingers for the discarded gang
         assert cache.volume_binder.reservations == {}
+
+
+class TestPreemptPhase2Divergence:
+    """Pins the DECLARED divergence from the reference's preempt phase 2
+    (PARITY.md "known divergences" / actions/preempt.py:104-131): the
+    reference runs intra-job rebalancing unconditionally (preempt.go:145-174)
+    and would evict an equal-rank running sibling to pipeline a pending one —
+    zero-gain churn; this rebuild gates phase 2 on a task-order plugin
+    verdict (or, with no voter, on the raw priority extremes) and SKIPS the
+    equal-rank case. These tests pin both sides of the gate so a refactor
+    cannot silently change the behavior."""
+
+    def _cache(self, pending_priority):
+        pods = [
+            build_pod("c1", f"run-{i}", "n1", PodPhase.RUNNING,
+                      {"cpu": 1000, "memory": GiB}, group_name="job",
+                      priority=0)
+            for i in range(2)
+        ] + [
+            build_pod("c1", "pend-0", None, PodPhase.PENDING,
+                      {"cpu": 1000, "memory": GiB}, group_name="job",
+                      priority=pending_priority)
+        ]
+        return build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="job", namespace="c1", min_member=1,
+                                 queue="default")],
+            nodes=[build_node("n1", cpu=2000, mem=16 * GiB)],  # full
+            pods=pods,
+        )
+
+    def test_equal_rank_sibling_not_evicted(self):
+        """The divergent case: the reference would evict a running sibling
+        for the equal-priority pending task; the gate skips phase 2 and
+        nothing happens."""
+        cache = self._cache(pending_priority=0)
+        run_actions(cache, action_names=["preempt"])
+        assert len(cache.evictor.evicts) == 0
+        assert len(cache.binder.binds) == 0
+
+    def test_outranking_pending_task_preempts_sibling(self):
+        """The gate's positive side (matching the reference): a pending task
+        that outranks a running sibling via the priority plugin's task order
+        evicts exactly one sibling and pipelines onto the freed capacity."""
+        cache = self._cache(pending_priority=100)
+        run_actions(cache, action_names=["preempt"])
+        assert len(cache.evictor.evicts) == 1
+        assert next(iter(cache.evictor.evicts)).startswith("c1/run-")
+        # the preemptor pipelines (placed on Releasing capacity) — it binds
+        # only after the eviction completes, so no bind yet this cycle
+        assert len(cache.binder.binds) == 0
+
+    def test_no_task_order_voter_falls_back_to_raw_priority(self):
+        """With the priority plugin disabled (no task-order voter), the gate
+        falls back to comparing raw priority extremes — still skipping the
+        equal-rank case."""
+        conf_no_priority = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: proportion
+  - name: nodeorder
+  - name: predicates
+"""
+        cache = self._cache(pending_priority=0)
+        run_actions(cache, conf_text=conf_no_priority,
+                    action_names=["preempt"])
+        assert len(cache.evictor.evicts) == 0
