@@ -62,6 +62,10 @@ if __name__ == "__main__" and os.environ.get("KB_BENCH_CHILD") != "1":
         sys.exit(subprocess.call([sys.executable, __file__], env=env))
     os.environ["KB_BENCH_CHILD"] = "1"
 
+from kube_batch_tpu.envutil import enable_persistent_compilation_cache  # noqa: E402
+
+enable_persistent_compilation_cache()  # compiles survive across invocations
+
 import numpy as np  # noqa: E402
 
 from kube_batch_tpu import actions as _actions  # noqa: E402,F401 — registers

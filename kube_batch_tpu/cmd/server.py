@@ -292,6 +292,10 @@ def run(opt: ServerOption) -> None:
     """app.Run (server.go:76-151): metrics/admin listener up front, then the
     scheduling loop — behind leader election when enabled. Option validation
     and --version live in cmd/main.py."""
+    from kube_batch_tpu.envutil import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()  # restart re-pays no solve compiles
+
     from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor
 
     from kube_batch_tpu.cache.volume import StandalonePVBinder

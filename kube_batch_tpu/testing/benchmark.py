@@ -220,6 +220,10 @@ def build_cases() -> List[BenchCase]:
 
 
 def main(argv=None) -> None:
+    from kube_batch_tpu.envutil import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--cycles", type=int, default=5)
     parser.add_argument("--quick", action="store_true",
