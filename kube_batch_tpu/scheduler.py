@@ -8,6 +8,7 @@ wait.Until(runOnce, period) analog."""
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import List, Optional
 
@@ -38,6 +39,52 @@ class Scheduler:
         self.schedule_period = schedule_period
         self.on_cycle_end = on_cycle_end  # e.g. state-file save (persistence.py)
         self._stop = False
+        # conf hot-reload (the reference's stated-but-unimplemented design,
+        # doc/design/plugin-conf.md — its code re-reads only at startup,
+        # scheduler.go:70-83): when constructed from a path, the file's
+        # mtime is checked each cycle and a changed, VALID conf swaps in at
+        # the cycle boundary; a broken edit logs and keeps the running conf
+        self._conf_path = conf_path if conf is None else None
+        # NOTE: __init__ loaded the conf above, so this stat runs after the
+        # load — an edit in that window would be lost. Re-stat BEFORE
+        # re-reading in _maybe_reload_conf closes the window for the loop;
+        # here, force one reload check on the first cycle instead.
+        self._conf_mtime: Optional[float] = None
+
+    def _stat_conf(self) -> Optional[float]:
+        if not self._conf_path:
+            return None
+        try:
+            return os.path.getmtime(self._conf_path)
+        except OSError:
+            return None
+
+    def _maybe_reload_conf(self) -> None:
+        if not self._conf_path:
+            return
+        mtime = self._stat_conf()
+        if mtime is None or mtime == self._conf_mtime:
+            return
+        try:
+            conf = load_scheduler_conf(self._conf_path)
+            # resolve EVERYTHING the conf names before swapping: an unknown
+            # action or plugin must reject the edit here, not crash every
+            # subsequent open_session
+            actions = [get_action(n) for n in conf.actions]
+            from kube_batch_tpu.framework.interface import get_plugin_builder
+
+            for tier in conf.tiers:
+                for opt in tier.plugins:
+                    get_plugin_builder(opt.name)
+        except Exception as e:  # noqa: BLE001 — keep the running conf
+            logger.error("scheduler conf reload failed (%s); keeping the "
+                         "running configuration", e)
+            self._conf_mtime = mtime  # don't re-log every cycle
+            return
+        if conf.actions != self.conf.actions or conf.tiers != self.conf.tiers:
+            logger.info("scheduler conf hot-reloaded: actions=%s", conf.actions)
+        self.conf, self.actions = conf, actions
+        self._conf_mtime = mtime
 
     def run_once(self) -> None:
         """(scheduler.go:88-102)"""
@@ -49,6 +96,7 @@ class Scheduler:
         resync = getattr(self.cache, "process_resync_tasks", None)
         if resync is not None:
             resync()
+        self._maybe_reload_conf()
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
         try:

@@ -81,3 +81,71 @@ class TestArguments:
     def test_get_float(self):
         args = Arguments({"w": "1.5"})
         assert args.get_float("w", 1.0) == 1.5
+
+
+class TestConfHotReload:
+    """The reference's stated-but-unimplemented hot-reload design
+    (doc/design/plugin-conf.md; its code re-reads only at startup,
+    scheduler.go:70-83): a changed, valid conf swaps in at the cycle
+    boundary; a broken edit keeps the running configuration."""
+
+    def _write(self, path, actions):
+        path.write_text(f'actions: "{actions}"\ntiers:\n- plugins:\n  - name: gang\n')
+
+    def test_valid_edit_swaps_in(self, tmp_path):
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.scheduler import Scheduler
+        import os
+        import time
+
+        conf = tmp_path / "conf.yaml"
+        self._write(conf, "allocate")
+        sched = Scheduler(SchedulerCache(), conf_path=str(conf))
+        assert sched.conf.actions == ["allocate"]
+        sched.run_once()
+        self._write(conf, "allocate, backfill")
+        os.utime(conf, (time.time() + 2, time.time() + 2))  # force mtime step
+        sched.run_once()
+        assert sched.conf.actions == ["allocate", "backfill"]
+
+    def test_broken_edit_keeps_running_conf(self, tmp_path):
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.scheduler import Scheduler
+        import os
+        import time
+
+        conf = tmp_path / "conf.yaml"
+        self._write(conf, "allocate")
+        sched = Scheduler(SchedulerCache(), conf_path=str(conf))
+        sched.run_once()
+        conf.write_text('actions: "no-such-action"\n')
+        os.utime(conf, (time.time() + 2, time.time() + 2))
+        sched.run_once()  # must not raise
+        assert sched.conf.actions == ["allocate"]
+
+    def test_explicit_conf_object_never_reloads(self, tmp_path):
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.scheduler import Scheduler
+
+        sched = Scheduler(SchedulerCache(), conf=load_scheduler_conf(None))
+        assert sched._conf_path is None
+        sched.run_once()  # no file to watch; no-op reload path
+
+    def test_unknown_plugin_edit_keeps_running_conf(self, tmp_path):
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        from kube_batch_tpu.scheduler import Scheduler
+        import os
+        import time
+
+        conf = tmp_path / "conf.yaml"
+        self._write(conf, "allocate")
+        sched = Scheduler(SchedulerCache(), conf_path=str(conf))
+        sched.run_once()
+        # valid actions, typo'd plugin: must be rejected at reload time, not
+        # crash every later open_session
+        conf.write_text('actions: "allocate"\ntiers:\n- plugins:\n  - name: gangg\n')
+        os.utime(conf, (time.time() + 2, time.time() + 2))
+        sched.run_once()
+        assert sched.conf.tiers[0].plugins[0].name == "gang"
+        sched.run_once()  # still scheduling with the running conf
