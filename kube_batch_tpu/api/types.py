@@ -81,6 +81,13 @@ class PodGroupPhase(str, enum.Enum):
     INQUEUE = "Inqueue"
 
 
+def queue_phase_counts() -> dict:
+    """A zeroed QueueStatus phase-count dict (types.go:195-204), keys
+    derived from the enum — the single source for the close-pass
+    accumulators, the writeback's zero record, and the admin API."""
+    return {p.value.lower(): 0 for p in PodGroupPhase}
+
+
 class PodGroupConditionType(str, enum.Enum):
     """(types.go:45-52)"""
 

@@ -49,12 +49,16 @@ class FakeStatusUpdater:
     def __init__(self):
         self.pod_conditions: List[tuple] = []
         self.pod_groups: List[object] = []
+        self.queue_statuses: dict = {}  # queue name → last written counts
 
     def update_pod_condition(self, pod, condition) -> None:
         self.pod_conditions.append((f"{pod.namespace}/{pod.name}", condition))
 
     def update_pod_group(self, pod_group) -> None:
         self.pod_groups.append(pod_group)
+
+    def update_queue_status(self, name: str, counts: dict) -> None:
+        self.queue_statuses[name] = dict(counts)
 
 
 class FakeVolumeBinder:

@@ -64,12 +64,12 @@ def list_(args) -> int:
     """(pkg/cli/queue/list.go:51-87): Name, Weight, then the Queue status
     podgroup-phase counts.
 
-    In --master mode the phase counts come from the Queue CRD status, which
-    NOTHING in kube-batch populates (the reference scheduler only ingests
-    queues; the counts were filled by a controller that arrived later, in
-    Volcano) — so they print 0 against a kube-batch-only cluster, exactly
-    like the reference CLI does.  The admin API (--server) computes live
-    counts from the scheduler cache."""
+    In --master mode the phase counts come from the Queue CRD status. The
+    reference never populates those fields (its filler controller arrived
+    later, in Volcano), so its CLI prints zeros; THIS scheduler writes them
+    back at session close (cache.update_queue_statuses), so the counts are
+    live when it is the one scheduling the cluster.  The admin API
+    (--server) computes the same counts directly from the scheduler cache."""
     if args.master:
         items = _transport(args, args.master).get_json(_QUEUES_PATH).get("items") or []
         rows = []

@@ -34,7 +34,7 @@ from typing import Optional
 from kube_batch_tpu import metrics
 from kube_batch_tpu.api import serialize
 from kube_batch_tpu.api.pod import PersistentVolume, PodDisruptionBudget
-from kube_batch_tpu.api.types import PodGroupPhase
+from kube_batch_tpu.api.types import PodGroupPhase, queue_phase_counts
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.cmd.leader_election import LeaderElector
 from kube_batch_tpu.cmd.options import ServerOption
@@ -48,7 +48,7 @@ def _queue_status(cache: SchedulerCache) -> list:
     """Queue list with the CRD's status counts (types.go:211-223)."""
     with cache._lock:
         counts = {
-            name: {"pending": 0, "running": 0, "unknown": 0, "inqueue": 0}
+            name: queue_phase_counts()
             for name in cache.queues
         }
         for job in cache.jobs.values():
@@ -286,6 +286,10 @@ class RateLimitedStatusUpdater(RateLimitedBackend):
     def update_pod_condition(self, pod, cond):
         self._take()
         return self._backend.update_pod_condition(pod, cond)
+
+    def update_queue_status(self, name, counts):
+        self._take()
+        return self._backend.update_queue_status(name, counts)
 
 
 def run(opt: ServerOption) -> None:

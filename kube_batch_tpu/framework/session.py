@@ -24,7 +24,11 @@ from kube_batch_tpu.api.node_info import NodeInfo
 from kube_batch_tpu.api.pod import PodGroupCondition
 from kube_batch_tpu.api.queue_info import QueueInfo
 from kube_batch_tpu.api.task_info import TaskInfo
-from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
+from kube_batch_tpu.api.types import (
+    PodGroupPhase,
+    TaskStatus,
+    queue_phase_counts,
+)
 from kube_batch_tpu.framework.conf import Tier
 from kube_batch_tpu import metrics
 
@@ -164,6 +168,9 @@ class Session:
         # job uids given an Unschedulable=True condition THIS session —
         # saves the close pass a per-job scan over conditions lists
         self.unschedulable_marked: set = set()
+        # jobs the open gate dropped (gang-invalid, session.go:107-124) —
+        # their podgroups still count toward QueueStatus phase counts
+        self.gate_dropped_jobs: List[JobInfo] = []
 
     def jobs_rows(self):
         """(jobs_list, rows[int64], min_avail[int32]) over the CURRENT job
@@ -645,6 +652,7 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
                     ),
                 )
                 cache.record_job_status_event(job)
+                ssn.gate_dropped_jobs.append(job)
                 del ssn.jobs[uid]
     except BaseException:
         if ssn.exclusive:
@@ -765,6 +773,9 @@ def _close_status_columnar(ssn: Session) -> None:
     record_event = ssn.cache.record_job_status_event
     updates = []
     append = updates.append
+    # per-queue podgroup-phase counts (QueueStatus writeback) accumulate in
+    # the same pass — phases are right here; a second walk would cost more
+    qcounts: Dict[str, dict] = {}
     for i, job in enumerate(jobs_list):
         pg = job.pod_group
         if pg is None:
@@ -789,11 +800,33 @@ def _close_status_columnar(ssn: Session) -> None:
         else:
             phase = pg.phase
         pg.phase, pg.running, pg.failed, pg.succeeded = phase, r, f, s
+        qc = qcounts.get(job.queue)
+        if qc is None:
+            qc = qcounts[job.queue] = queue_phase_counts()
+        qc[phase.value.lower()] += 1
         changed = prev_get(job.uid) != (phase, r, f, s)
         need_record = bool(stuck_l[i]) or phase is PENDING or phase is UNKNOWN
         if changed or need_record or pg.conditions:
             append((job, changed, need_record))
     ssn.cache.update_job_statuses_bulk(updates)
+    _count_gate_dropped(ssn, qcounts)
+    ssn.cache.update_queue_statuses(qcounts)
+
+
+def _count_gate_dropped(ssn: Session, qcounts: Dict[str, dict]) -> None:
+    """Fold the podgroups of gang-invalid jobs (deleted from ssn.jobs by the
+    open gate, session.go:107-124) into the queue phase counts — QueueStatus
+    counts podgroups by phase, not by session membership; without this a
+    queue whose only podgroups are gang-invalid would zero out while the
+    cluster still holds its Pending groups."""
+    for job in ssn.gate_dropped_jobs:
+        pg = job.pod_group
+        if pg is None or pg.shadow or job.queue not in ssn.queues:
+            continue
+        qc = qcounts.get(job.queue)
+        if qc is None:
+            qc = qcounts[job.queue] = queue_phase_counts()
+        qc[(pg.phase or PodGroupPhase.PENDING).value.lower()] += 1
 
 
 def close_session(ssn: Session) -> None:
@@ -812,6 +845,7 @@ def close_session(ssn: Session) -> None:
         if ssn.columns is not None and ssn.jobs:
             _close_status_columnar(ssn)
         else:
+            qcounts: Dict[str, dict] = {}
             for job in ssn.jobs.values():
                 if job.pod_group is None:
                     # PDB-defined jobs get events only, no status writeback
@@ -823,9 +857,15 @@ def close_session(ssn: Session) -> None:
                         ssn.cache.record_job_status_event(job)
                     continue
                 job_status(ssn, job)
+                pg = job.pod_group
+                if not pg.shadow and pg.phase is not None:
+                    qc = qcounts.setdefault(job.queue, queue_phase_counts())
+                    qc[pg.phase.value.lower()] += 1
                 ssn.cache.update_job_status(
                     job, prev_status=ssn.pod_group_status_at_open.get(job.uid)
                 )
+            _count_gate_dropped(ssn, qcounts)
+            ssn.cache.update_queue_statuses(qcounts)
     finally:
         if ssn.exclusive:
             # revert surviving Pipelined placements: they exist only inside

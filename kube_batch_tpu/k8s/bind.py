@@ -107,3 +107,17 @@ class K8sBackend:
             {"status": {"conditions": [cond]}},
             content_type="application/strategic-merge-patch+json",
         )
+
+    def update_queue_status(self, name: str, counts: dict) -> None:
+        """PATCH the Queue CRD's podgroup-phase counts (QueueStatus,
+        types.go:195-204). BEYOND the reference: kube-batch declares the
+        status fields but nothing populates them (the filler controller
+        arrived later, in Volcano) — writing them here makes
+        `kb-ctl queue --master ... list` show live counts."""
+        self.transport.request(
+            "PATCH",
+            "/apis/scheduling.incubator.k8s.io/v1alpha1/queues/"
+            f"{name}/status",
+            {"status": counts},
+            content_type="application/merge-patch+json",
+        )

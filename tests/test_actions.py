@@ -463,3 +463,79 @@ class TestFitErrorDiagnostics:
         assert evictor.evicts and evictor.evicts[0].startswith("c1/low-")
         assert evictor.calls >= 2
         assert cache.err_tasks == []
+
+
+class TestQueueStatusWriteback:
+    """QueueStatus podgroup-phase counts (types.go:195-204) write through
+    the StatusUpdater seam at close — BEYOND the reference, which declares
+    the fields but never fills them (the filler controller arrived later,
+    in Volcano). Deltas only; a queue whose podgroups all leave zeroes out."""
+
+    def test_counts_written_and_delta_suppressed(self):
+        from tests.fixtures import GiB, build_cache, build_node, build_pod
+        from kube_batch_tpu.api.pod import PodGroup
+        from kube_batch_tpu.api.types import PodPhase
+
+        pods = [
+            build_pod("c1", f"g-{i}", None, PodPhase.PENDING,
+                      {"cpu": 1000, "memory": GiB}, group_name="g")
+            for i in range(2)
+        ]
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="g", namespace="c1", min_member=2)],
+            nodes=[build_node("n1", cpu=4000, mem=16 * GiB)],
+            pods=pods,
+        )
+        run_actions(cache, action_names=["allocate"])
+        st = cache.status_updater.queue_statuses
+        assert st["default"] == {"pending": 0, "running": 1, "unknown": 0,
+                                 "inqueue": 0}
+        # unchanged counts suppress the write: clear the record and re-run
+        cache.status_updater.queue_statuses.clear()
+        run_actions(cache, action_names=["allocate"])
+        assert "default" not in cache.status_updater.queue_statuses
+
+    def test_emptied_queue_zeroes_out(self):
+        from tests.fixtures import GiB, build_cache, build_node, build_pod
+        from kube_batch_tpu.api.pod import PodGroup
+        from kube_batch_tpu.api.types import PodPhase
+
+        pod = build_pod("c1", "solo", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB}, group_name="g")
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="g", namespace="c1", min_member=1)],
+            nodes=[build_node("n1", cpu=4000, mem=16 * GiB)],
+            pods=[pod],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.status_updater.queue_statuses["default"]["running"] == 1
+        cache.delete_pod(cache.pods["c1/solo"])
+        cache.delete_pod_group("c1/g")
+        run_actions(cache, action_names=["allocate"])
+        assert cache.status_updater.queue_statuses["default"] == {
+            "pending": 0, "running": 0, "unknown": 0, "inqueue": 0,
+        }
+
+    def test_gate_dropped_gang_still_counts_pending(self):
+        """A gang-invalid job (dropped from the session at open) keeps its
+        Pending podgroup in the QueueStatus counts — counts are by phase,
+        not session membership."""
+        from tests.fixtures import GiB, build_cache, build_node, build_pod
+        from kube_batch_tpu.api.pod import PodGroup
+        from kube_batch_tpu.api.types import PodPhase
+
+        # minMember=3 but only 1 pod exists → JobValid drops it at open
+        pod = build_pod("c1", "g-0", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB}, group_name="g")
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[PodGroup(name="g", namespace="c1", min_member=3)],
+            nodes=[build_node("n1", cpu=4000, mem=16 * GiB)],
+            pods=[pod],
+        )
+        run_actions(cache, action_names=["allocate"])
+        assert cache.binder.binds == {}
+        st = cache.status_updater.queue_statuses
+        assert st["default"]["pending"] == 1, st
