@@ -82,8 +82,24 @@ def solve_claims(ssn, mode: str):
             _cluster_view(ssn), excluded_nodes=ssn.session_excluded_nodes
         )
     gates = victim_gates(ssn, mode)
+    # the idle-fit claimant gate (a declared improvement over reclaim.go —
+    # PARITY "known divergences") is sound only when allocate actually runs
+    # after reclaim to place the skipped claimants, and only when the
+    # device fit is exact for them.  action_names is set by the scheduler
+    # loop; direct action invocation (tests, drives) defaults to the
+    # shipped enqueue→reclaim→allocate layout.
+    names = getattr(ssn, "action_names", None)
+    idle_gate = mode == "reclaim" and not ssn.host_only_predicates and (
+        names is None
+        or (
+            "allocate" in names
+            and "reclaim" in names
+            and names.index("allocate") > names.index("reclaim")
+        )
+    )
     config = EvictConfig(
         mode=mode,
+        idle_gate=idle_gate,
         gang=ssn.plugin_enabled("gang"),
         drf=ssn.plugin_enabled("drf"),
         proportion=ssn.plugin_enabled("proportion"),

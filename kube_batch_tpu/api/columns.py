@@ -728,6 +728,7 @@ class ColumnStore:
             task_tol_bits=self.t_tol_bits,
             task_node=self.t_node,
             task_critical=self.t_critical,
+            task_needs_host=self.t_needs_host,
             task_aff_idx=task_aff_idx,
             task_aff_mask=task_aff_mask,
             task_pref_idx=task_pref_idx,

@@ -79,6 +79,10 @@ class DeviceSnapshot(NamedTuple):
     task_node: "np.ndarray"         # [T] i32 — bound node index, -1 unbound
     task_critical: "np.ndarray"     # [T] bool — conformance-protected
     #                                 (conformance.go:42-59)
+    task_needs_host: "np.ndarray"   # [T] bool — carries host-only constraints
+    #                                 (ports/rich affinity); the reclaim
+    #                                 idle-fit gate exempts these (their
+    #                                 device fit is approximate)
     # sparse inter-pod-affinity correction (predicates.go:278-296): rows of
     # a [K, N] allow mask for the K tasks carrying required pod
     # (anti-)affinity terms, evaluated against snapshot-time placements;
@@ -149,6 +153,13 @@ class SnapshotMeta:
     @property
     def shape(self) -> Tuple[int, int, int, int]:
         return (len(self.task_keys), len(self.node_names), len(self.job_uids), len(self.queue_names))
+
+
+def _pad_bool(arr: "np.ndarray", n: int) -> "np.ndarray":
+    """[k] bool → [n] bool, padding False."""
+    out = np.zeros(n, bool)
+    out[: arr.shape[0]] = arr
+    return out
 
 
 def _pack_bits(bit_indices: List[int], words: int) -> np.ndarray:
@@ -458,6 +469,7 @@ def build_snapshot(
         task_tol_bits=task_tol_bits,
         task_node=task_node,
         task_critical=task_critical,
+        task_needs_host=_pad_bool(task_needs_host, T),
         task_aff_idx=task_aff_idx,
         task_aff_mask=task_aff_mask,
         task_pref_idx=task_pref_idx,

@@ -46,7 +46,7 @@ from kube_batch_tpu.api.snapshot import DeviceSnapshot
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.ops import fairness, ordering
 from kube_batch_tpu.ops.assignment import _best_node, _tie_break_hash
-from kube_batch_tpu.ops.feasibility import static_predicates
+from kube_batch_tpu.ops.feasibility import fits, static_predicates
 from kube_batch_tpu.ops.ordering import segmented_prefix
 from kube_batch_tpu.ops.scoring import ScoreWeights, score_matrix
 
@@ -67,6 +67,10 @@ class EvictConfig(NamedTuple):
 
     mode: str = "reclaim"     # "reclaim" (cross-queue) | "preempt" (same-queue)
     rounds: int = 8
+    # reclaim-only: skip claimants that fit free Idle (allocate places them
+    # later this cycle) — set by the action layer ONLY when allocate is
+    # actually configured after reclaim and host predicates are exact
+    idle_gate: bool = False
     # ordering / gating (claimant side)
     gang: bool = True
     drf: bool = True
@@ -132,6 +136,22 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
         & snap.job_valid[snap.task_job]
         & snap.job_schedulable[snap.task_job]
     )
+    if config.idle_gate and not preempt:
+        # IMPROVEMENT over reclaim.go (which never looks at Idle and will
+        # evict cross-queue victims for a task free capacity could satisfy):
+        # a claimant that fits some schedulable node's cycle-start Idle is
+        # left to the allocate action — eviction is for capacity that must
+        # be TAKEN, not capacity that's already free.  The action layer
+        # enables this only when allocate really runs after reclaim;
+        # claimants with host-only constraints are exempt (their device fit
+        # is approximate — allocate's host re-check might reject the node
+        # and strand them).  Preempt never gates: it runs after allocate,
+        # so its claimants already failed idle placement this cycle.
+        fits_idle_any = jnp.any(
+            fits(snap.task_req, snap.node_idle, snap.quanta) & static_ok,
+            axis=1,
+        )
+        claimant_base &= ~(fits_idle_any & ~snap.task_needs_host)
 
     def round_body(state):
         claim_node, evicted, victim_claimant, i, _ = state

@@ -86,6 +86,7 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
         task_tol_bits=repl,
         task_node=repl,
         task_critical=repl,
+        task_needs_host=repl,
         task_aff_idx=repl,
         task_aff_mask=NamedSharding(mesh, P(None, NODE_AXIS)),
         task_pref_idx=repl,

@@ -37,12 +37,21 @@ class GangPlugin(Plugin):
 
         def evictable(evictor: TaskInfo, evictees: List[TaskInfo]) -> List[TaskInfo]:
             """(gang.go:71-94) a task is a victim only if its job stays at or
-            above minAvailable after all victims so far are removed."""
+            above minAvailable after all victims so far are removed.
+            MinAvailable <= 1 jobs are not gangs and are always evictable
+            (gang.go:78's `|| job.MinAvailable == 1` escape — the device
+            solve's slack gate, ops/eviction.py, has the same special case);
+            the cumulative accounting for real gangs is deliberately
+            stricter than the reference's per-victim snapshot test, which
+            could approve a victim set that jointly breaks the gang."""
             victims: List[TaskInfo] = []
             occupied: Dict[str, int] = {}
             for ee in evictees:
                 job = ssn.jobs.get(ee.job)
                 if job is None:
+                    continue
+                if job.min_available <= 1:
+                    victims.append(ee)
                     continue
                 if job.uid not in occupied:
                     occupied[job.uid] = job.ready_task_num

@@ -99,6 +99,9 @@ class Scheduler:
         self._maybe_reload_conf()
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
+        # the configured pipeline, for actions whose behavior depends on
+        # what runs after them (reclaim's idle-fit claimant gate)
+        ssn.action_names = [a.name for a in self.actions]
         try:
             for action in self.actions:
                 a_start = time.perf_counter()

@@ -119,6 +119,7 @@ def synthetic_device_snapshot(
         task_tol_bits=np.zeros((T, 1), np.uint32),
         task_node=np.full(T, -1, np.int32),
         task_critical=np.zeros(T, bool),
+        task_needs_host=np.zeros(T, bool),
         task_aff_idx=np.full(1, -1, np.int32),
         task_aff_mask=np.ones((1, N), bool),
         task_pref_idx=np.full(1, -1, np.int32),

@@ -188,3 +188,118 @@ def test_overused_queue_gains_nothing_at_scale():
         for t in job.tasks.values():
             assert t.status == TaskStatus.PENDING, (uid, t.status)
     close_session(ssn)
+
+
+@pytest.mark.slow
+def test_eviction_many_queues_bucket():
+    """The per-claimant queue-capacity gather at a queue bucket > 8 (the
+    one-hot matmul's contraction axis, ops/eviction.py): 12 queues land in
+    the 16-wide bucket; claimants across 11 starved queues must reclaim only
+    cross-queue victims and never their own queue's capacity."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod, PodGroup, Queue
+    from kube_batch_tpu.api.types import PodPhase
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.testing.synthetic import GiB as _GiB
+
+    cache = SchedulerCache()
+    cache.add_queue(Queue(name="q0", weight=1))
+    for i in range(1, 12):
+        cache.add_queue(Queue(name=f"q{i}", weight=2))
+    from kube_batch_tpu.api.pod import Node
+
+    for n in range(8):
+        cache.add_node(Node(name=f"n{n}", allocatable={
+            "cpu": 8000.0, "memory": float(64 * _GiB), "pods": 110.0}))
+    # q0 saturates every node's cpu with 8 x 1000m per node
+    for i in range(64):
+        cache.add_pod_group(PodGroup(name=f"r{i}", namespace="b", min_member=1,
+                                     queue="q0", creation_index=i))
+        cache.add_pod(Pod(
+            name=f"r{i}", namespace="b", requests={"cpu": 1000.0, "memory": float(_GiB)},
+            annotations={GROUP_NAME_ANNOTATION: f"r{i}"},
+            phase=PodPhase.RUNNING, node_name=f"n{i % 8}", creation_index=i,
+        ))
+    # one pending claimant per starved queue
+    for i in range(1, 12):
+        cache.add_pod_group(PodGroup(name=f"p{i}", namespace="b", min_member=1,
+                                     queue=f"q{i}", creation_index=100 + i))
+        cache.add_pod(Pod(
+            name=f"p{i}", namespace="b", requests={"cpu": 1000.0, "memory": float(_GiB)},
+            annotations={GROUP_NAME_ANNOTATION: f"p{i}"},
+            phase=PodPhase.PENDING, creation_index=100 + i,
+        ))
+    conf = load_scheduler_conf(None)
+    ssn = open_session(cache, conf.tiers)
+    get_action("reclaim").execute(ssn)
+    evicted = [t for job in ssn.jobs.values() for t in job.tasks.values()
+               if t.status == TaskStatus.RELEASING]
+    pipelined = [t for job in ssn.jobs.values() for t in job.tasks.values()
+                 if t.status == TaskStatus.PIPELINED]
+    assert evicted and pipelined
+    assert all(ssn.jobs[t.job].queue == "q0" for t in evicted)
+    assert all(ssn.jobs[t.job].queue != "q0" for t in pipelined)
+    # most starved queues get their claim in one cycle (8 nodes → up to 8
+    # claims per round; rounds continue while progress is made)
+    assert len(pipelined) >= 8, len(pipelined)
+    close_session(ssn)
+    assert not cache.columns.check_consistency(cache)
+
+    # no-churn convergence (the idle-fit claimant gate, a declared
+    # improvement over reclaim.go): once the victims terminate, claimants
+    # fit free capacity, so the next cycles allocate WITHOUT new evictions
+    for key in list(cache.evictor.evicts):
+        pod = cache.pods.get(key)
+        if pod is not None:
+            cache.delete_pod(pod)
+    cache.evictor.evicts.clear()
+    conf2 = load_scheduler_conf(None)
+    conf2.actions = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
+    ssn2 = open_session(cache, conf2.tiers)
+    for name in conf2.actions:
+        get_action(name).execute(ssn2)
+    close_session(ssn2)
+    cache.flush_binds()
+    assert not cache.evictor.evicts, cache.evictor.evicts
+    bound = sum(1 for k in cache.binder.binds if k.startswith("b/p"))
+    assert bound >= len(pipelined), (bound, len(pipelined))
+
+
+@pytest.mark.slow
+def test_idle_gate_off_without_allocate_after_reclaim():
+    """The idle-fit claimant gate must disable itself when the configured
+    pipeline has no allocate after reclaim — otherwise a skipped claimant
+    would never be scheduled at all (strictly worse than the reference)."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue
+    from kube_batch_tpu.api.types import PodPhase
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.scheduler import Scheduler
+
+    GiB = float(2 ** 30)
+    cache = SchedulerCache()
+    cache.add_queue(Queue(name="q0", weight=1))
+    cache.add_queue(Queue(name="q1", weight=3))
+    # node with FREE cpu (claimant fits idle) AND a cross-queue victim
+    cache.add_node(Node(name="n1", allocatable={
+        "cpu": 4000.0, "memory": float(64 * GiB), "pods": 110.0}))
+    cache.add_pod_group(PodGroup(name="r", namespace="b", min_member=1,
+                                 queue="q0", creation_index=0))
+    cache.add_pod(Pod(name="r", namespace="b",
+                      requests={"cpu": 1000.0, "memory": GiB},
+                      annotations={GROUP_NAME_ANNOTATION: "r"},
+                      phase=PodPhase.RUNNING, node_name="n1",
+                      creation_index=0))
+    cache.add_pod_group(PodGroup(name="p", namespace="b", min_member=1,
+                                 queue="q1", creation_index=1))
+    cache.add_pod(Pod(name="p", namespace="b",
+                      requests={"cpu": 1000.0, "memory": GiB},
+                      annotations={GROUP_NAME_ANNOTATION: "p"},
+                      phase=PodPhase.PENDING, creation_index=1))
+    conf = load_scheduler_conf(None)
+    conf.actions = ["reclaim"]  # no allocate at all
+    sched = Scheduler(cache, conf=conf)
+    sched.run_once()
+    # without the gate disabling itself, the fitting claimant would be
+    # masked out and NOTHING would happen; with it off (no allocate in the
+    # pipeline), reclaim behaves like the reference: the victim is evicted
+    # (the pipeline itself is session-only state, reverted at close)
+    assert "b/r" in cache.evictor.evicts, cache.evictor.evicts
