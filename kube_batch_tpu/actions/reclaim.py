@@ -86,16 +86,17 @@ def solve_claims(ssn, mode: str):
     # PARITY "known divergences") is sound only when allocate actually runs
     # after reclaim to place the skipped claimants, and only when the
     # device fit is exact for them.  action_names is set by the scheduler
-    # loop; direct action invocation (tests, drives) defaults to the
-    # shipped enqueue→reclaim→allocate layout.
+    # loop; with no pipeline information (direct action invocation) the
+    # gate FAILS CLOSED to the reference behavior — an optimization whose
+    # soundness depends on pipeline shape must not assume one.
     names = getattr(ssn, "action_names", None)
-    idle_gate = mode == "reclaim" and not ssn.host_only_predicates and (
-        names is None
-        or (
-            "allocate" in names
-            and "reclaim" in names
-            and names.index("allocate") > names.index("reclaim")
-        )
+    idle_gate = (
+        mode == "reclaim"
+        and not ssn.host_only_predicates
+        and names is not None
+        and "allocate" in names
+        and "reclaim" in names
+        and names.index("allocate") > names.index("reclaim")
     )
     config = EvictConfig(
         mode=mode,

@@ -255,6 +255,9 @@ def test_eviction_many_queues_bucket():
     conf2 = load_scheduler_conf(None)
     conf2.actions = ["enqueue", "reclaim", "allocate", "backfill", "preempt"]
     ssn2 = open_session(cache, conf2.tiers)
+    # the idle-fit gate fails closed without pipeline info — publish it the
+    # way Scheduler.run_once does
+    ssn2.action_names = list(conf2.actions)
     for name in conf2.actions:
         get_action(name).execute(ssn2)
     close_session(ssn2)
