@@ -157,14 +157,28 @@ class LeaderElector:
         if failure:
             raise LostLeadership(f"{self.identity} lost the lease")
 
-    def _join_renew(self) -> None:
+    # one K8s renew attempt is a GET + a PUT, EACH with a 10s HTTP timeout;
+    # the join must outlast the pair or an in-flight renew PUT can land AFTER
+    # release() vacates the lease and re-take it, delaying standby takeover
+    # by a full lease_duration
+    _RENEW_JOIN_TIMEOUT = 22.0
+
+    def _join_renew(self) -> bool:
         """Stop and reap the renew thread BEFORE vacating the lock: a renew
         attempt in flight after the vacate would re-take the lease and delay
-        standby takeover by a full lease_duration."""
+        standby takeover by a full lease_duration. Returns False when the
+        thread could not be reaped within the transport timeout — callers
+        must then re-check/re-vacate after it dies, or accept the risk."""
         self._stop.set()
         t = self._renew_thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
+            t.join(timeout=self._RENEW_JOIN_TIMEOUT)
+            if t.is_alive():
+                logger.warning(
+                    "renew thread still alive after %.0fs join; a late renew "
+                    "may re-take the lease", self._RENEW_JOIN_TIMEOUT)
+                return False
+        return True
 
     def is_leader(self) -> bool:
         rec = self._read()
@@ -175,7 +189,15 @@ class LeaderElector:
         )
 
     def release(self) -> None:
-        self._join_renew()
+        joined = self._join_renew()
+        self._vacate()
+        if not joined and self._renew_thread is not None:
+            # a straggling renew may land after the vacate and re-take the
+            # lease; wait for the thread to die and vacate once more
+            self._renew_thread.join(timeout=self._RENEW_JOIN_TIMEOUT)
+            self._vacate()
+
+    def _vacate(self) -> None:
         rec = self._read()
         if rec is not None and rec["holder"] == self.identity:
             try:
@@ -340,15 +362,14 @@ class K8sLeaseElector(LeaderElector):
             and time.time() - _parse_rfc3339(spec.get("renewTime")) < duration
         )
 
-    def release(self) -> None:
+    def _vacate(self) -> None:
         """Vacate the lease on clean shutdown (client-go ReleaseOnCancel
         clears holderIdentity) so a standby can take over immediately.
-        The renew thread is reaped FIRST — an in-flight renew landing after
-        the vacate would re-take the lease; its CAS bump also explains the
-        one 409 retry here."""
+        The renew thread is reaped FIRST (base-class release) — an in-flight
+        renew landing after the vacate would re-take the lease; its CAS bump
+        also explains the one 409 retry here."""
         import urllib.error
 
-        self._join_renew()
         for _ in range(2):
             try:
                 obj = self._get()

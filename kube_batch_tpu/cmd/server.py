@@ -230,15 +230,15 @@ class AdminServer:
         self.httpd.server_close()
 
 
-class RateLimitedBackend:
-    """Token-bucket throttle on egress writes — the client-side 50 QPS /
-    100-burst throttling of the reference (options.go:32-33, server.go:69-70)
-    applied to the Binder/Evictor seam."""
+class TokenBucket:
+    """The client-side 50 QPS / 100-burst throttle of the reference
+    (options.go:32-33, server.go:69-70). The reference has ONE rest.Config —
+    binder, evictor, and status updater all ride the same rate limiter — so
+    one bucket instance must be shared across every egress wrapper."""
 
-    def __init__(self, backend, qps: float, burst: int):
+    def __init__(self, qps: float, burst: int):
         import time as _time
 
-        self._backend = backend
         self._qps = qps
         self._burst = float(burst)
         self._tokens = float(burst)
@@ -246,7 +246,7 @@ class RateLimitedBackend:
         self._lock = threading.Lock()
         self._time = _time
 
-    def _take(self) -> None:
+    def take(self) -> None:
         with self._lock:
             now = self._time.monotonic()
             self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
@@ -259,6 +259,22 @@ class RateLimitedBackend:
                 self._time.sleep(wait)
             else:
                 self._tokens -= 1.0
+
+
+class RateLimitedBackend:
+    """Token-bucket throttle applied to the Binder/Evictor seam. Pass a shared
+    TokenBucket via `bucket` so multiple seams drain one budget; qps/burst
+    kwargs build a private bucket (single-seam deployments and tests)."""
+
+    def __init__(self, backend, qps: float = 0.0, burst: int = 0,
+                 bucket: Optional[TokenBucket] = None):
+        if bucket is None and qps <= 0.0:
+            raise ValueError("RateLimitedBackend needs a shared bucket or qps > 0")
+        self._backend = backend
+        self._bucket = bucket if bucket is not None else TokenBucket(qps, burst)
+
+    def _take(self) -> None:
+        self._bucket.take()
 
     def bind(self, pod, hostname):
         self._take()
@@ -308,6 +324,9 @@ def run(opt: ServerOption) -> None:
     # apiserver (pods/binding POST, pod DELETE); standalone deployments keep
     # the recording fakes behind the ingest API
     k8s_mode = opt.master.startswith("http")
+    # one bucket for ALL egress (binds + evictions + status writes): the
+    # reference's writes share a single throttled rest.Config (server.go:69-70)
+    bucket = TokenBucket(opt.kube_api_qps, opt.kube_api_burst)
     if k8s_mode:
         from kube_batch_tpu.k8s.bind import K8sBackend
         from kube_batch_tpu.k8s.transport import in_cluster_auth
@@ -315,17 +334,15 @@ def run(opt: ServerOption) -> None:
         auth = in_cluster_auth()
         backend = K8sBackend(opt.master, **auth)
         binder, evictor = backend, backend
-        status_updater = RateLimitedStatusUpdater(
-            backend, opt.kube_api_qps, opt.kube_api_burst
-        )
+        status_updater = RateLimitedStatusUpdater(backend, bucket=bucket)
     else:
         binder, evictor = FakeBinder(), FakeEvictor()
         status_updater = None  # cache default: recording fake
     cache = SchedulerCache(
         scheduler_name=opt.scheduler_name,
         default_queue=opt.default_queue,
-        binder=RateLimitedBackend(binder, opt.kube_api_qps, opt.kube_api_burst),
-        evictor=RateLimitedBackend(evictor, opt.kube_api_qps, opt.kube_api_burst),
+        binder=RateLimitedBackend(binder, bucket=bucket),
+        evictor=RateLimitedBackend(evictor, bucket=bucket),
         status_updater=status_updater,
         volume_binder=StandalonePVBinder(),  # real PV ledger behind /v1/persistentvolumes
         resolve_priority=opt.enable_priority_class,

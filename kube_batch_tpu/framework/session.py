@@ -164,6 +164,10 @@ class Session:
         # hooks / close status pass (each used to rebuild the same job-row
         # arrays and cluster-total Resource independently)
         self._jobs_rows_cache: Optional[tuple] = None
+        # bumped at every session-jobs add/delete (drop_job / direct callers
+        # via note_jobs_mutation) so the rows cache can't alias a stale
+        # array when equal numbers of jobs are added and removed mid-session
+        self._jobs_version = 0
         self._total_alloc_cache = None
         # job uids given an Unschedulable=True condition THIS session —
         # saves the close pass a per-job scan over conditions lists
@@ -172,24 +176,35 @@ class Session:
         # their podgroups still count toward QueueStatus phase counts
         self.gate_dropped_jobs: List[JobInfo] = []
 
+    def drop_job(self, uid: str) -> None:
+        """Remove a job from the session (open-gate drops) and invalidate
+        the rows cache. Any other path mutating ssn.jobs must call
+        note_jobs_mutation()."""
+        del self.jobs[uid]
+        self._jobs_version += 1
+
+    def note_jobs_mutation(self) -> None:
+        self._jobs_version += 1
+
     def jobs_rows(self):
         """(jobs_list, rows[int64], min_avail[int32]) over the CURRENT job
-        set, cached for the session — invalidated when the job set changes
-        size (open-gate deletions, enqueue additions). Columnar sessions
-        only."""
+        set, cached for the session — keyed on (len, mutation counter) so
+        equal add+delete churn can't silently reuse stale arrays. Columnar
+        sessions only."""
         import numpy as np
 
+        key = (len(self.jobs), self._jobs_version)
         cached = self._jobs_rows_cache
-        if cached is not None and len(cached[0]) == len(self.jobs):
-            return cached
+        if cached is not None and cached[3] == key:
+            return cached[:3]
         jobs_list = list(self.jobs.values())
         m = len(jobs_list)
         rows = np.fromiter((j._row for j in jobs_list), np.int64, count=m)
         minav = np.fromiter(
             (j.min_available for j in jobs_list), np.int32, count=m
         )
-        self._jobs_rows_cache = (jobs_list, rows, minav)
-        return self._jobs_rows_cache
+        self._jobs_rows_cache = (jobs_list, rows, minav, key)
+        return self._jobs_rows_cache[:3]
 
     def total_allocatable(self):
         """Σ allocatable over the session's nodes (the drf/proportion
@@ -653,7 +668,7 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
                 )
                 cache.record_job_status_event(job)
                 ssn.gate_dropped_jobs.append(job)
-                del ssn.jobs[uid]
+                ssn.drop_job(uid)
     except BaseException:
         if ssn.exclusive:
             cache.end_exclusive_session()  # never leave the gate stuck

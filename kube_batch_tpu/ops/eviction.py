@@ -256,7 +256,12 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
         onehot_q = (task_queue[:, None] == jnp.arange(Q)[None, :]).astype(
             jnp.float32
         )                                                            # [T, Q]
+        # a queue index outside [0, Q) gathers an all-zero capacity row from
+        # the one-hot contraction; a near-zero request could still pass the
+        # epsilon compare against it — make such tasks categorically
+        # infeasible rather than relying on claimant_ok to exclude them
         feas = static_ok & claimant_ok[:, None]
+        feas &= ((task_queue >= 0) & (task_queue < Q))[:, None]
         for r in range(R):  # R is the small static resource dim
             # HIGHEST precision: TPU default matmul truncates the f32
             # capacity operand to bf16 (~2^-8 relative), which at byte-unit

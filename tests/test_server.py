@@ -294,6 +294,29 @@ class TestRateLimiter:
         assert elapsed >= 0.08
         assert len(rl._backend.binds) == 15
 
+    def test_single_bucket_shared_across_seams(self):
+        """Binder + evictor + status updater drain ONE token budget: the
+        reference's writes all ride a single throttled rest.Config
+        (server.go:69-70), so combined egress must not reach 3x qps."""
+        from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor
+        from kube_batch_tpu.cmd.server import (
+            RateLimitedBackend, TokenBucket)
+
+        bucket = TokenBucket(qps=100.0, burst=5)
+        binder = RateLimitedBackend(FakeBinder(), bucket=bucket)
+        evictor = RateLimitedBackend(FakeEvictor(), bucket=bucket)
+        pods = [build_pod("default", f"p{i}", None, PodPhase.PENDING, {})
+                for i in range(16)]
+        t0 = time.perf_counter()
+        for i, p in enumerate(pods):
+            (binder.bind(p, "n1") if i % 2 == 0 else evictor.evict(p))
+        elapsed = time.perf_counter() - t0
+        # 16 writes against a SHARED burst of 5 → ≥11 waits at 100/s;
+        # independent buckets would sail through both bursts in ~0.03s
+        assert elapsed >= 0.08
+        assert len(binder._backend.binds) == 8
+        assert len(evictor._backend.evicts) == 8
+
 
 class TestLeaderElection:
     def test_single_leader_and_failover(self, tmp_path):
