@@ -98,8 +98,29 @@ class AllocateResult(NamedTuple):
     node_releasing: jnp.ndarray  # [N, R] post-solve
     node_used: jnp.ndarray      # [N, R] post-solve
     deserved: jnp.ndarray       # [Q, R] proportion deserved (diagnostics)
-    fail_hist: jnp.ndarray      # [T, N_REASONS] i32 — cycle-start fit-error
-    #                             histogram (FitErrors diagnostics)
+
+
+@jax.jit
+def failure_histogram_solve(snap: DeviceSnapshot) -> jnp.ndarray:
+    """[T, N_REASONS] cycle-start fit-error histogram as its OWN dispatch.
+
+    The histogram re-walks the [T, N]-scale predicate bitsets, so folding it
+    into allocate_solve taxed every cycle — including the steady-state ones
+    where every pending task places and the histogram is never read
+    (allocate.go:151-155 only builds FitErrors for tasks that failed). The
+    action calls this lazily, after the solve's assignment shows unplaced
+    pending tasks."""
+    from kube_batch_tpu.ops.feasibility import FeasibilityMasks, failure_histogram
+
+    static_ok = static_predicates(snap)
+    fit0_idle = fits(snap.task_req, snap.node_idle, snap.quanta)
+    fit0_rel = fits(snap.task_req, snap.node_releasing, snap.quanta)
+    return failure_histogram(
+        snap,
+        FeasibilityMasks(
+            static_ok, fit0_idle, fit0_rel, static_ok & (fit0_idle | fit0_rel)
+        ),
+    )
 
 
 def _queue_gate(
@@ -193,18 +214,6 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     Q = snap.queue_weight.shape[0]
 
     static_ok = static_predicates(snap)           # [T, N]
-    # cycle-start fit-error histogram — inside the same compiled program so
-    # diagnostics never cost a second [T, N] dispatch (allocate.go:151-155)
-    from kube_batch_tpu.ops.feasibility import FeasibilityMasks, failure_histogram
-
-    fit0_idle = fits(snap.task_req, snap.node_idle, snap.quanta)
-    fit0_rel = fits(snap.task_req, snap.node_releasing, snap.quanta)
-    fail_hist = failure_histogram(
-        snap,
-        FeasibilityMasks(
-            static_ok, fit0_idle, fit0_rel, static_ok & (fit0_idle | fit0_rel)
-        ),
-    )
     score = score_matrix(snap, config.weights)
     # static predicates folded into the score once — every round reuses it
     score_static = jnp.where(static_ok, score, NEG)
@@ -442,5 +451,4 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         node_releasing=releasing,
         node_used=used,
         deserved=deserved,
-        fail_hist=fail_hist,
     )

@@ -142,7 +142,6 @@ def sharded_allocate_solve(
             node_releasing=node2,
             node_used=node2,
             deserved=repl,
-            fail_hist=repl,
         )
         fn = jax.jit(
             partial(_solve, config=config),
@@ -156,6 +155,25 @@ def sharded_allocate_solve(
 
 def _solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
     return allocate_solve(snap, config)
+
+
+def sharded_failure_histogram(snap: DeviceSnapshot, mesh: Mesh):
+    """The lazy fit-error histogram over the mesh: [T, N]-scale predicate
+    masks shard along the node axis, the per-reason node counts all-reduce
+    into the replicated [T, N_REASONS] result."""
+    from kube_batch_tpu.ops.assignment import failure_histogram_solve
+
+    key = (mesh, "fail_hist")
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(
+            failure_histogram_solve.__wrapped__,
+            in_shardings=(snapshot_shardings(mesh),),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+        _jit_cache[key] = fn
+    with mesh:
+        return fn(snap)
 
 
 def sharded_evict_solve(
