@@ -133,6 +133,26 @@ class PersistentVolume:
     name: str
     node: Optional[str] = None   # None = accessible from every node
     claim: Optional[str] = None  # pre-bound PVC name; None = matches any claim
+    # k8s mode: PVs bind only claims of the same storage class; standalone
+    # ingest leaves it empty (matches empty-class claims)
+    storage_class: str = ""
+
+
+@dataclasses.dataclass
+class PersistentVolumeClaim:
+    """The claim side of the PV ledger in --master mode: carries the durable
+    PVC→PV binding (spec.volumeName) and the storage class that decides
+    whether an unbound claim is dynamically provisionable
+    (cache.go:258-269 feeds the k8s volumebinder from the pvc informer)."""
+
+    name: str
+    namespace: str = "default"
+    volume_name: Optional[str] = None  # spec.volumeName — bound PV
+    storage_class: str = ""
+    phase: str = "Pending"
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclasses.dataclass

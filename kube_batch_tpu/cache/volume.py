@@ -19,9 +19,12 @@ volumes to the next BindVolumes/re-assume — convergence by re-running).
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional
 
-from kube_batch_tpu.api.pod import PersistentVolume
+from kube_batch_tpu.api.pod import PersistentVolume, PersistentVolumeClaim
+
+logger = logging.getLogger("kube_batch_tpu")
 
 
 class StandalonePVBinder:
@@ -35,15 +38,23 @@ class StandalonePVBinder:
         # task uid → {claim: pv name} (assumed, this cycle)
         self.reservations: Dict[str, Dict[str, str]] = {}
         self._sorted_pvs: list = None  # memo; invalidated on ledger change
+        # ingest arrives from watch / admin-HTTP threads while the
+        # scheduling cycle reads — one coarse lock covers both ledgers
+        # (the reference's volumebinder rides the cache's big mutex)
+        import threading
+
+        self._lock = threading.RLock()
 
     # -- ledger ingest (pv informer analog) ------------------------------
     def add_pv(self, pv: PersistentVolume) -> None:
-        self.pvs[pv.name] = pv
-        self._sorted_pvs = None
+        with self._lock:
+            self.pvs[pv.name] = pv
+            self._sorted_pvs = None
 
     def delete_pv(self, name: str) -> None:
-        self.pvs.pop(name, None)
-        self._sorted_pvs = None
+        with self._lock:
+            self.pvs.pop(name, None)
+            self._sorted_pvs = None
 
     def _candidates(self) -> list:
         """PVs in match order (pre-bound first), memoized — _resolve runs
@@ -88,14 +99,15 @@ class StandalonePVBinder:
         claims = getattr(task.pod, "volume_claims", ())
         if not claims:
             return True
-        held = self._reserved_pvs(excluding_task=task.uid)
-        picked: set = set()
-        for claim in claims:
-            pv = self._resolve(claim, hostname, held | picked)
-            if pv is None:
-                return False
-            picked.add(pv)
-        return True
+        with self._lock:
+            held = self._reserved_pvs(excluding_task=task.uid)
+            picked: set = set()
+            for claim in claims:
+                pv = self._resolve(claim, hostname, held | picked)
+                if pv is None:
+                    return False
+                picked.add(pv)
+            return True
 
     # -- VolumeBinder seam ------------------------------------------------
     def allocate_volumes(self, task, hostname: str) -> None:
@@ -105,28 +117,237 @@ class StandalonePVBinder:
         from kube_batch_tpu.framework.session import FitFailure
 
         claims = getattr(task.pod, "volume_claims", ())
-        self.reservations.pop(task.uid, None)
-        if not claims:
-            return
-        held = self._reserved_pvs(excluding_task=task.uid)
-        picked: Dict[str, str] = {}
-        for claim in claims:
-            pv = self._resolve(claim, hostname, held | set(picked.values()))
-            if pv is None:
-                raise FitFailure(
-                    f"volume claim {claim!r} has no PV reachable from {hostname}"
-                )
-            picked[claim] = pv
-        self.reservations[task.uid] = picked
+        with self._lock:
+            self.reservations.pop(task.uid, None)
+            if not claims:
+                return
+            held = self._reserved_pvs(excluding_task=task.uid)
+            picked: Dict[str, str] = {}
+            for claim in claims:
+                pv = self._resolve(claim, hostname, held | set(picked.values()))
+                if pv is None:
+                    raise FitFailure(
+                        f"volume claim {claim!r} has no PV reachable from {hostname}"
+                    )
+                picked[claim] = pv
+            self.reservations[task.uid] = picked
 
     def bind_volumes(self, task) -> None:
         """Make the task's assumed bindings durable (BindVolumes,
         cache.go:258-269)."""
-        picked = self.reservations.pop(task.uid, None)
-        if picked:
-            self.bound.update(picked)
+        with self._lock:
+            picked = self.reservations.pop(task.uid, None)
+            if picked:
+                self.bound.update(picked)
 
     def release_task(self, task_uid: str) -> None:
         """Drop a task's assumed (not yet bound) reservation — called when
         its pod leaves the cluster so the PVs free up."""
-        self.reservations.pop(task_uid, None)
+        with self._lock:
+            self.reservations.pop(task_uid, None)
+
+
+# k8s dynamic-provisioning marker class; every other provisioner value means
+# the cluster creates a volume on demand (the static marker is the k8s
+# convention for local/manual PVs)
+NO_PROVISIONER = "kubernetes.io/no-provisioner"
+# the WaitForFirstConsumer hand-off annotation the scheduler writes so the
+# PV controller binds the claim to a volume reachable from the chosen node
+SELECTED_NODE_ANNOTATION = "volume.kubernetes.io/selected-node"
+
+
+class K8sPVLedger(StandalonePVBinder):
+    """The --master mode VolumeBinder, fed by the pv/pvc/storageclass
+    watches (the reference's volumebinder informers,
+    cache.go:189-209,258-269,311-320).
+
+    Differences from the standalone ledger:
+    - claim identity is NAMESPACED ("ns/name"); a pod's claim names resolve
+      in the pod's own namespace
+    - PVC objects are first-class: spec.volumeName is the durable binding,
+      an unknown claim fails placement (the pod references a PVC the
+      cluster doesn't have — FindPodVolumes errors the same way)
+    - StorageClasses gate unbound claims: a provisioner-backed class is
+      dynamically provisionable (feasible on every node — the volume is
+      created after scheduling), while kubernetes.io/no-provisioner
+      classes must match a free static PV from the ledger, storage class
+      and node reachability included
+    - bind_volumes makes the binding durable CLUSTER-SIDE too: static
+      claims pre-bind their PV by claimRef PATCH (what the k8s volume
+      binder's BindPodVolumes does), dynamic claims get the
+      WaitForFirstConsumer selected-node annotation so the PV controller
+      provisions on the chosen node; every write rides the shared kube-api
+      token bucket and failed writes queue for retry on later binds
+    """
+
+    def __init__(self, transport=None, bucket=None):
+        super().__init__()
+        self.claims: Dict[str, PersistentVolumeClaim] = {}
+        self.storage_classes: Dict[str, str] = {}  # name → provisioner
+        self.transport = transport
+        self.bucket = bucket  # shared egress TokenBucket (cmd/server.py)
+        self._selected_node: Dict[str, str] = {}  # task uid → chosen host
+        self._pending_writes: list = []  # failed PATCHes awaiting retry
+
+    # -- ingest (pvc / storageclass informer analogs) --------------------
+    def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            key = pvc.key()
+            self.claims[key] = pvc
+            if pvc.volume_name:
+                self.bound[key] = pvc.volume_name
+            # an unbound PVC event does NOT clear a local binding: our
+            # claimRef patch / the PV controller round-trip lags the watch,
+            # and dropping the entry here would free the PV for a second
+            # claim while the first pod's binding is still in flight
+
+    def delete_pvc(self, key: str) -> None:
+        with self._lock:
+            self.claims.pop(key, None)
+            self.bound.pop(key, None)
+
+    def add_storage_class(self, name: str, provisioner: str) -> None:
+        with self._lock:
+            self.storage_classes[name] = provisioner
+
+    def delete_storage_class(self, name: str) -> None:
+        with self._lock:
+            self.storage_classes.pop(name, None)
+
+    # -- resolution -------------------------------------------------------
+    def _dynamic(self, pvc: PersistentVolumeClaim) -> bool:
+        prov = self.storage_classes.get(pvc.storage_class)
+        return bool(prov) and prov != NO_PROVISIONER
+
+    def _resolve_k8s(self, key: str, hostname: str, held: set) -> Optional[str]:
+        """Pick a PV for claim `key` reachable from hostname, or the empty
+        string for a dynamically-provisionable claim (nothing to reserve),
+        or None when the placement must fail."""
+        pvc = self.claims.get(key)
+        if pvc is None:
+            return None  # unknown claim — the cluster can't satisfy it
+        if pvc.volume_name:
+            pv = self.pvs.get(pvc.volume_name)
+            if pv is not None and pv.node in (None, hostname):
+                return pv.name
+            return None
+        if self._dynamic(pvc):
+            return ""  # provisioned after scheduling; feasible anywhere
+        for pv in self._candidates():
+            if pv.claim is not None and pv.claim != key:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if pv.node not in (None, hostname):
+                continue
+            if pv.name in held:
+                continue
+            return pv.name
+        return None
+
+    def _claim_keys(self, task) -> list:
+        ns = task.pod.namespace
+        return [f"{ns}/{c}" for c in getattr(task.pod, "volume_claims", ())]
+
+    # -- VolumeBinder seam ------------------------------------------------
+    def volume_feasible(self, task, hostname: str) -> bool:
+        keys = self._claim_keys(task)
+        if not keys:
+            return True
+        with self._lock:
+            held = self._reserved_pvs(excluding_task=task.uid)
+            picked: set = set()
+            for key in keys:
+                pv = self._resolve_k8s(key, hostname, held | picked)
+                if pv is None:
+                    return False
+                if pv:
+                    picked.add(pv)
+            return True
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        from kube_batch_tpu.framework.session import FitFailure
+
+        keys = self._claim_keys(task)
+        with self._lock:
+            self.reservations.pop(task.uid, None)
+            self._selected_node.pop(task.uid, None)
+            if not keys:
+                return
+            held = self._reserved_pvs(excluding_task=task.uid)
+            picked: Dict[str, str] = {}
+            for key in keys:
+                pv = self._resolve_k8s(key, hostname, held | set(picked.values()))
+                if pv is None:
+                    raise FitFailure(
+                        f"volume claim {key!r} has no PV reachable from {hostname}"
+                    )
+                # dynamic claims reserve the empty string: nothing to hold,
+                # but bind time still needs the claim key for the hand-off
+                picked[key] = pv
+            self._selected_node[task.uid] = hostname
+            self.reservations[task.uid] = picked
+
+    def release_task(self, task_uid: str) -> None:
+        with self._lock:
+            self.reservations.pop(task_uid, None)
+            self._selected_node.pop(task_uid, None)
+
+    def bind_volumes(self, task) -> None:
+        """Durable binding, ledger AND cluster: a static claim pre-binds its
+        PV by claimRef PATCH (BindPodVolumes' UpdatePV), a dynamic claim
+        gets the selected-node annotation so the PV controller provisions on
+        the chosen node (BindVolumes, cache.go:258-269).  Failed writes
+        queue and retry on later binds."""
+        # retry earlier failures FIRST — a write that just failed would
+        # almost surely fail again within the same call
+        self._flush_pending_writes()
+        with self._lock:
+            picked = self.reservations.pop(task.uid, None)
+            hostname = self._selected_node.pop(task.uid, None)
+            if not picked:
+                return
+            writes = []
+            for key, pv in picked.items():
+                ns, name = key.split("/", 1)
+                if pv:
+                    self.bound[key] = pv
+                    writes.append((
+                        f"/api/v1/persistentvolumes/{pv}",
+                        {"spec": {"claimRef": {
+                            "apiVersion": "v1",
+                            "kind": "PersistentVolumeClaim",
+                            "namespace": ns, "name": name,
+                        }}},
+                    ))
+                elif hostname:
+                    writes.append((
+                        f"/api/v1/namespaces/{ns}/persistentvolumeclaims/{name}",
+                        {"metadata": {"annotations": {
+                            SELECTED_NODE_ANNOTATION: hostname}}},
+                    ))
+        for path, body in writes:
+            self._cluster_write(path, body)
+
+    # -- throttled, retried cluster writes --------------------------------
+    def _cluster_write(self, path: str, body: dict) -> None:
+        if self.transport is None:
+            return
+        if self.bucket is not None:
+            self.bucket.take()
+        try:
+            self.transport.request(
+                "PATCH", path, body,
+                content_type="application/merge-patch+json", timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001 — queue for a later bind
+            logger.warning("volume write %s failed (%s); queued for retry",
+                           path, e)
+            with self._lock:
+                self._pending_writes.append((path, body))
+
+    def _flush_pending_writes(self) -> None:
+        with self._lock:
+            pending, self._pending_writes = self._pending_writes, []
+        for path, body in pending:
+            self._cluster_write(path, body)

@@ -328,23 +328,34 @@ def run(opt: ServerOption) -> None:
     # reference's writes share a single throttled rest.Config (server.go:69-70)
     bucket = TokenBucket(opt.kube_api_qps, opt.kube_api_burst)
     if k8s_mode:
+        from kube_batch_tpu.cache.volume import K8sPVLedger
         from kube_batch_tpu.k8s.bind import K8sBackend
-        from kube_batch_tpu.k8s.transport import in_cluster_auth
+        from kube_batch_tpu.k8s.transport import ApiTransport, in_cluster_auth
 
         auth = in_cluster_auth()
         backend = K8sBackend(opt.master, **auth)
         binder, evictor = backend, backend
         status_updater = RateLimitedStatusUpdater(backend, bucket=bucket)
+        # pv/pvc/storageclass watches feed this ledger; its claimRef /
+        # selected-node PATCHes ride the backend's own transport AND the
+        # same shared token bucket as every other egress write
+        volume_binder = K8sPVLedger(
+            transport=getattr(backend, "transport", None)
+            or ApiTransport(opt.master, **auth),
+            bucket=bucket,
+        )
     else:
         binder, evictor = FakeBinder(), FakeEvictor()
         status_updater = None  # cache default: recording fake
+        # real PV ledger behind /v1/persistentvolumes
+        volume_binder = StandalonePVBinder()
     cache = SchedulerCache(
         scheduler_name=opt.scheduler_name,
         default_queue=opt.default_queue,
         binder=RateLimitedBackend(binder, bucket=bucket),
         evictor=RateLimitedBackend(evictor, bucket=bucket),
         status_updater=status_updater,
-        volume_binder=StandalonePVBinder(),  # real PV ledger behind /v1/persistentvolumes
+        volume_binder=volume_binder,
         resolve_priority=opt.enable_priority_class,
     )
     on_cycle_end = None

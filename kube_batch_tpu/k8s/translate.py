@@ -26,6 +26,8 @@ from kube_batch_tpu.api.pod import (
     GROUP_NAME_ANNOTATION,
     Affinity,
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodAffinityTerm,
     PodDisruptionBudget,
@@ -358,6 +360,50 @@ def priority_class_from_k8s(obj: dict) -> PriorityClass:
     )
 
 
+def _pv_node_from_affinity(spec: dict) -> Optional[str]:
+    """A local PV's single reachable node, read from the
+    spec.nodeAffinity required terms (the kubernetes.io/hostname or
+    metadata.name expression local-storage provisioning writes); None for
+    network volumes reachable everywhere."""
+    required = ((spec.get("nodeAffinity") or {}).get("required") or {})
+    for term in required.get("nodeSelectorTerms") or []:
+        for e in _match_expressions(term):
+            key, op, values = e
+            if key == "kubernetes.io/hostname" and op == "In" and values:
+                return values[0]
+    return None
+
+
+def pv_from_k8s(obj: dict) -> PersistentVolume:
+    """v1.PersistentVolume JSON → ledger PV (cache.go:189-209 pv informer)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    claim_ref = spec.get("claimRef") or {}
+    claim = None
+    if claim_ref.get("name"):
+        claim = f"{claim_ref.get('namespace', 'default')}/{claim_ref['name']}"
+    return PersistentVolume(
+        name=meta.get("name", ""),
+        node=_pv_node_from_affinity(spec),
+        claim=claim,
+        storage_class=spec.get("storageClassName", ""),
+    )
+
+
+def pvc_from_k8s(obj: dict) -> PersistentVolumeClaim:
+    """v1.PersistentVolumeClaim JSON → ledger claim (pvc informer)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    return PersistentVolumeClaim(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        volume_name=spec.get("volumeName") or None,
+        storage_class=spec.get("storageClassName", ""),
+        phase=status.get("phase", "Pending"),
+    )
+
+
 # watch "kind" → (translator, cache add, cache update, cache delete)
 def apply_event(cache, kind: str, event_type: str, obj: dict) -> None:
     """Dispatch one watch event into the cache — the informer handler seam
@@ -405,5 +451,32 @@ def apply_event(cache, kind: str, event_type: str, obj: dict) -> None:
             )
         else:
             cache.add_priority_class(priority_class_from_k8s(obj))
+    elif kind == "persistentvolumes":
+        # PV ledger seam (cache.go:189-209); a binder without the ingest
+        # methods (the no-op fake) silently drops them, like the reference's
+        # fake volume binder
+        binder = cache.volume_binder
+        if deleted:
+            getattr(binder, "delete_pv", lambda _n: None)(
+                (obj.get("metadata") or {}).get("name", "")
+            )
+        else:
+            getattr(binder, "add_pv", lambda _pv: None)(pv_from_k8s(obj))
+    elif kind == "persistentvolumeclaims":
+        binder = cache.volume_binder
+        pvc = pvc_from_k8s(obj)
+        if deleted:
+            getattr(binder, "delete_pvc", lambda _k: None)(pvc.key())
+        else:
+            getattr(binder, "add_pvc", lambda _p: None)(pvc)
+    elif kind == "storageclasses":
+        binder = cache.volume_binder
+        name = (obj.get("metadata") or {}).get("name", "")
+        if deleted:
+            getattr(binder, "delete_storage_class", lambda _n: None)(name)
+        else:
+            getattr(binder, "add_storage_class", lambda _n, _p: None)(
+                name, obj.get("provisioner", "")
+            )
     else:
         logger.warning("unknown watch kind %r ignored", kind)

@@ -34,6 +34,10 @@ RESOURCES: Dict[str, str] = {
     "queues": "/apis/scheduling.incubator.k8s.io/v1alpha1/queues",
     "poddisruptionbudgets": "/apis/policy/v1/poddisruptionbudgets",
     "priorityclasses": "/apis/scheduling.k8s.io/v1/priorityclasses",
+    # the volume-binder feed (cache.go:189-209,258-269,311-320)
+    "persistentvolumes": "/api/v1/persistentvolumes",
+    "persistentvolumeclaims": "/api/v1/persistentvolumeclaims",
+    "storageclasses": "/apis/storage.k8s.io/v1/storageclasses",
 }
 
 
@@ -136,6 +140,30 @@ class WatchAdapter:
                 ]
             for uid in stale_uids:
                 cache.delete_pod_group(uid)
+        elif kind == "persistentvolumes":
+            binder = cache.volume_binder
+            pvs = getattr(binder, "pvs", None)
+            if pvs is not None:
+                listed = {(i.get("metadata") or {}).get("name", "") for i in items}
+                for name in [n for n in list(pvs) if n not in listed]:
+                    binder.delete_pv(name)
+        elif kind == "persistentvolumeclaims":
+            binder = cache.volume_binder
+            claims = getattr(binder, "claims", None)
+            if claims is not None:
+                listed = names()
+                for key in [k for k in list(claims) if k not in listed]:
+                    binder.delete_pvc(key)
+        elif kind == "storageclasses":
+            # no other object's events touch the class ledger — a stale
+            # provisioner entry would keep its claims "dynamically
+            # provisionable" forever
+            binder = cache.volume_binder
+            classes = getattr(binder, "storage_classes", None)
+            if classes is not None:
+                listed = {(i.get("metadata") or {}).get("name", "") for i in items}
+                for name in [n for n in list(classes) if n not in listed]:
+                    binder.delete_storage_class(name)
         # priorityclasses/pdbs: stale entries are harmless until their next
         # watch event; deletions reconcile through the objects they affect
 
