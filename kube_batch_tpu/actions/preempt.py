@@ -107,28 +107,31 @@ class PreemptAction(Action):
             # custom task_order) says the best pending task outranks the
             # worst running one. The creation-index tie-break deliberately
             # does NOT open the gate: evicting an equal-rank sibling for its
-            # slot is zero-gain work.
-            to = ssn.task_order_fn
-            best_p = None
-            for t in pending.values():
-                if best_p is None or to(t, best_p):
-                    best_p = t
-            worst_r = None
-            for t in running.values():
-                if worst_r is None or to(worst_r, t):
-                    worst_r = t
-            verdict = ssn.task_order_plugin_verdict(best_p, worst_r)
-            if verdict == 0:
-                # no task-order plugin voted (e.g. priority disabled in
-                # conf): fall back to comparing the extreme raw priorities —
-                # NOT best_p/worst_r, which were picked by the degenerate
-                # creation-order comparator and need not carry the extreme
-                # priorities
-                hi = max(t.priority for t in pending.values())
-                lo = min(t.priority for t in running.values())
-                verdict = -1 if hi > lo else 1
-            if verdict >= 0:
-                continue  # nothing to rebalance
+            # slot is zero-gain work.  `preempt.referenceExact: "true"` on
+            # any conf tier restores the reference's ungated phase 2
+            # (PARITY.md "known divergences").
+            if not ssn.conf_flag("preempt.referenceExact"):
+                to = ssn.task_order_fn
+                best_p = None
+                for t in pending.values():
+                    if best_p is None or to(t, best_p):
+                        best_p = t
+                worst_r = None
+                for t in running.values():
+                    if worst_r is None or to(worst_r, t):
+                        worst_r = t
+                verdict = ssn.task_order_plugin_verdict(best_p, worst_r)
+                if verdict == 0:
+                    # no task-order plugin voted (e.g. priority disabled in
+                    # conf): fall back to comparing the extreme raw
+                    # priorities — NOT best_p/worst_r, which were picked by
+                    # the degenerate creation-order comparator and need not
+                    # carry the extreme priorities
+                    hi = max(t.priority for t in pending.values())
+                    lo = min(t.priority for t in running.values())
+                    verdict = -1 if hi > lo else 1
+                if verdict >= 0:
+                    continue  # nothing to rebalance
             tq = PriorityQueue(less=ssn.task_order_fn)
             for task in pending.values():
                 tq.push(task)

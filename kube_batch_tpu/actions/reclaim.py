@@ -92,6 +92,9 @@ def solve_claims(ssn, mode: str):
     names = getattr(ssn, "action_names", None)
     idle_gate = (
         mode == "reclaim"
+        # `reclaim.referenceExact: "true"` restores reclaim.go's behavior:
+        # evict even for claimants free capacity could satisfy (PARITY.md)
+        and not ssn.conf_flag("reclaim.referenceExact")
         and not ssn.host_only_predicates
         and names is not None
         and "allocate" in names

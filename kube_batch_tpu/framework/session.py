@@ -266,6 +266,19 @@ class Session:
     def plugin_enabled(self, name: str) -> bool:
         return any(opt.name == name for tier in self.tiers for opt in tier.plugins)
 
+    def conf_flag(self, key: str, default: bool = False) -> bool:
+        """A free-form boolean argument searched across every tier's plugin
+        Arguments (arguments.go:26-66) — the conf surface for action-level
+        toggles: `allocate.pallas`, and the sanctioned-divergence escape
+        hatches `preempt.referenceExact` / `reclaim.referenceExact`
+        (PARITY.md "known divergences")."""
+        for tier in self.tiers:
+            for opt in tier.plugins:
+                v = opt.arguments.get(key)
+                if v is not None:
+                    return str(v).strip().lower() in ("1", "true", "yes")
+        return default
+
     def enabled_plugin_names(self, kind: str) -> set:
         """Names of plugins with an enabled fn of `kind` registered — lets the
         vectorized allocate replay prove the gang arithmetic gate is the only

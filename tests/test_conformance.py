@@ -987,3 +987,173 @@ tiers:
         run_actions(cache, conf_text=conf_no_priority,
                     action_names=["preempt"])
         assert len(cache.evictor.evicts) == 0
+
+    def test_reference_exact_restores_ungated_phase2(self):
+        """`preempt.referenceExact: "true"` on any conf tier restores
+        preempt.go:145-174's unconditional phase 2: the equal-rank pending
+        sibling DOES evict a running one (the churn the gate avoids)."""
+        conf_exact = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+    arguments:
+      preempt.referenceExact: "true"
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: proportion
+  - name: nodeorder
+  - name: predicates
+"""
+        cache = self._cache(pending_priority=0)
+        run_actions(cache, conf_text=conf_exact, action_names=["preempt"])
+        assert len(cache.evictor.evicts) == 1
+        assert next(iter(cache.evictor.evicts)).startswith("c1/run-")
+
+
+class TestReclaimReferenceExact:
+    """`reclaim.referenceExact: "true"` disables the idle-fit claimant gate
+    (the PARITY.md reclaim divergence): like reclaim.go:107-199, a
+    cross-queue victim is evicted even when free capacity could satisfy the
+    claimant."""
+
+    def _cache(self):
+        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Node, Pod
+
+        cache = build_cache(queues=[])
+        from kube_batch_tpu.api.pod import Queue
+
+        cache.add_queue(Queue(name="q0", weight=1))
+        cache.add_queue(Queue(name="q1", weight=3))
+        # free cpu for the claimant AND a cross-queue victim on the node
+        cache.add_node(Node(name="n1", allocatable={
+            "cpu": 4000.0, "memory": float(64 * GiB), "pods": 110.0}))
+        cache.add_pod_group(PodGroup(name="r", namespace="b", min_member=1,
+                                     queue="q0", creation_index=0))
+        cache.add_pod(Pod(name="r", namespace="b",
+                          requests={"cpu": 1000.0, "memory": float(GiB)},
+                          annotations={GROUP_NAME_ANNOTATION: "r"},
+                          phase=PodPhase.RUNNING, node_name="n1",
+                          creation_index=0))
+        cache.add_pod_group(PodGroup(name="p", namespace="b", min_member=1,
+                                     queue="q1", creation_index=1))
+        cache.add_pod(Pod(name="p", namespace="b",
+                          requests={"cpu": 1000.0, "memory": float(GiB)},
+                          annotations={GROUP_NAME_ANNOTATION: "p"},
+                          phase=PodPhase.PENDING, creation_index=1))
+        return cache
+
+    CONF = """
+actions: "reclaim, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+{ARG}
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: proportion
+  - name: nodeorder
+  - name: predicates
+"""
+
+    def _run(self, cache, exact: bool):
+        from kube_batch_tpu.framework.conf import parse_scheduler_conf
+        from kube_batch_tpu.scheduler import Scheduler
+
+        arg = ('    arguments:\n'
+               '      reclaim.referenceExact: "true"') if exact else ""
+        conf = parse_scheduler_conf(self.CONF.replace("{ARG}", arg))
+        sched = Scheduler(cache, conf=conf)
+        sched.run_once()
+        cache.flush_binds()
+
+    def test_gate_on_no_eviction(self):
+        """Default: the claimant fits idle, so allocate places it and the
+        victim survives (the declared improvement)."""
+        cache = self._cache()
+        self._run(cache, exact=False)
+        assert not cache.evictor.evicts
+        assert "b/p" in cache.binder.binds
+
+    def test_reference_exact_evicts_like_the_reference(self):
+        """With the escape hatch, reclaim evicts the cross-queue victim for
+        the claimant even though free capacity could satisfy it —
+        reclaim.go's exact behavior."""
+        cache = self._cache()
+        self._run(cache, exact=True)
+        assert "b/r" in cache.evictor.evicts, cache.evictor.evicts
+
+
+class TestRealRequestBackfill:
+    """BEYOND-REFERENCE (backfill.go:87's own TODO): real-request tasks fill
+    capacity stranded by host-side gang discards.  The batched solve gave
+    the capacity to gang G; G's volume claims failed host-side and its
+    Statement discarded, leaving the freed capacity stranded for the rest
+    of the cycle.  The reference's backfill (BestEffort-only) could never
+    perform this fill; ours re-solves over gang-safe claimants."""
+
+    def _cache(self):
+        pods = []
+        # gang G: 4 x 1000m with unsatisfiable volume claims — the device
+        # places it, the host volume pre-check demotes, the slow replay
+        # discards (no PV exists anywhere)
+        for i in range(4):
+            pods.append(build_pod(
+                "c1", f"g-{i}", None, PodPhase.PENDING,
+                {"cpu": 1000, "memory": GiB}, group_name="g",
+                volume_claims=("no-such-pv",),
+            ))
+        # singleton S, created later (worse rank): crowded out by G in the
+        # main solve
+        pods.append(build_pod("c1", "s-0", None, PodPhase.PENDING,
+                              {"cpu": 1000, "memory": GiB}, group_name="s"))
+        return _cache_with_pv_binder(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="g", namespace="c1", min_member=4,
+                         queue="default", creation_index=1),
+                PodGroup(name="s", namespace="c1", min_member=1,
+                         queue="default", creation_index=2),
+            ],
+            nodes=[build_node("n1", cpu=4000, mem=16 * GiB)],
+            pods=pods,
+        )
+
+    def test_stranded_capacity_backfilled(self):
+        cache = self._cache()
+        ssn = run_actions(cache, action_names=["allocate", "backfill"])
+        from kube_batch_tpu.framework.interface import get_action
+
+        assert get_action("allocate").last_host_discards == 1
+        # G discarded entirely; S backfilled into the freed capacity
+        assert set(cache.binder.binds) == {"c1/s-0"}
+        assert not cache.evictor.evicts
+        errs = cache.columns.check_consistency(cache)
+        assert not errs, errs[:3]
+
+    def test_flag_off_leaves_capacity_stranded(self):
+        """`backfill.realRequests: "false"` restores the reference-shaped
+        behavior: the stranded task waits for the next cycle."""
+        conf_off = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+    arguments:
+      backfill.realRequests: "false"
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: proportion
+  - name: nodeorder
+  - name: predicates
+"""
+        cache = self._cache()
+        run_actions(cache, conf_text=conf_off,
+                    action_names=["allocate", "backfill"])
+        assert not cache.binder.binds  # s-0 stranded until next cycle
