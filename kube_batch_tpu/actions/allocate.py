@@ -49,12 +49,49 @@ def _pallas_enabled(ssn) -> bool:
     """Opt into the fused Pallas round-head kernel via an `allocate.pallas`
     argument on any conf tier plugin (Arguments are free-form string maps,
     arguments.go:26-66) or env KB_PALLAS=1 (pallas_kernels.py)."""
-    for tier in ssn.tiers:
-        for opt in tier.plugins:
-            v = opt.arguments.get("allocate.pallas")
-            if v is not None:
-                return str(v).strip().lower() in ("1", "true", "yes")
-    return os.environ.get("KB_PALLAS", "").lower() in ("1", "true", "yes")
+    env = os.environ.get("KB_PALLAS", "").lower() in ("1", "true", "yes")
+    return ssn.conf_flag("allocate.pallas", default=env)
+
+
+def build_session_snapshot(ssn):
+    """(DeviceSnapshot, meta) for the session — columnar row space when the
+    session is exclusive, object rebuild for isolated sessions.  Shared by
+    execute() and the backfill real-request pass so both solve the
+    identically-constructed problem."""
+    cols = ssn.columns
+    if cols is not None:
+        return cols.device_snapshot(ssn)
+    cluster = ClusterInfo(ssn.spec)
+    cluster.nodes = ssn.nodes
+    cluster.queues = ssn.queues
+    cluster.jobs = ssn.jobs
+    return build_snapshot(cluster, excluded_nodes=ssn.session_excluded_nodes)
+
+
+def session_allocate_config(ssn) -> AllocateConfig:
+    """The solve configuration a session implies (plugin enables + opt-ins)."""
+    from kube_batch_tpu.ops.scoring import ScoreWeights  # noqa: F401 — doc
+
+    return AllocateConfig(
+        gang=ssn.plugin_enabled("gang"),
+        drf=ssn.plugin_enabled("drf"),
+        proportion=ssn.plugin_enabled("proportion"),
+        use_pallas=_pallas_enabled(ssn),
+        weights=ssn.score_weights,
+    )
+
+
+def dispatch_allocate_solve(snap, config):
+    """Shard-or-local solve dispatch; returns (result, mode)."""
+    from kube_batch_tpu.parallel.mesh import (
+        default_mesh,
+        sharded_allocate_solve,
+        should_shard,
+    )
+
+    if should_shard(snap.node_alloc.shape[0]):
+        return sharded_allocate_solve(snap, config, default_mesh()), "sharded"
+    return allocate_solve(snap, config), "single"
 
 
 class AllocateAction(Action):
@@ -68,6 +105,11 @@ class AllocateAction(Action):
         self.last_solve_mode = "single"
         # fallback pressure of the most recent execute() (VERDICT r2 #6)
         self.last_fallback: Dict[str, int] = {}
+        # jobs whose placements were DISCARDED host-side this execute()
+        # (slow-replay JobReady failures, volume demotion dead-ends): their
+        # freed capacity is stranded for the rest of the cycle unless the
+        # backfill action's real-request pass re-offers it
+        self.last_host_discards = 0
         self._host_place_count = 0
         self._n_applied = 0
         self._ports_by_node: Optional[Dict[int, set]] = None
@@ -75,6 +117,7 @@ class AllocateAction(Action):
     def execute(self, ssn) -> None:
         self.last_phase_ms = {}
         self.last_fallback = {}
+        self.last_host_discards = 0
         self._host_place_count = 0
         self._n_applied = 0
         self._ports_by_node = None
@@ -96,42 +139,14 @@ class AllocateAction(Action):
             self.last_phase_ms = {"snapshot_build": 0.0, "solve": 0.0,
                                   "fit_errors": 0.0, "replay": 0.0}
             return
-        if cols is not None:
-            # persistent columnar host model: row space == device axis, no
-            # per-object rebuild (api/columns.py)
-            snap, meta = cols.device_snapshot(ssn)
-        else:
-            # isolated (deep-clone) sessions rebuild from objects
-            cluster = ClusterInfo(ssn.spec)
-            cluster.nodes = ssn.nodes
-            cluster.queues = ssn.queues
-            cluster.jobs = ssn.jobs
-            snap, meta = build_snapshot(
-                cluster, excluded_nodes=ssn.session_excluded_nodes
-            )
+        snap, meta = build_session_snapshot(ssn)
         t1 = time.perf_counter()
-        config = AllocateConfig(
-            gang=ssn.plugin_enabled("gang"),
-            drf=ssn.plugin_enabled("drf"),
-            proportion=ssn.plugin_enabled("proportion"),
-            use_pallas=_pallas_enabled(ssn),
-            weights=ssn.score_weights,
-        )
         # multi-chip parts shard the node axis over the ICI mesh — the
         # production analog of the reference's always-on 16-worker fan-out
         # (scheduler_helper.go:34-64); single-chip or small-N stays local
-        from kube_batch_tpu.parallel.mesh import (
-            default_mesh,
-            sharded_allocate_solve,
-            should_shard,
+        result, self.last_solve_mode = dispatch_allocate_solve(
+            snap, session_allocate_config(ssn)
         )
-
-        if should_shard(snap.node_alloc.shape[0]):
-            result = sharded_allocate_solve(snap, config, default_mesh())
-            self.last_solve_mode = "sharded"
-        else:
-            result = allocate_solve(snap, config)
-            self.last_solve_mode = "single"
         # one blocking transfer for everything the host reads
         assigned, pipelined = jax.device_get(
             (result.assigned, result.pipelined)
@@ -640,6 +655,7 @@ class AllocateAction(Action):
                 "job %s not ready after device solve (%d placements), discarding",
                 job.uid, int(idxs.size),
             )
+            self.last_host_discards += 1
             stmt.discard()
 
     def _record_fit_errors(self, ssn, meta, fail_hist, assigned, task_job, pending) -> None:

@@ -1,20 +1,41 @@
 """backfill action (actions/backfill/backfill.go:42-93): place BestEffort
 tasks (empty InitResreq) on the first node passing the plugin predicates —
-no scoring, immediate allocate. Non-BestEffort backfill remains the
-reference's acknowledged TODO (backfill.go:87)."""
+no scoring, immediate allocate.
+
+BEYOND-REFERENCE: non-BestEffort backfill — the reference's own acknowledged
+TODO (backfill.go:87).  When the allocate replay discarded placements
+host-side (a gang that failed its JobReady gate after host predicate
+rejections, a volume-demoted job that could not re-place), the capacity
+those discards freed is stranded for the rest of the cycle: the device solve
+already ran and the reference's sequential loop has likewise moved on.  The
+real-request pass re-runs the allocate solve over the live post-replay
+snapshot, restricted to GANG-SAFE claimants — jobs already at or above
+MinAvailable, or non-gangs (MinAvailable ≤ 1) — so no partial gang can ever
+commit, and replays the result through the standard vectorized path.
+Disabled with `backfill.realRequests: "false"` on any conf tier.
+Pinned by tests/test_conformance.py TestRealRequestBackfill."""
 
 from __future__ import annotations
 
+import logging
+
 from kube_batch_tpu.api.job_info import FitError, FitErrors
 from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
-from kube_batch_tpu.framework.interface import Action
+from kube_batch_tpu.framework.interface import Action, get_action
 from kube_batch_tpu.framework.session import FitFailure
+
+logger = logging.getLogger("kube_batch_tpu")
 
 
 class BackfillAction(Action):
     name = "backfill"
 
     def execute(self, ssn) -> None:
+        self._best_effort(ssn)
+        self._real_requests(ssn)
+
+    # ---- reference semantics: BestEffort first-fit ----------------------
+    def _best_effort(self, ssn) -> None:
         for job in ssn.jobs.values():
             if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
                 continue
@@ -35,3 +56,71 @@ class BackfillAction(Action):
                     break
                 else:
                     job.nodes_fit_errors[task.uid] = fit_errors
+
+    # ---- beyond-reference: stranded-capacity real-request pass ----------
+    def _real_requests(self, ssn) -> None:
+        if not ssn.jobs or not ssn.nodes:
+            return
+        if not ssn.conf_flag("backfill.realRequests", default=True):
+            return
+        # the pass re-pays a full [T, N] solve, so it only runs when the
+        # allocate action actually stranded capacity this cycle; without
+        # that signal the post-allocate pending set is exactly the set the
+        # solve just failed, and re-solving is wasted work
+        try:
+            alloc = get_action("allocate")
+        except KeyError:
+            return
+        if not getattr(alloc, "last_host_discards", 0):
+            return
+        import jax
+        import numpy as np
+
+        from kube_batch_tpu.actions.allocate import (
+            AllocateAction,
+            build_session_snapshot,
+            dispatch_allocate_solve,
+            session_allocate_config,
+        )
+
+        cols = ssn.columns
+        if cols is not None and not cols.has_schedulable_pending():
+            return
+        snap, meta = build_session_snapshot(ssn)
+        # gang-safe claimants only: a job at/above MinAvailable can take
+        # extra placements without atomicity risk; a MinAvailable ≤ 1 job is
+        # not a gang.  An unready gang stays excluded — committing part of
+        # it is exactly what allocate's discard just prevented.
+        safe_np = (
+            (np.asarray(snap.job_min_avail) <= 1)
+            | (np.asarray(snap.job_ready) >= np.asarray(snap.job_min_avail))
+        ) & np.asarray(snap.job_schedulable)
+        # cheap host pre-check BEFORE the [T, N] solve: the common trigger —
+        # a discarded unready gang being the only pending work — must not
+        # re-pay the cycle's dominant cost for a guaranteed-empty result
+        task_job = np.asarray(snap.task_job)[: meta.n_tasks]
+        eligible = (
+            np.asarray(snap.task_pending)[: meta.n_tasks]
+            & np.asarray(snap.task_valid)[: meta.n_tasks]
+            & np.asarray(snap.job_valid)[task_job]
+            & safe_np[task_job]
+        )
+        if not eligible.any():
+            return
+        import jax.numpy as jnp
+
+        snap = snap._replace(
+            job_schedulable=snap.job_schedulable & jnp.asarray(safe_np)
+        )
+        result, _mode = dispatch_allocate_solve(snap, session_allocate_config(ssn))
+        assigned, pipelined = jax.device_get((result.assigned, result.pipelined))
+        assigned = assigned[: meta.n_tasks]
+        pipelined = pipelined[: meta.n_tasks]
+        if not (assigned >= 0).any():
+            return
+        n = int((assigned >= 0).sum())
+        logger.info("backfill real-request pass placing %d stranded tasks", n)
+        # replay through a throwaway action instance so the allocate
+        # action's recorded phases/fallback stay those of the main pass
+        helper = AllocateAction()
+        helper._replay(ssn, snap, meta, assigned, pipelined, task_job)

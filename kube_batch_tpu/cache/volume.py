@@ -180,6 +180,10 @@ class K8sPVLedger(StandalonePVBinder):
       token bucket and failed writes queue for retry on later binds
     """
 
+    # failed cluster writes kept for retry — bounded so an apiserver outage
+    # can't grow the queue (and replay staleness) without limit
+    MAX_PENDING_WRITES = 256
+
     def __init__(self, transport=None, bucket=None):
         super().__init__()
         self.claims: Dict[str, PersistentVolumeClaim] = {}
@@ -188,6 +192,7 @@ class K8sPVLedger(StandalonePVBinder):
         self.bucket = bucket  # shared egress TokenBucket (cmd/server.py)
         self._selected_node: Dict[str, str] = {}  # task uid → chosen host
         self._pending_writes: list = []  # failed PATCHes awaiting retry
+        self._writer = None  # lazy single-thread pool for cluster writes
 
     # -- ingest (pvc / storageclass informer analogs) --------------------
     def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
@@ -226,8 +231,13 @@ class K8sPVLedger(StandalonePVBinder):
         pvc = self.claims.get(key)
         if pvc is None:
             return None  # unknown claim — the cluster can't satisfy it
-        if pvc.volume_name:
-            pv = self.pvs.get(pvc.volume_name)
+        # a binding we already made locally wins even before the PVC watch
+        # round-trips spec.volumeName back (the claimRef PATCH is in
+        # flight): without this, the claim's own PV sits in the held set
+        # and the claim reads as unsatisfiable everywhere
+        bound_pv = self.bound.get(key) or pvc.volume_name
+        if bound_pv:
+            pv = self.pvs.get(bound_pv)
             if pv is not None and pv.node in (None, hostname):
                 return pv.name
             return None
@@ -299,9 +309,6 @@ class K8sPVLedger(StandalonePVBinder):
         gets the selected-node annotation so the PV controller provisions on
         the chosen node (BindVolumes, cache.go:258-269).  Failed writes
         queue and retry on later binds."""
-        # retry earlier failures FIRST — a write that just failed would
-        # almost surely fail again within the same call
-        self._flush_pending_writes()
         with self._lock:
             picked = self.reservations.pop(task.uid, None)
             hostname = self._selected_node.pop(task.uid, None)
@@ -326,28 +333,48 @@ class K8sPVLedger(StandalonePVBinder):
                         {"metadata": {"annotations": {
                             SELECTED_NODE_ANNOTATION: hostname}}},
                     ))
-        for path, body in writes:
-            self._cluster_write(path, body)
+        if writes and self.transport is not None:
+            # the writes run OFF-CYCLE on a single worker (the cache's pod
+            # binds are likewise async, cache.go:478-484): a slow apiserver
+            # must not stall the scheduling cycle's bind loop.  Earlier
+            # failures retry first (ordering preserved by the 1-thread pool).
+            self._submit_writes(writes)
 
-    # -- throttled, retried cluster writes --------------------------------
-    def _cluster_write(self, path: str, body: dict) -> None:
-        if self.transport is None:
-            return
-        if self.bucket is not None:
-            self.bucket.take()
-        try:
-            self.transport.request(
-                "PATCH", path, body,
-                content_type="application/merge-patch+json", timeout=10,
+    def drain_writes(self) -> None:
+        """Block until every submitted cluster write ran (tests, shutdown)."""
+        if self._writer is not None:
+            self._writer.submit(lambda: None).result()
+
+    # -- throttled, retried, OFF-CYCLE cluster writes ---------------------
+    def _submit_writes(self, writes) -> None:
+        if self._writer is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._writer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pv-writes"
             )
-        except Exception as e:  # noqa: BLE001 — queue for a later bind
-            logger.warning("volume write %s failed (%s); queued for retry",
-                           path, e)
-            with self._lock:
-                self._pending_writes.append((path, body))
+        self._writer.submit(self._run_writes, writes)
 
-    def _flush_pending_writes(self) -> None:
+    def _run_writes(self, writes) -> None:
         with self._lock:
             pending, self._pending_writes = self._pending_writes, []
-        for path, body in pending:
-            self._cluster_write(path, body)
+        for path, body in pending + list(writes):
+            if self.bucket is not None:
+                self.bucket.take()
+            try:
+                self.transport.request(
+                    "PATCH", path, body,
+                    content_type="application/merge-patch+json", timeout=10,
+                )
+            except Exception as e:  # noqa: BLE001 — queue for a later flush
+                logger.warning("volume write %s failed (%s); queued for retry",
+                               path, e)
+                with self._lock:
+                    self._pending_writes.append((path, body))
+                    overflow = len(self._pending_writes) - self.MAX_PENDING_WRITES
+                    if overflow > 0:
+                        del self._pending_writes[:overflow]
+                        logger.warning(
+                            "volume write retry queue full; dropped %d oldest "
+                            "(next cycles re-derive bindings)", overflow,
+                        )

@@ -540,6 +540,7 @@ class TestVolumeK8sMode:
         static = T("s", "ml", ("train-data",))
         led.allocate_volumes(static, "node-b")
         led.bind_volumes(static)
+        led.drain_writes()  # cluster writes run off-cycle on a worker
         assert tr.requests[-1][1] == "/api/v1/persistentvolumes/pv-ssd-b"
         assert tr.requests[-1][2]["spec"]["claimRef"]["name"] == "train-data"
         # an unbound PVC MODIFIED event must NOT clear the in-flight binding
@@ -550,12 +551,14 @@ class TestVolumeK8sMode:
         led.allocate_volumes(dyn, "node-a")
         tr.fail_next = 1
         led.bind_volumes(dyn)  # PATCH fails -> queued
+        led.drain_writes()
         assert led._pending_writes
         # next bind flushes the queue (retry runs before new writes)
         led.bound.pop("ml/train-data")
         led.add_pvc(pvc_from_k8s(FIXTURES["pvc_unbound"]))
         led.allocate_volumes(static, "node-b")
         led.bind_volumes(static)
+        led.drain_writes()
         assert not led._pending_writes
         ann = [r for r in tr.requests
                if "persistentvolumeclaims/scratch" in r[1]]
