@@ -69,9 +69,8 @@ def build_session_snapshot(ssn):
 
 
 def session_allocate_config(ssn) -> AllocateConfig:
-    """The solve configuration a session implies (plugin enables + opt-ins)."""
-    from kube_batch_tpu.ops.scoring import ScoreWeights  # noqa: F401 — doc
-
+    """The solve configuration a session implies (plugin enables + opt-ins);
+    `weights` is the session's ScoreWeights (ops/scoring.py)."""
     return AllocateConfig(
         gang=ssn.plugin_enabled("gang"),
         drf=ssn.plugin_enabled("drf"),

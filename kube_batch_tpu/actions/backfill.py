@@ -84,8 +84,21 @@ class BackfillAction(Action):
         )
 
         cols = ssn.columns
-        if cols is not None and not cols.has_schedulable_pending():
-            return
+        if cols is not None:
+            if not cols.has_schedulable_pending():
+                return
+        else:
+            # isolated sessions: object-level pre-gate before paying the
+            # full snapshot rebuild — any gang-safe job with pending tasks?
+            def _safe_pending(job):
+                if job.pod_group and job.pod_group.phase == PodGroupPhase.PENDING:
+                    return False
+                if not job.task_status_index.get(TaskStatus.PENDING):
+                    return False
+                return job.min_available <= 1 or job.ready()
+
+            if not any(_safe_pending(j) for j in ssn.jobs.values()):
+                return
         snap, meta = build_session_snapshot(ssn)
         # gang-safe claimants only: a job at/above MinAvailable can take
         # extra placements without atomicity risk; a MinAvailable ≤ 1 job is

@@ -309,12 +309,10 @@ class K8sPVLedger(StandalonePVBinder):
         gets the selected-node annotation so the PV controller provisions on
         the chosen node (BindVolumes, cache.go:258-269).  Failed writes
         queue and retry on later binds."""
+        writes = []
         with self._lock:
-            picked = self.reservations.pop(task.uid, None)
+            picked = self.reservations.pop(task.uid, None) or {}
             hostname = self._selected_node.pop(task.uid, None)
-            if not picked:
-                return
-            writes = []
             for key, pv in picked.items():
                 ns, name = key.split("/", 1)
                 if pv:
@@ -333,11 +331,14 @@ class K8sPVLedger(StandalonePVBinder):
                         {"metadata": {"annotations": {
                             SELECTED_NODE_ANNOTATION: hostname}}},
                     ))
-        if writes and self.transport is not None:
+        if (writes or self._pending_writes) and self.transport is not None:
             # the writes run OFF-CYCLE on a single worker (the cache's pod
             # binds are likewise async, cache.go:478-484): a slow apiserver
             # must not stall the scheduling cycle's bind loop.  Earlier
-            # failures retry first (ordering preserved by the 1-thread pool).
+            # failures retry first (ordering preserved by the 1-thread
+            # pool), and a bind with NO new writes still flushes the retry
+            # queue — a stranded claimRef PATCH must not wait for another
+            # volume-carrying bind that may never come.
             self._submit_writes(writes)
 
     def drain_writes(self) -> None:

@@ -27,7 +27,9 @@ from typing import Optional
 
 import jax
 
-from kube_batch_tpu.parallel.mesh import make_mesh
+# NOTE: no top-level kube_batch_tpu.parallel.mesh import — its import chain
+# (ops.assignment's module-level jnp constants) initialises the XLA backend,
+# which must not happen before jax.distributed.initialize runs
 
 
 def initialize(
@@ -37,8 +39,17 @@ def initialize(
 ) -> None:
     """jax.distributed.initialize wrapper. With no arguments, relies on the
     environment (TPU pod auto-configuration); no-op when already
-    initialized or single-process."""
-    if jax.process_count() > 1:
+    initialized or single-process.
+
+    The already-initialized probe must NOT touch the backend:
+    jax.process_count() would initialise XLA and make a subsequent
+    jax.distributed.initialize impossible (the bug the two-process smoke
+    test pinned, tests/test_distributed.py).  jax.distributed.is_initialized
+    checks only the coordination-service client — backend-safe, and a
+    failed earlier attempt (which leaves coordinator_address residue but no
+    client) stays retryable."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
         return  # already initialized
     if coordinator is None and num_processes is None:
         try:
@@ -58,4 +69,6 @@ def global_mesh():
     cluster. Device order follows jax.devices(), so the mesh axis is
     contiguous per host — node shards stay host-local and the all-reduces
     are hierarchical (ICI within a host, DCN across)."""
+    from kube_batch_tpu.parallel.mesh import make_mesh
+
     return make_mesh(None)
