@@ -15,12 +15,18 @@ import sys
 
 def main() -> None:
     coordinator, rank = sys.argv[1], int(sys.argv[2])
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
+    # envutil owns the axon workaround: env hardening BEFORE the first jax
+    # import, plus deregistration of the axon PJRT factory sitecustomize may
+    # already have registered at interpreter start (a wedged tunnel would
+    # otherwise hang backend init even on CPU)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from kube_batch_tpu.envutil import (
+        apply_hardened_cpu_env,
+        deregister_axon_backend,
     )
+
+    apply_hardened_cpu_env(n_devices=4)
+    deregister_axon_backend()
     import jax
 
     from kube_batch_tpu.parallel.distributed import global_mesh, initialize

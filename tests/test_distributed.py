@@ -25,13 +25,18 @@ def _free_port() -> int:
 
 @pytest.mark.slow
 def test_two_process_global_mesh_solve_matches_single():
+    from kube_batch_tpu.envutil import hardened_cpu_env
+
     coordinator = f"127.0.0.1:{_free_port()}"
-    env = {
+    stripped = {
         k: v for k, v in os.environ.items()
         # each worker sets its own backend env; inherited JAX/XLA settings
         # (the conftest's 8-device flag) must not leak in
         if not k.startswith(("JAX_", "XLA_"))
     }
+    # harden BEFORE the child interpreter starts: sitecustomize acts on the
+    # env at startup, earlier than any code the worker itself runs
+    env = hardened_cpu_env(n_devices=4, base=stripped)
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]

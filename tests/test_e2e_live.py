@@ -1,0 +1,28 @@
+"""Runs the live-apiserver e2e driver (kube_batch_tpu/testing/e2e.py) in
+--stub mode: the REAL CLI scheduler process in --master mode against a real
+HTTP apiserver (the kubelet-simulating stub), executing the reference's
+core scenarios (test/e2e/job.go:82,118,189; queue.go:26,458).
+
+Against an actual cluster:  python -m kube_batch_tpu.testing.e2e --master URL
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_e2e_scenarios_against_stub_apiserver():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.testing.e2e", "--stub"],
+        capture_output=True, text=True, timeout=560, env=env, cwd=repo,
+    )
+    assert r.returncode == 0, f"e2e driver failed:\n{r.stdout[-6000:]}\n{r.stderr[-2000:]}"
+    assert "5/5 scenarios passed" in r.stdout, r.stdout[-3000:]
