@@ -17,13 +17,19 @@ SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 def in_cluster_auth() -> Dict[str, Optional[str]]:
-    """token_file/ca_file kwargs for the mounted serviceaccount, when present."""
-    token = f"{SERVICEACCOUNT_DIR}/token"
-    ca = f"{SERVICEACCOUNT_DIR}/ca.crt"
-    return {
+    """token_file/ca_file/insecure kwargs for the cluster transport: the
+    mounted serviceaccount when present, overridable out-of-cluster via
+    KB_KUBE_TOKEN_FILE / KB_KUBE_CA_FILE / KB_KUBE_INSECURE (how the e2e
+    driver hands the scheduler subprocess its credentials)."""
+    token = os.environ.get("KB_KUBE_TOKEN_FILE") or f"{SERVICEACCOUNT_DIR}/token"
+    ca = os.environ.get("KB_KUBE_CA_FILE") or f"{SERVICEACCOUNT_DIR}/ca.crt"
+    auth: Dict[str, Optional[str]] = {
         "token_file": token if os.path.exists(token) else None,
         "ca_file": ca if os.path.exists(ca) else None,
     }
+    if os.environ.get("KB_KUBE_INSECURE", "").lower() in ("1", "true", "yes"):
+        auth["insecure"] = True  # type: ignore[assignment]
+    return auth
 
 
 class ApiTransport:

@@ -352,8 +352,16 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             acc_a, delta_a = _resolve_conflicts(
                 alloc_cand, best, rank, snap.task_req, snap.task_resreq, idle, snap.quanta
             )
-            acc_p, delta_p = _resolve_conflicts(
-                pipe_cand, best, rank, snap.task_req, snap.task_resreq, releasing, snap.quanta
+            # pipeline-on-releasing bidders exist only when eviction freed
+            # capacity this cycle; the steady-state allocate-only round has
+            # none — skip the second sort + segmented scan entirely
+            acc_p, delta_p = jax.lax.cond(
+                jnp.any(pipe_cand),
+                lambda: _resolve_conflicts(
+                    pipe_cand, best, rank, snap.task_req, snap.task_resreq,
+                    releasing, snap.quanta,
+                ),
+                lambda: (jnp.zeros(T, bool), jnp.zeros_like(releasing)),
             )
             # statement.Allocate → node.AddTask(Allocated): Idle -= r, Used += r
             # statement.Pipeline → node.AddTask(Pipelined): Releasing -= r, Used += r

@@ -8,13 +8,17 @@ binder/evictor, and the status writeback:
     python -m kube_batch_tpu.testing.e2e --master https://127.0.0.1:6443
     python -m kube_batch_tpu.testing.e2e --stub        # CI: no cluster
 
-Scenarios (test/e2e/job.go:82,118,189; queue.go:26; job.go:458):
+Scenarios (test/e2e/job.go:82,118,189; queue.go:26; job.go:458;
+predicates.go:35,84,161):
   gang              — minMember gang schedules atomically
   gang_full         — a gang that cannot fully fit binds NOTHING
   preemption        — a high-priority job evicts same-queue victims, then
                       places once the kubelet terminates them
   reclaim           — a starved weighted queue reclaims cross-queue
   proportion        — two weighted queues split capacity by weight
+  node_selector     — selector pods land only on matching nodes
+  taints            — only tolerating pods land on a tainted node
+  hostport          — same hostPort forces distinct nodes
 
 With --stub, an in-process fake apiserver (real HTTP, real watch streams)
 plays the cluster, including the kubelet's part: a Binding POST transitions
@@ -41,6 +45,7 @@ SCHED = "volcano"  # default scheduler-name the shim filters on
 
 # collection resource segment → canonical list path (mirrors k8s/watch.py)
 _COLLECTIONS = {
+    "namespaces": "/api/v1/namespaces",
     "pods": "/api/v1/pods",
     "nodes": "/api/v1/nodes",
     "persistentvolumes": "/api/v1/persistentvolumes",
@@ -146,12 +151,15 @@ class StubApiServer:
                 self.wfile.write(data)
 
             def _route(self) -> Tuple[Optional[str], List[str], str]:
-                """path → (collection kind, trailing segments, query)."""
+                """path → (collection kind, trailing segments, query). The
+                LAST matching segment is the resource — namespaced paths
+                (/api/v1/namespaces/<ns>/pods/...) contain 'namespaces'
+                first but address the inner collection."""
                 path, _, query = self.path.partition("?")
                 parts = [p for p in path.split("/") if p]
-                for i, seg in enumerate(parts):
-                    if seg in _COLLECTIONS:
-                        return seg, parts[i + 1:], query
+                for i in range(len(parts) - 1, -1, -1):
+                    if parts[i] in _COLLECTIONS:
+                        return parts[i], parts[i + 1:], query
                 return None, [], query
 
             def _obj_key(self, kind: str, rest: List[str]) -> str:
@@ -172,7 +180,16 @@ class StubApiServer:
                     return
                 if "watch=true" in query:
                     q: _queue.Queue = _queue.Queue()
-                    stub._watchers[kind].append(q)
+                    with stub._lock:
+                        # close the LIST→watch gap: whatever the store holds
+                        # NOW replays as MODIFIED (the shim's handlers are
+                        # upserts, so re-delivery is harmless) — an event
+                        # emitted between the client's list and this
+                        # registration cannot be lost
+                        for obj in stub._store[kind].values():
+                            q.put({"type": "MODIFIED",
+                                   "object": json.loads(json.dumps(obj))})
+                        stub._watchers[kind].append(q)
                     try:
                         self.send_response(200)
                         self.send_header("Content-Type", "application/json")
@@ -277,15 +294,51 @@ class StubApiServer:
 
 
 class Cluster:
-    """Minimal apiserver client for the scenarios."""
+    """Minimal apiserver client for the scenarios. Creates are tracked so
+    teardown() can delete them in reverse order — scenario isolation on a
+    real cluster, where objects would otherwise leak across runs."""
 
     def __init__(self, master: str, **auth):
         from kube_batch_tpu.k8s.transport import ApiTransport
 
         self.t = ApiTransport(master, **auth)
+        self._created: List[str] = []  # object paths, creation order
 
-    def create(self, collection_path: str, obj: dict) -> None:
-        self.t.request("POST", collection_path, obj)
+    def _obj_path(self, collection_path: str, obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        ns, name = meta.get("namespace"), meta.get("name", "")
+        if ns and not collection_path.rstrip("/").endswith(f"namespaces/{ns}"):
+            prefix, _, resource = collection_path.rpartition("/")
+            return f"{prefix}/namespaces/{ns}/{resource}/{name}"
+        return f"{collection_path}/{name}"
+
+    def create(self, collection_path: str, obj: dict, tolerate_conflict=False) -> None:
+        import urllib.error
+
+        try:
+            self.t.request("POST", collection_path, obj)
+        except urllib.error.HTTPError as e:
+            if not (tolerate_conflict and e.code == 409):
+                raise
+            return
+        self._created.append(self._obj_path(collection_path, obj))
+
+    def ensure_namespace(self, ns: str) -> None:
+        self.create("/api/v1/namespaces",
+                    {"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": ns}},
+                    tolerate_conflict=True)
+
+    def teardown(self) -> None:
+        """Best-effort reverse-order cleanup of everything this client made."""
+        import urllib.error
+
+        for path in reversed(self._created):
+            try:
+                self.t.request("DELETE", path)
+            except (urllib.error.HTTPError, OSError):
+                pass
+        self._created.clear()
 
     def pods(self, ns: str) -> Dict[str, dict]:
         listing = self.t.get_json(_COLLECTIONS["pods"])
@@ -346,7 +399,17 @@ class Cluster:
         })
 
     def pod(self, ns: str, name: str, group: str, cpu_m: int = 1000,
-            priority: int = 0, node: Optional[str] = None) -> None:
+            priority: int = 0, node: Optional[str] = None,
+            node_selector: Optional[dict] = None,
+            tolerations: Optional[list] = None,
+            host_port: Optional[int] = None) -> None:
+        container = {
+            "name": "c", "image": "busybox",
+            "resources": {"requests": {"cpu": f"{cpu_m}m", "memory": "1Gi"}},
+        }
+        if host_port is not None:
+            container["ports"] = [{"containerPort": host_port,
+                                   "hostPort": host_port}]
         obj = {
             "apiVersion": "v1", "kind": "Pod",
             "metadata": {
@@ -357,14 +420,14 @@ class Cluster:
             "spec": {
                 "schedulerName": SCHED,
                 "priority": priority,
-                "containers": [{
-                    "name": "c", "image": "busybox",
-                    "resources": {"requests": {"cpu": f"{cpu_m}m",
-                                               "memory": "1Gi"}},
-                }],
+                "containers": [container],
             },
             "status": {"phase": "Pending"},
         }
+        if node_selector:
+            obj["spec"]["nodeSelector"] = node_selector
+        if tolerations:
+            obj["spec"]["tolerations"] = tolerations
         if node is not None:
             obj["spec"]["nodeName"] = node
             obj["status"]["phase"] = "Running"
@@ -393,10 +456,10 @@ class Cluster:
 
 def scenario_gang(c: Cluster, ns: str) -> None:
     """Gang scheduling (job.go:82): all minMember tasks bind together."""
-    c.queue("default", 1)
+    c.queue(f"{ns}-q", 1)
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1"))
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n2"))
-    c.podgroup(ns, "gang", 6, "default")
+    c.podgroup(ns, "gang", 6, f"{ns}-q")
     for i in range(6):
         c.pod(ns, f"g{i}", "gang")
     c.wait(lambda: c.n_on_nodes(ns, "g") == 6, what="gang fully scheduled")
@@ -405,12 +468,12 @@ def scenario_gang(c: Cluster, ns: str) -> None:
 def scenario_gang_full(c: Cluster, ns: str) -> None:
     """Gang: Full Occupied (job.go:118): an unsatisfiable gang binds NOTHING
     (no partial placement) while a fitting gang proceeds."""
-    c.queue("default", 1)
+    c.queue(f"{ns}-q", 1)
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1", cpu_m=4000))
-    c.podgroup(ns, "big", 8, "default")   # 8 x 1000m > 4000m — can't fit
+    c.podgroup(ns, "big", 8, f"{ns}-q")   # 8 x 1000m > 4000m — can't fit
     for i in range(8):
         c.pod(ns, f"big{i}", "big")
-    c.podgroup(ns, "ok", 3, "default")
+    c.podgroup(ns, "ok", 3, f"{ns}-q")
     for i in range(3):
         c.pod(ns, f"ok{i}", "ok")
     c.wait(lambda: c.n_on_nodes(ns, "ok") == 3, what="fitting gang scheduled")
@@ -421,15 +484,15 @@ def scenario_gang_full(c: Cluster, ns: str) -> None:
 def scenario_preemption(c: Cluster, ns: str) -> None:
     """Preemption (job.go:189): a high-priority same-queue job evicts
     running victims and places once they terminate."""
-    c.queue("default", 1)
+    c.queue(f"{ns}-q", 1)
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1", cpu_m=4000))
     # minMember 2 with 4 running replicas: gang slack 2 — the victims the
     # gang plugin permits (evicting from a min==replicas gang would break
     # it, and the reference's Evictable refuses that too, gang.go:71-94)
-    c.podgroup(ns, "low", 2, "default")
+    c.podgroup(ns, "low", 2, f"{ns}-q")
     for i in range(4):  # fills the node
         c.pod(ns, f"low{i}", "low", node=f"{ns}-n1")
-    c.podgroup(ns, "high", 2, "default")
+    c.podgroup(ns, "high", 2, f"{ns}-q")
     for i in range(2):
         c.pod(ns, f"high{i}", "high", priority=1000)
     c.wait(lambda: c.n_on_nodes(ns, "high") == 2, timeout=90,
@@ -439,15 +502,15 @@ def scenario_preemption(c: Cluster, ns: str) -> None:
 def scenario_reclaim(c: Cluster, ns: str) -> None:
     """Reclaim across queues (queue.go:26): a starved weighted queue evicts
     another queue's overuse."""
-    c.queue("q1", 1)
-    c.queue("q2", 1)
+    c.queue(f"{ns}-q1", 1)
+    c.queue(f"{ns}-q2", 1)
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1", cpu_m=4000))
     # gang slack 2 (see scenario_preemption): reclaimable without breaking
     # the hog's own gang
-    c.podgroup(ns, "hog", 2, "q1")
+    c.podgroup(ns, "hog", 2, f"{ns}-q1")
     for i in range(4):
         c.pod(ns, f"hog{i}", "hog", node=f"{ns}-n1")
-    c.podgroup(ns, "starved", 2, "q2")
+    c.podgroup(ns, "starved", 2, f"{ns}-q2")
     for i in range(2):
         c.pod(ns, f"starved{i}", "starved")
     c.wait(lambda: c.n_on_nodes(ns, "starved") == 2, timeout=90,
@@ -457,11 +520,11 @@ def scenario_reclaim(c: Cluster, ns: str) -> None:
 def scenario_proportion(c: Cluster, ns: str) -> None:
     """Proportion (job.go:458): weighted queues split contended capacity
     ~by weight; nothing is overcommitted."""
-    c.queue("gold", 2)
-    c.queue("bronze", 1)
+    c.queue(f"{ns}-gold", 2)
+    c.queue(f"{ns}-bronze", 1)
     c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1", cpu_m=6000))
-    c.podgroup(ns, "gj", 1, "gold")
-    c.podgroup(ns, "bj", 1, "bronze")
+    c.podgroup(ns, "gj", 1, f"{ns}-gold")
+    c.podgroup(ns, "bj", 1, f"{ns}-bronze")
     for i in range(6):
         c.pod(ns, f"gp{i}", "gj")
         c.pod(ns, f"bp{i}", "bj")
@@ -473,12 +536,76 @@ def scenario_proportion(c: Cluster, ns: str) -> None:
     assert gold >= 3, f"gold under-served: {gold}"
 
 
+def scenario_node_selector(c: Cluster, ns: str) -> None:
+    """NodeAffinity/selector (predicates.go:35): a selector pod lands only
+    on the matching node."""
+    c.queue(f"{ns}-q", 1)
+    red, blue = c.node_obj(f"{ns}-red"), c.node_obj(f"{ns}-blue")
+    red["metadata"]["labels"]["color"] = "red"
+    blue["metadata"]["labels"]["color"] = "blue"
+    c.create(_COLLECTIONS["nodes"], red)
+    c.create(_COLLECTIONS["nodes"], blue)
+    c.podgroup(ns, "sel", 2, f"{ns}-q")
+    for i in range(2):
+        c.pod(ns, f"sel{i}", "sel", node_selector={"color": "blue"})
+    c.wait(lambda: c.n_on_nodes(ns, "sel") == 2, what="selector pods placed")
+    for k, p in c.pods(ns).items():
+        assert p["spec"].get("nodeName") in (None, f"{ns}-blue"), (k, p["spec"])
+
+
+def scenario_taints(c: Cluster, ns: str) -> None:
+    """Taints/Tolerations (predicates.go:161): only tolerating pods land on
+    the tainted node; the others go to the clean node."""
+    c.queue(f"{ns}-q", 1)
+    tainted = c.node_obj(f"{ns}-tainted", cpu_m=4000)
+    tainted["spec"]["taints"] = [
+        {"key": "dedicated", "value": "ml", "effect": "NoSchedule"}]
+    c.create(_COLLECTIONS["nodes"], tainted)
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-clean", cpu_m=2000))
+    c.podgroup(ns, "tol", 3, f"{ns}-q")
+    tol = [{"key": "dedicated", "operator": "Equal", "value": "ml",
+            "effect": "NoSchedule"}]
+    for i in range(3):
+        # selector pins tol pods to the tainted node: they can land there
+        # ONLY via the toleration (the predicate under test), and the clean
+        # node's exact capacity stays reserved for the plain gang
+        c.pod(ns, f"tol{i}", "tol", tolerations=tol,
+              node_selector={"kubernetes.io/hostname": f"{ns}-tainted"})
+    c.podgroup(ns, "plain", 2, f"{ns}-q")
+    for i in range(2):
+        c.pod(ns, f"plain{i}", "plain")
+    c.wait(lambda: c.n_on_nodes(ns) == 5, what="all pods placed")
+    pods = c.pods(ns)
+    for k, p in pods.items():
+        name = k.split("/", 1)[1]
+        on = p["spec"].get("nodeName")
+        if name.startswith("plain"):
+            assert on == f"{ns}-clean", (k, on)
+
+
+def scenario_hostport(c: Cluster, ns: str) -> None:
+    """Hostport (predicates.go:84): two pods claiming the same hostPort
+    land on different nodes."""
+    c.queue(f"{ns}-q", 1)
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1"))
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n2"))
+    c.podgroup(ns, "hp", 2, f"{ns}-q")
+    for i in range(2):
+        c.pod(ns, f"hp{i}", "hp", host_port=8080)
+    c.wait(lambda: c.n_on_nodes(ns, "hp") == 2, what="hostport pods placed")
+    nodes = {p["spec"]["nodeName"] for p in c.pods(ns).values()}
+    assert len(nodes) == 2, f"hostPort conflict ignored: {nodes}"
+
+
 SCENARIOS = {
     "gang": scenario_gang,
     "gang_full": scenario_gang_full,
     "preemption": scenario_preemption,
     "reclaim": scenario_reclaim,
     "proportion": scenario_proportion,
+    "node_selector": scenario_node_selector,
+    "taints": scenario_taints,
+    "hostport": scenario_hostport,
 }
 
 
@@ -496,6 +623,8 @@ def run_scenario(name: str, master: str, **auth) -> None:
 
     from kube_batch_tpu.envutil import hardened_cpu_env
 
+    import tempfile
+
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     conf = os.path.join(repo, "config", "kube-batch-tpu-conf.yaml")
@@ -503,6 +632,16 @@ def run_scenario(name: str, master: str, **auth) -> None:
     env["PYTHONPATH"] = os.pathsep.join(
         [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
     )
+    # hand the scheduler subprocess the same credentials the scenario
+    # client carries (in_cluster_auth reads these overrides)
+    token_tmp = None
+    if auth.get("token"):
+        token_tmp = tempfile.NamedTemporaryFile("w", delete=False, suffix=".token")
+        token_tmp.write(auth["token"])
+        token_tmp.close()
+        env["KB_KUBE_TOKEN_FILE"] = token_tmp.name
+    if auth.get("insecure"):
+        env["KB_KUBE_INSECURE"] = "1"
     cmd = [
         sys.executable, "-m", "kube_batch_tpu.cmd.main",
         "--master", master,
@@ -510,18 +649,25 @@ def run_scenario(name: str, master: str, **auth) -> None:
         "--schedule-period", "0.25",
         "--scheduler-conf", conf,
     ]
-    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
+    # scheduler logs drain to a file — an undrained PIPE would block the
+    # scheduler once its logging fills the pipe buffer mid-scenario
+    logf = tempfile.NamedTemporaryFile("w+", delete=False, suffix=".sched.log")
+    proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+                            text=True)
+    c = Cluster(master, **auth)
     try:
-        c = Cluster(master, **auth)
+        c.ensure_namespace(f"e2e-{name.replace('_', '-')}")
         SCENARIOS[name](c, ns=f"e2e-{name.replace('_', '-')}")
         if proc.poll() is not None:
             raise RuntimeError(
                 f"scheduler exited early rc={proc.returncode}")
     except Exception:
-        if proc.poll() is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            logger.error("scheduler process output:\n%s", out[-4000:])
+        logf.flush()
+        try:
+            with open(logf.name) as f:
+                logger.error("scheduler process output:\n%s", f.read()[-4000:])
+        except OSError:
+            pass
         raise
     finally:
         proc.terminate()
@@ -529,6 +675,11 @@ def run_scenario(name: str, master: str, **auth) -> None:
             proc.wait(timeout=15)
         except subprocess.TimeoutExpired:
             proc.kill()
+        logf.close()
+        os.unlink(logf.name)
+        if token_tmp is not None:
+            os.unlink(token_tmp.name)
+        c.teardown()
 
 
 def main(argv=None) -> int:

@@ -1,7 +1,7 @@
 """Runs the live-apiserver e2e driver (kube_batch_tpu/testing/e2e.py) in
 --stub mode: the REAL CLI scheduler process in --master mode against a real
 HTTP apiserver (the kubelet-simulating stub), executing the reference's
-core scenarios (test/e2e/job.go:82,118,189; queue.go:26,458).
+core scenarios (test/e2e/job.go:82,118,189; queue.go:26,458; predicates.go:35,84,161).
 
 Against an actual cluster:  python -m kube_batch_tpu.testing.e2e --master URL
 """
@@ -25,4 +25,4 @@ def test_e2e_scenarios_against_stub_apiserver():
         capture_output=True, text=True, timeout=560, env=env, cwd=repo,
     )
     assert r.returncode == 0, f"e2e driver failed:\n{r.stdout[-6000:]}\n{r.stderr[-2000:]}"
-    assert "5/5 scenarios passed" in r.stdout, r.stdout[-3000:]
+    assert "8/8 scenarios passed" in r.stdout, r.stdout[-3000:]
