@@ -102,6 +102,9 @@ class AllocateAction(Action):
         self.last_phase_ms: Dict[str, float] = {}
         # "single" | "sharded" — which solve the last execute() dispatched
         self.last_solve_mode = "single"
+        # bidding rounds the last solve executed (early exits make this
+        # the measured convergence, not the 6x3 cap)
+        self.last_solve_rounds = 0
         # fallback pressure of the most recent execute() (VERDICT r2 #6)
         self.last_fallback: Dict[str, int] = {}
         # jobs whose placements were DISCARDED host-side this execute()
@@ -147,9 +150,12 @@ class AllocateAction(Action):
             snap, session_allocate_config(ssn)
         )
         # one blocking transfer for everything the host reads
-        assigned, pipelined = jax.device_get(
-            (result.assigned, result.pipelined)
+        assigned, pipelined, rounds_run = jax.device_get(
+            (result.assigned, result.pipelined, result.rounds_run)
         )
+        # convergence diagnostic (round-cap tuning); NOT in last_phase_ms —
+        # that dict is ms-typed for the bench phases map
+        self.last_solve_rounds = int(rounds_run)
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
         t2 = time.perf_counter()

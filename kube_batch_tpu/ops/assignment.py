@@ -98,6 +98,8 @@ class AllocateResult(NamedTuple):
     node_releasing: jnp.ndarray  # [N, R] post-solve
     node_used: jnp.ndarray      # [N, R] post-solve
     deserved: jnp.ndarray       # [Q, R] proportion deserved (diagnostics)
+    rounds_run: jnp.ndarray     # [] i32 — total bidding rounds executed
+    #                             (convergence diagnostic for round tuning)
 
 
 @jax.jit
@@ -234,7 +236,8 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     )
 
     def outer_body(state):
-        idle, releasing, used, assigned, pipelined, job_failed, o, _more = state
+        (idle, releasing, used, assigned, pipelined, job_failed, o,
+         rounds_total, _more) = state
 
         # ---- fairness state + virtual-time rank, once per outer pass -----
         # (the rank is a static plan for the whole round set: virtual time
@@ -423,10 +426,11 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         more = (reverted_any | rounds_capped) & jnp.any(
             eligible & (assigned < 0) & ~job_failed[snap.task_job]
         )
-        return (idle, releasing, used, assigned, pipelined, job_failed, o + 1, more)
+        return (idle, releasing, used, assigned, pipelined, job_failed, o + 1,
+                rounds_total + rounds_i, more)
 
     def outer_cond(state):
-        *_, o, more = state
+        *_, o, _rounds, more = state
         return (o < config.outer) & more
 
     init = (
@@ -437,12 +441,13 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         jnp.zeros(T, bool),
         jnp.zeros(J, bool),
         jnp.int32(0),
+        jnp.int32(0),
         jnp.bool_(True),
     )
     # while_loop with early exit — a scan would pay every outer iteration
     # (~12% of solve time each) even after everything is placed
-    (idle, releasing, used, assigned, pipelined, _, _, _) = jax.lax.while_loop(
-        outer_cond, outer_body, init
+    (idle, releasing, used, assigned, pipelined, _, _, rounds_run, _) = (
+        jax.lax.while_loop(outer_cond, outer_body, init)
     )
 
     # after the final outer revert, every surviving placement belongs to a
@@ -459,4 +464,5 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         node_releasing=releasing,
         node_used=used,
         deserved=deserved,
+        rounds_run=rounds_run,
     )

@@ -142,6 +142,7 @@ def sharded_allocate_solve(
             node_releasing=node2,
             node_used=node2,
             deserved=repl,
+            rounds_run=repl,
         )
         fn = jax.jit(
             partial(_solve, config=config),
