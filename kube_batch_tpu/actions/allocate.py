@@ -120,6 +120,7 @@ class AllocateAction(Action):
         self.last_phase_ms = {}
         self.last_fallback = {}
         self.last_host_discards = 0
+        self.last_solve_rounds = 0
         self._host_place_count = 0
         self._n_applied = 0
         self._ports_by_node = None

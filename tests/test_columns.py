@@ -323,3 +323,92 @@ class TestColumnConsistency:
         assert_consistent(cache)
         idle = cache.nodes["n1"].idle
         assert idle.milli_cpu == cache.nodes["n1"].allocatable.milli_cpu - 500
+
+
+class TestFullPipelineChurnSoak:
+    def test_five_action_churn_soak(self):
+        """Seeded soak over the SHIPPED 5-action pipeline (enqueue, reclaim,
+        allocate, backfill, preempt) with two weighted queues, random
+        priorities, kubelet transitions (run / die / honor evictions), and
+        node churn — after every cycle: full column/object consistency and
+        the node resource algebra invariants (never overcommit, reclaim's
+        and preempt's evictions included)."""
+        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+
+        conf = parse_scheduler_conf(FULL_CONF)
+        rng = np.random.default_rng(11)
+        from kube_batch_tpu.api.pod import Queue
+
+        cache = build_cache(
+            queues=[Queue(name="qa", weight=3), Queue(name="qb", weight=1)],
+            nodes=[build_node(f"n{i}", cpu=6000, mem=16 * GiB, pods=30)
+                   for i in range(4)],
+            pods=[],
+        )
+        sched = Scheduler(cache, conf=conf)
+        next_id = [0]
+
+        def add_gang():
+            g = next_id[0]
+            next_id[0] += 1
+            size = int(rng.integers(1, 4))
+            queue = "qa" if rng.random() < 0.5 else "qb"
+            cache.add_pod_group(PodGroup(
+                name=f"g{g}", namespace="c", min_member=size, queue=queue,
+                creation_index=g,
+            ))
+            prio = int(rng.choice([0, 0, 0, 100]))
+            for i in range(size):
+                cache.add_pod(Pod(
+                    name=f"g{g}-{i}", namespace="c",
+                    requests={"cpu": float(rng.choice([500, 1000, 2000])),
+                              "memory": float(GiB)},
+                    annotations={GROUP_NAME_ANNOTATION: f"g{g}"},
+                    priority=prio,
+                    creation_index=g * 10 + i,
+                ))
+
+        quanta = cache.spec.quanta
+        for cycle in range(30):
+            op = rng.random()
+            if op < 0.45:
+                add_gang()
+            elif op < 0.65 and cache.pods:
+                key = list(cache.pods)[int(rng.integers(len(cache.pods)))]
+                pod = cache.pods[key]
+                if pod.node_name and rng.random() < 0.7:
+                    cache.update_pod(Pod(
+                        name=pod.name, namespace=pod.namespace, uid=pod.uid,
+                        requests=dict(pod.requests), node_name=pod.node_name,
+                        phase=PodPhase.RUNNING,
+                        annotations=dict(pod.annotations),
+                        priority=pod.priority,
+                        creation_index=pod.creation_index,
+                    ))
+                else:
+                    cache.delete_pod(pod)
+            elif op < 0.75:
+                name = f"n{int(rng.integers(4))}"
+                if name in cache.nodes and rng.random() < 0.5:
+                    cache.delete_node(name)
+                else:
+                    cache.add_node(build_node(name, cpu=6000, mem=16 * GiB,
+                                              pods=30))
+            # honor pending evictions like a kubelet: terminate the pods the
+            # evictor asked for, so Releasing capacity actually frees
+            for key in list(cache.evictor.evicts):
+                pod = cache.pods.get(key)
+                if pod is not None:
+                    cache.delete_pod(pod)
+            cache.evictor.evicts.clear()
+
+            sched.run_once()
+            cache.flush_binds()
+            errs = cache.columns.check_consistency(cache)
+            assert not errs, (cycle, errs[:5])
+            for node in cache.nodes.values():
+                assert (node.idle.vec >= -quanta).all(), (cycle, node.name)
+                assert (node.used.vec
+                        <= node.allocatable.vec + quanta).all(), (
+                    cycle, node.name)
+        assert len(cache.binder.binds) > 10
