@@ -253,6 +253,15 @@ class TestShardedSolveAgreement:
             rtol=1e-5, atol=1e-3,
         )
         assert (s_a >= 0).sum() > 0  # non-vacuous
+        # the lazy fit-error histogram's sharded twin (failure cycles in
+        # sharded mode dispatch it) must match the single-device one
+        from kube_batch_tpu.ops.assignment import failure_histogram_solve
+        from kube_batch_tpu.parallel.mesh import sharded_failure_histogram
+
+        np.testing.assert_array_equal(
+            np.asarray(failure_histogram_solve(snap)),
+            np.asarray(sharded_failure_histogram(snap, mesh)),
+        )
 
 
 class TestOuterLoopContinuation:

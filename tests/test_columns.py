@@ -6,7 +6,7 @@ import numpy as np
 
 from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
 from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
-from kube_batch_tpu.api.pod import Node, PodGroup, PriorityClass
+from kube_batch_tpu.api.pod import Node, Pod, PodGroup, PriorityClass
 from kube_batch_tpu.api.types import PodPhase, TaskStatus
 from kube_batch_tpu.framework.conf import parse_scheduler_conf
 from kube_batch_tpu.scheduler import Scheduler
@@ -26,6 +26,32 @@ tiers:
   - name: proportion
   - name: nodeorder
 """
+
+
+def _soak_add_gang(cache, rng, next_id, queues=("default",),
+                   cpu_choices=(250, 500, 1000), prio_choices=(0,)):
+    """Shared gang generator for the churn soaks: a random-size PodGroup in
+    a random queue with random per-task cpu and priority."""
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
+
+    g = next_id[0]
+    next_id[0] += 1
+    size = int(rng.integers(1, 4))
+    queue = queues[int(rng.integers(len(queues)))]
+    cache.add_pod_group(PodGroup(
+        name=f"g{g}", namespace="c", min_member=size, queue=queue,
+        creation_index=g,
+    ))
+    prio = int(rng.choice(prio_choices))
+    for i in range(size):
+        cache.add_pod(Pod(
+            name=f"g{g}-{i}", namespace="c",
+            requests={"cpu": float(rng.choice(cpu_choices)),
+                      "memory": float(GiB)},
+            annotations={GROUP_NAME_ANNOTATION: f"g{g}"},
+            priority=prio,
+            creation_index=g * 10 + i,
+        ))
 
 
 def assert_consistent(cache):
@@ -211,8 +237,6 @@ class TestColumnConsistency:
         node churn / kubelet transitions, asserting full column/object
         consistency after every cycle.  The strongest drift guard the
         columnar model has — any missed choke point shows up here."""
-        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
-
         rng = np.random.default_rng(7)
         cache = build_cache(
             queues=["default"],
@@ -224,21 +248,7 @@ class TestColumnConsistency:
         next_id = [0]
 
         def add_gang():
-            g = next_id[0]
-            next_id[0] += 1
-            size = int(rng.integers(1, 4))
-            cache.add_pod_group(PodGroup(
-                name=f"g{g}", namespace="c", min_member=size, queue="default",
-                creation_index=g,
-            ))
-            for i in range(size):
-                cache.add_pod(Pod(
-                    name=f"g{g}-{i}", namespace="c",
-                    requests={"cpu": float(rng.choice([250, 500, 1000])),
-                              "memory": float(GiB)},
-                    annotations={GROUP_NAME_ANNOTATION: f"g{g}"},
-                    creation_index=g * 10 + i,
-                ))
+            _soak_add_gang(cache, rng, next_id)
 
         for cycle in range(25):
             op = rng.random()
@@ -333,8 +343,6 @@ class TestFullPipelineChurnSoak:
         node churn — after every cycle: full column/object consistency and
         the node resource algebra invariants (never overcommit, reclaim's
         and preempt's evictions included)."""
-        from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
-
         conf = parse_scheduler_conf(FULL_CONF)
         rng = np.random.default_rng(11)
         from kube_batch_tpu.api.pod import Queue
@@ -349,24 +357,9 @@ class TestFullPipelineChurnSoak:
         next_id = [0]
 
         def add_gang():
-            g = next_id[0]
-            next_id[0] += 1
-            size = int(rng.integers(1, 4))
-            queue = "qa" if rng.random() < 0.5 else "qb"
-            cache.add_pod_group(PodGroup(
-                name=f"g{g}", namespace="c", min_member=size, queue=queue,
-                creation_index=g,
-            ))
-            prio = int(rng.choice([0, 0, 0, 100]))
-            for i in range(size):
-                cache.add_pod(Pod(
-                    name=f"g{g}-{i}", namespace="c",
-                    requests={"cpu": float(rng.choice([500, 1000, 2000])),
-                              "memory": float(GiB)},
-                    annotations={GROUP_NAME_ANNOTATION: f"g{g}"},
-                    priority=prio,
-                    creation_index=g * 10 + i,
-                ))
+            _soak_add_gang(cache, rng, next_id, queues=("qa", "qb"),
+                           cpu_choices=(500, 1000, 2000),
+                           prio_choices=(0, 0, 0, 100))
 
         quanta = cache.spec.quanta
         for cycle in range(30):
