@@ -19,6 +19,7 @@ predicates.go:35,84,161):
   node_selector     — selector pods land only on matching nodes
   taints            — only tolerating pods land on a tainted node
   hostport          — same hostPort forces distinct nodes
+  volume            — a local-PV claim pins its pod; the PV pre-binds
 
 With --stub, an in-process fake apiserver (real HTTP, real watch streams)
 plays the cluster, including the kubelet's part: a Binding POST transitions
@@ -597,6 +598,72 @@ def scenario_hostport(c: Cluster, ns: str) -> None:
     assert len(nodes) == 2, f"hostPort conflict ignored: {nodes}"
 
 
+def scenario_volume(c: Cluster, ns: str) -> None:
+    """Local-PV reachability (the volumebinder feed, cache.go:189-209): a
+    pod claiming an unbound no-provisioner PVC lands ONLY on the node its
+    static PV is reachable from, and the scheduler pre-binds the PV
+    (claimRef) cluster-side."""
+    c.queue(f"{ns}-q", 1)
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-a"))
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-b"))
+    c.create(_COLLECTIONS["storageclasses"], {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": f"{ns}-local"},
+        "provisioner": "kubernetes.io/no-provisioner",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    })
+    c.create(_COLLECTIONS["persistentvolumes"], {
+        "apiVersion": "v1", "kind": "PersistentVolume",
+        "metadata": {"name": f"{ns}-pv"},
+        "spec": {
+            "capacity": {"storage": "10Gi"},
+            "accessModes": ["ReadWriteOnce"],
+            "storageClassName": f"{ns}-local",
+            "local": {"path": "/mnt/ssd0"},
+            "nodeAffinity": {"required": {"nodeSelectorTerms": [
+                {"matchExpressions": [{"key": "kubernetes.io/hostname",
+                                       "operator": "In",
+                                       "values": [f"{ns}-b"]}]}
+            ]}},
+        },
+        "status": {"phase": "Available"},
+    })
+    c.create(f"/api/v1/namespaces/{ns}/persistentvolumeclaims", {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "data", "namespace": ns},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "5Gi"}},
+                 "storageClassName": f"{ns}-local"},
+        "status": {"phase": "Pending"},
+    })
+    c.podgroup(ns, "stateful", 1, f"{ns}-q")
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "stateful-0", "namespace": ns,
+                     "uid": f"{ns}-stateful-0-uid",
+                     "annotations": {"scheduling.k8s.io/group-name": "stateful"}},
+        "spec": {
+            "schedulerName": SCHED,
+            "containers": [{"name": "c", "image": "busybox",
+                            "resources": {"requests": {"cpu": "500m",
+                                                       "memory": "1Gi"}}}],
+            "volumes": [{"name": "v",
+                         "persistentVolumeClaim": {"claimName": "data"}}],
+        },
+        "status": {"phase": "Pending"},
+    }
+    c.create(f"/api/v1/namespaces/{ns}/pods", pod)
+    c.wait(lambda: (c.pods(ns).get(f"{ns}/stateful-0") or {}).get(
+        "spec", {}).get("nodeName") == f"{ns}-b",
+        what="stateful pod on the PV's node")
+
+    def claim_ref_landed():
+        pv = c.t.get_json(f"/api/v1/persistentvolumes/{ns}-pv")
+        ref = (pv.get("spec") or {}).get("claimRef") or {}
+        return ref.get("name") == "data"
+    c.wait(claim_ref_landed, timeout=30, what="PV claimRef pre-bound")
+
+
 SCENARIOS = {
     "gang": scenario_gang,
     "gang_full": scenario_gang_full,
@@ -606,6 +673,7 @@ SCENARIOS = {
     "node_selector": scenario_node_selector,
     "taints": scenario_taints,
     "hostport": scenario_hostport,
+    "volume": scenario_volume,
 }
 
 

@@ -6,7 +6,7 @@ import numpy as np
 
 from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
 from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
-from kube_batch_tpu.api.pod import Node, Pod, PodGroup, PriorityClass
+from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, PriorityClass
 from kube_batch_tpu.api.types import PodPhase, TaskStatus
 from kube_batch_tpu.framework.conf import parse_scheduler_conf
 from kube_batch_tpu.scheduler import Scheduler
@@ -32,8 +32,6 @@ def _soak_add_gang(cache, rng, next_id, queues=("default",),
                    cpu_choices=(250, 500, 1000), prio_choices=(0,)):
     """Shared gang generator for the churn soaks: a random-size PodGroup in
     a random queue with random per-task cpu and priority."""
-    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod
-
     g = next_id[0]
     next_id[0] += 1
     size = int(rng.integers(1, 4))

@@ -25,4 +25,4 @@ def test_e2e_scenarios_against_stub_apiserver():
         capture_output=True, text=True, timeout=560, env=env, cwd=repo,
     )
     assert r.returncode == 0, f"e2e driver failed:\n{r.stdout[-6000:]}\n{r.stderr[-2000:]}"
-    assert "8/8 scenarios passed" in r.stdout, r.stdout[-3000:]
+    assert "9/9 scenarios passed" in r.stdout, r.stdout[-3000:]
