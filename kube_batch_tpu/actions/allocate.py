@@ -45,6 +45,20 @@ def _run_bounds(sorted_arr) -> list:
     ).tolist()
 
 
+class _PhaseMarks:
+    """Accumulating wall-clock sub-phase marks: each mark() charges the
+    elapsed time since the previous one to `sink[key]` (in ms)."""
+
+    def __init__(self, sink: Dict[str, float]):
+        self.sink = sink
+        self.t = time.perf_counter()
+
+    def mark(self, key: str) -> None:
+        now = time.perf_counter()
+        self.sink[key] = self.sink.get(key, 0.0) + (now - self.t) * 1e3
+        self.t = now
+
+
 def _pallas_enabled(ssn) -> bool:
     """Opt into the fused Pallas round-head kernel via an `allocate.pallas`
     argument on any conf tier plugin (Arguments are free-form string maps,
@@ -218,15 +232,7 @@ class AllocateAction(Action):
         # sub-phase wall clock (folded into last_phase_ms as replay_*) — the
         # host replay is the cycle's second-biggest phase and its internals
         # must stay visible in the bench artifact
-        _t = time.perf_counter
-        _t0 = _t()
-
-        def _mark(key, _t0=[_t0]):  # noqa: B006 — single-cycle accumulator
-            now = _t()
-            self.last_phase_ms[key] = (
-                self.last_phase_ms.get(key, 0.0) + (now - _t0[0]) * 1e3
-            )
-            _t0[0] = now
+        _mark = _PhaseMarks(self.last_phase_ms).mark
         # group placements by job, preserving device task order within a job;
         # groups are (job_idx, lo, hi) ranges over the sorted flat arrays
         order = np.argsort(task_job[placed], kind="stable")

@@ -342,7 +342,9 @@ class Cluster:
         self._created.clear()
 
     def pods(self, ns: str) -> Dict[str, dict]:
-        listing = self.t.get_json(_COLLECTIONS["pods"])
+        # namespaced list (the stub lists everything regardless; a real
+        # cluster must not pay a cluster-wide pod list per wait poll)
+        listing = self.t.get_json(f"/api/v1/namespaces/{ns}/pods")
         return {
             StubApiServer._key(p): p for p in listing.get("items", [])
             if (p.get("metadata") or {}).get("namespace") == ns
