@@ -94,8 +94,14 @@ def session_allocate_config(ssn) -> AllocateConfig:
     )
 
 
-def dispatch_allocate_solve(snap, config):
-    """Shard-or-local solve dispatch; returns (result, mode)."""
+def dispatch_allocate_solve(snap, config, cols=None):
+    """Shard-or-local solve dispatch; returns (result, mode).
+
+    With a ColumnStore, the ingest-static feature columns ride the
+    device-resident cache (columns.resident_features) so per-cycle
+    host→device traffic is only the truly per-cycle arrays; the caller's
+    `snap` stays host-backed for its numpy reads."""
+    from kube_batch_tpu.api.columns import resident_snap
     from kube_batch_tpu.parallel.mesh import (
         default_mesh,
         sharded_allocate_solve,
@@ -103,8 +109,12 @@ def dispatch_allocate_solve(snap, config):
     )
 
     if should_shard(snap.node_alloc.shape[0]):
-        return sharded_allocate_solve(snap, config, default_mesh()), "sharded"
-    return allocate_solve(snap, config), "single"
+        mesh = default_mesh()
+        return (
+            sharded_allocate_solve(resident_snap(cols, snap, mesh), config, mesh),
+            "sharded",
+        )
+    return allocate_solve(resident_snap(cols, snap), config), "single"
 
 
 class AllocateAction(Action):
@@ -162,7 +172,7 @@ class AllocateAction(Action):
         # production analog of the reference's always-on 16-worker fan-out
         # (scheduler_helper.go:34-64); single-chip or small-N stays local
         result, self.last_solve_mode = dispatch_allocate_solve(
-            snap, session_allocate_config(ssn)
+            snap, session_allocate_config(ssn), cols=cols
         )
         # one blocking transfer for everything the host reads
         assigned, pipelined, rounds_run = jax.device_get(
@@ -193,16 +203,23 @@ class AllocateAction(Action):
         # replay-phase regression in the bench breakdown
         t_fit0 = time.perf_counter()
         if bool(np.any(pending & (assigned < 0))):
+            from kube_batch_tpu.api.columns import resident_snap
+
             if self.last_solve_mode == "sharded":
                 from kube_batch_tpu.parallel.mesh import (
                     default_mesh as _dm, sharded_failure_histogram,
                 )
 
-                fail_hist = np.asarray(sharded_failure_histogram(snap, _dm()))
+                mesh = _dm()
+                fail_hist = np.asarray(sharded_failure_histogram(
+                    resident_snap(cols, snap, mesh), mesh
+                ))
             else:
                 from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
-                fail_hist = np.asarray(failure_histogram_solve(snap))
+                fail_hist = np.asarray(failure_histogram_solve(
+                    resident_snap(cols, snap)
+                ))
             self._record_fit_errors(
                 ssn, meta, fail_hist, assigned, task_job, pending
             )

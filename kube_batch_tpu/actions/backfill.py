@@ -125,7 +125,9 @@ class BackfillAction(Action):
         snap = snap._replace(
             job_schedulable=snap.job_schedulable & jnp.asarray(safe_np)
         )
-        result, _mode = dispatch_allocate_solve(snap, session_allocate_config(ssn))
+        result, _mode = dispatch_allocate_solve(
+            snap, session_allocate_config(ssn), cols=cols
+        )
         assigned, pipelined = jax.device_get((result.assigned, result.pipelined))
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]

@@ -113,16 +113,20 @@ def solve_claims(ssn, mode: str):
         victim_drf="drf" in gates,
         weights=ssn.score_weights,
     )
+    from kube_batch_tpu.api.columns import resident_snap
     from kube_batch_tpu.parallel.mesh import (
         default_mesh,
         sharded_evict_solve,
         should_shard,
     )
 
+    # device-resident feature cache (see allocate's dispatch): the decode
+    # below keeps reading the ORIGINAL host-backed snap
     if should_shard(snap.node_alloc.shape[0]):
-        result = sharded_evict_solve(snap, config, default_mesh())
+        mesh = default_mesh()
+        result = sharded_evict_solve(resident_snap(cols, snap, mesh), config, mesh)
     else:
-        result = evict_solve(snap, config)
+        result = evict_solve(resident_snap(cols, snap), config)
     claim_node = np.asarray(result.claim_node)[: meta.n_tasks]
     evicted = np.asarray(result.evicted)[: meta.n_tasks]
     victim_claimant = np.asarray(result.victim_claimant)[: meta.n_tasks]

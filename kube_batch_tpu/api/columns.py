@@ -68,6 +68,13 @@ VALID_STATUSES = READY_STATUSES + (
 )
 
 
+def resident_snap(cols, snap, mesh=None):
+    """The call-site shape for the device-resident feature cache: swap in
+    cached device arrays when a ColumnStore backs the session, pass the
+    snapshot through untouched otherwise."""
+    return cols.resident_features(snap, mesh=mesh) if cols is not None else snap
+
+
 def _grow(arr: np.ndarray, cap: int) -> np.ndarray:
     new = np.zeros((cap,) + arr.shape[1:], arr.dtype)
     new[: arr.shape[0]] = arr
@@ -185,6 +192,17 @@ class ColumnStore:
         # changed): next device_snapshot recomputes the sparse task rows
         self._task_bits_dirty = False
 
+        # ---- device-resident feature cache ------------------------------
+        # The ingest-static snapshot columns (task requests/bits/priorities,
+        # node allocatable/bits) change only at the ingest choke points that
+        # bump feature_version; resident_features() re-uploads them to the
+        # device ONLY when it moved — per-cycle host→device traffic drops to
+        # the genuinely per-cycle columns (statuses, node ledgers, job rows),
+        # the SURVEY §7.3 one-transfer-in budget.  Disabled with
+        # KB_DEVICE_CACHE=0.
+        self.feature_version = 0
+        self._dev_cache: Dict = {}
+
     # ==================================================================
     # task axis
     # ==================================================================
@@ -234,6 +252,7 @@ class ColumnStore:
         # were already incremented by job.add_task's index choke point.
         task._row = row
         task._store = self
+        self.feature_version += 1
 
     def free_task(self, task) -> None:
         row = getattr(task, "_row", -1)
@@ -257,6 +276,7 @@ class ColumnStore:
         self._ported_rows.discard(row)
         self.task_by_row[row] = None
         self.tasks.free(row)
+        self.feature_version += 1
 
     def _grow_tasks(self) -> None:
         cap = self.tasks.grown_cap()
@@ -406,6 +426,7 @@ class ColumnStore:
         node.used.vec = self.n_used[row]
         node.allocatable.vec = self.n_alloc[row]
         node.capability.vec = self.n_cap[row]
+        self.feature_version += 1  # fresh n_alloc / bit rows on this row
         self.sync_node_meta(node)
         # resident tasks bound before their node rows resolve to -1;
         # repoint them now that the name has a row
@@ -437,6 +458,7 @@ class ColumnStore:
         # node) must not alias whatever node reuses it
         self.t_node[self.t_node == row] = -1
         self.nodes.free(row)
+        self.feature_version += 1
 
     def _grow_nodes(self) -> None:
         cap = self.nodes.grown_cap()
@@ -457,7 +479,12 @@ class ColumnStore:
     def sync_node_meta(self, node) -> None:
         """Refresh validity/schedulability/label/taint bits after set_node
         (or bind). Interns new label pairs / taints; growth of the universe
-        marks task bitsets dirty for recompute at next snapshot."""
+        marks task bitsets dirty for recompute at next snapshot.
+
+        feature_version bumps only when a CACHED node column (label/taint
+        bits; n_alloc via set_node's own change check) actually changed —
+        kubelet heartbeats with unchanged content must not flush the
+        device-resident cache every cycle."""
         row = node._row
         self.n_valid[row] = node.ready
         obj = node.node
@@ -483,11 +510,11 @@ class ColumnStore:
             self.t_tol_bits = _grow_width(self.t_tol_bits, Wt)
         if len(self.label_pair_bit) != before_labels or len(self.taint_bit) != before_taints:
             self._task_bits_dirty = True
-        self.n_label_bits[row] = _pack_bits(
+        label_row = _pack_bits(
             [self.label_pair_bit[kv] for kv in obj.labels.items()],
             self.n_label_bits.shape[1],
         )
-        self.n_taint_bits[row] = _pack_bits(
+        taint_row = _pack_bits(
             [
                 self.taint_bit[(t.key, t.value, t.effect)]
                 for t in obj.taints
@@ -495,6 +522,13 @@ class ColumnStore:
             ],
             self.n_taint_bits.shape[1],
         )
+        if not (
+            np.array_equal(self.n_label_bits[row], label_row)
+            and np.array_equal(self.n_taint_bits[row], taint_row)
+        ):
+            self.feature_version += 1
+        self.n_label_bits[row] = label_row
+        self.n_taint_bits[row] = taint_row
 
     # ==================================================================
     # queue axis
@@ -598,10 +632,71 @@ class ColumnStore:
         if not self._task_bits_dirty:
             return
         self._task_bits_dirty = False
+        self.feature_version += 1
         for row in self._sel_rows:
             self._fill_sel_bits(row, self.task_by_row[row])
         for row in self._tol_rows:
             self._fill_tol_bits(row, self.task_by_row[row])
+
+    # snapshot field → ingest-static backing column (resident_features)
+    FEATURE_FIELDS = {
+        "task_req": "t_init32",
+        "task_resreq": "t_res32",
+        "task_job": "t_job",
+        "task_prio": "t_prio",
+        "task_creation": "t_creation",
+        "task_best_effort": "t_best_effort",
+        "task_critical": "t_critical",
+        "task_needs_host": "t_needs_host",
+        "task_sel_bits": "t_sel_bits",
+        "task_sel_impossible": "t_sel_impossible",
+        "task_tol_bits": "t_tol_bits",
+        "node_alloc": "n_alloc",
+        "node_label_bits": "n_label_bits",
+        "node_taint_bits": "n_taint_bits",
+    }
+
+    def resident_features(self, snap, mesh=None):
+        """`snap` with the ingest-static feature arrays swapped for cached
+        DEVICE-RESIDENT copies, re-uploaded only when feature_version moved
+        since the last call — steady-state cycles then ship only the truly
+        per-cycle columns (statuses, node ledgers, job/queue rows) to the
+        device (SURVEY §7.3's one-transfer-in budget; decisive on a
+        network-tunneled TPU).  `shardings`/`key` select a placement (the
+        mesh solve needs mesh-sharded uploads; committed single-device
+        arrays would be rejected by its in_shardings).  Callers keep using
+        the ORIGINAL host-backed snap for numpy reads — only the returned
+        copy goes to the solve.  KB_DEVICE_CACHE=0 disables."""
+        import os
+
+        if os.environ.get("KB_DEVICE_CACHE", "").strip().lower() in (
+            "0", "false", "off", "no"
+        ):
+            return snap
+        import jax
+
+        shardings = None
+        if mesh is not None:
+            from kube_batch_tpu.parallel.mesh import snapshot_shardings
+
+            shardings = snapshot_shardings(mesh)
+        cache = self._dev_cache.setdefault(mesh, {})
+        version = self.feature_version
+        updates = {}
+        for field, col in self.FEATURE_FIELDS.items():
+            ver, arr = cache.get(field, (-1, None))
+            host = getattr(self, col)
+            if ver != version or arr.shape != host.shape:
+                sharding = (
+                    getattr(shardings, field) if shardings is not None else None
+                )
+                arr = (
+                    jax.device_put(host, sharding)
+                    if sharding is not None else jax.device_put(host)
+                )
+                cache[field] = (version, arr)
+            updates[field] = arr
+        return snap._replace(**updates)
 
     def device_snapshot(self, ssn):
         """Build the (DeviceSnapshot, SnapshotMeta) pair for an EXCLUSIVE

@@ -131,7 +131,10 @@ class NodeInfo:
             self.releasing = Resource(rel_v, self.spec)
         else:
             # column-bound: write through the ledger views in place so the
-            # store's matrices stay the single source of truth
+            # store's matrices stay the single source of truth; an actual
+            # allocatable change invalidates the device-resident n_alloc
+            if not np.array_equal(self.allocatable.vec, alloc.vec):
+                self._cols.feature_version += 1
             self.allocatable.vec[:] = alloc.vec
             self.capability.vec[:] = cap.vec
             self.idle.vec[:] = idle_v

@@ -18,7 +18,7 @@ annotate, let XLA insert collectives)."""
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -64,9 +64,11 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
+@lru_cache(maxsize=8)
 def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
     """A DeviceSnapshot-shaped pytree of NamedShardings: node-axis arrays
-    sharded, everything else replicated."""
+    sharded, everything else replicated. Memoized per mesh — the resident
+    feature cache consults it every sharded cycle."""
     node1 = NamedSharding(mesh, P(NODE_AXIS))        # [N]
     node2 = NamedSharding(mesh, P(NODE_AXIS, None))  # [N, R] / [N, W]
     repl = NamedSharding(mesh, P())

@@ -403,3 +403,82 @@ class TestFullPipelineChurnSoak:
                         <= node.allocatable.vec + quanta).all(), (
                     cycle, node.name)
         assert len(cache.binder.binds) > 10
+
+
+class TestResidentFeatureCache:
+    def test_reuse_and_invalidation(self):
+        """resident_features returns the SAME device arrays while the
+        feature_version is unchanged, refreshes after ingest (bind/free
+        task, node meta change), and the refreshed upload carries the new
+        values — the staleness hazard the version counter exists for."""
+        import numpy as np
+
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.framework.session import close_session, open_session
+
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB)],
+            pods=[build_pod("c", "p0", None, PodPhase.PENDING,
+                            {"cpu": 1000, "memory": GiB}, group_name="g0")],
+            pod_groups=[PodGroup(name="g0", namespace="c", min_member=1,
+                                 queue="default")],
+        )
+        cols = cache.columns
+        conf = load_scheduler_conf(None)
+        ssn = open_session(cache, conf.tiers)
+        try:
+            snap, _meta = cols.device_snapshot(ssn)
+            r1 = cols.resident_features(snap)
+            r2 = cols.resident_features(snap)
+            assert r1.task_req is r2.task_req  # cached, no re-upload
+            assert r1.node_alloc is r2.node_alloc
+            np.testing.assert_array_equal(
+                np.asarray(r1.task_req), cols.t_init32)
+        finally:
+            close_session(ssn)
+        # ingest invalidates: a new task must appear in the next upload
+        v0 = cols.feature_version
+        cache.add_pod_group(PodGroup(name="g1", namespace="c", min_member=1,
+                                     queue="default"))
+        cache.add_pod(build_pod("c", "p1", None, PodPhase.PENDING,
+                                {"cpu": 2000, "memory": GiB},
+                                group_name="g1"))
+        assert cols.feature_version > v0
+        ssn = open_session(cache, conf.tiers)
+        try:
+            snap2, meta2 = cols.device_snapshot(ssn)
+            r3 = cols.resident_features(snap2)
+            assert r3.task_req is not r1.task_req
+            np.testing.assert_array_equal(
+                np.asarray(r3.task_req), cols.t_init32)
+            # node meta change (labels) invalidates node bits
+            prev_bits = r3.node_label_bits
+            node = cache.nodes["n1"]
+            obj = build_node("n1", cpu=4000, mem=8 * GiB,
+                             labels={"zone": "z1"})
+            node.set_node(obj)
+            snap3, _ = cols.device_snapshot(ssn)
+            r4 = cols.resident_features(snap3)
+            assert r4.node_label_bits is not prev_bits
+        finally:
+            close_session(ssn)
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KB_DEVICE_CACHE", "0")
+        from kube_batch_tpu.framework.conf import load_scheduler_conf
+        from kube_batch_tpu.framework.session import close_session, open_session
+
+        cache = build_cache(
+            queues=["default"],
+            nodes=[build_node("n1", cpu=4000, mem=8 * GiB)],
+            pods=[],
+        )
+        cols = cache.columns
+        conf = load_scheduler_conf(None)
+        ssn = open_session(cache, conf.tiers)
+        try:
+            snap, _ = cols.device_snapshot(ssn)
+            assert cols.resident_features(snap) is snap
+        finally:
+            close_session(ssn)
