@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from kube_batch_tpu.api.cluster_info import ClusterInfo
+from kube_batch_tpu.api.columns import resident_snap
 from kube_batch_tpu.api.snapshot import build_snapshot
 from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
@@ -101,7 +102,6 @@ def dispatch_allocate_solve(snap, config, cols=None):
     device-resident cache (columns.resident_features) so per-cycle
     host→device traffic is only the truly per-cycle arrays; the caller's
     `snap` stays host-backed for its numpy reads."""
-    from kube_batch_tpu.api.columns import resident_snap
     from kube_batch_tpu.parallel.mesh import (
         default_mesh,
         sharded_allocate_solve,
@@ -203,8 +203,6 @@ class AllocateAction(Action):
         # replay-phase regression in the bench breakdown
         t_fit0 = time.perf_counter()
         if bool(np.any(pending & (assigned < 0))):
-            from kube_batch_tpu.api.columns import resident_snap
-
             if self.last_solve_mode == "sharded":
                 from kube_batch_tpu.parallel.mesh import (
                     default_mesh as _dm, sharded_failure_histogram,

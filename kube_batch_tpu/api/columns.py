@@ -195,12 +195,13 @@ class ColumnStore:
         # ---- device-resident feature cache ------------------------------
         # The ingest-static snapshot columns (task requests/bits/priorities,
         # node allocatable/bits) change only at the ingest choke points that
-        # bump feature_version; resident_features() re-uploads them to the
+        # bump the per-axis feature versions; resident_features() re-uploads them to the
         # device ONLY when it moved — per-cycle host→device traffic drops to
         # the genuinely per-cycle columns (statuses, node ledgers, job rows),
         # the SURVEY §7.3 one-transfer-in budget.  Disabled with
         # KB_DEVICE_CACHE=0.
-        self.feature_version = 0
+        self.task_feature_version = 0
+        self.node_feature_version = 0
         self._dev_cache: Dict = {}
 
     # ==================================================================
@@ -252,7 +253,7 @@ class ColumnStore:
         # were already incremented by job.add_task's index choke point.
         task._row = row
         task._store = self
-        self.feature_version += 1
+        self.task_feature_version += 1
 
     def free_task(self, task) -> None:
         row = getattr(task, "_row", -1)
@@ -276,7 +277,7 @@ class ColumnStore:
         self._ported_rows.discard(row)
         self.task_by_row[row] = None
         self.tasks.free(row)
-        self.feature_version += 1
+        self.task_feature_version += 1
 
     def _grow_tasks(self) -> None:
         cap = self.tasks.grown_cap()
@@ -426,7 +427,7 @@ class ColumnStore:
         node.used.vec = self.n_used[row]
         node.allocatable.vec = self.n_alloc[row]
         node.capability.vec = self.n_cap[row]
-        self.feature_version += 1  # fresh n_alloc / bit rows on this row
+        self.node_feature_version += 1  # fresh n_alloc / bit rows on this row
         self.sync_node_meta(node)
         # resident tasks bound before their node rows resolve to -1;
         # repoint them now that the name has a row
@@ -458,7 +459,7 @@ class ColumnStore:
         # node) must not alias whatever node reuses it
         self.t_node[self.t_node == row] = -1
         self.nodes.free(row)
-        self.feature_version += 1
+        self.node_feature_version += 1
 
     def _grow_nodes(self) -> None:
         cap = self.nodes.grown_cap()
@@ -481,8 +482,9 @@ class ColumnStore:
         (or bind). Interns new label pairs / taints; growth of the universe
         marks task bitsets dirty for recompute at next snapshot.
 
-        feature_version bumps only when a CACHED node column (label/taint
-        bits; n_alloc via set_node's own change check) actually changed —
+        the node feature version bumps only when a CACHED node column
+        (label/taint bits; n_alloc via set_node's own change check) actually
+        changed —
         kubelet heartbeats with unchanged content must not flush the
         device-resident cache every cycle."""
         row = node._row
@@ -526,7 +528,7 @@ class ColumnStore:
             np.array_equal(self.n_label_bits[row], label_row)
             and np.array_equal(self.n_taint_bits[row], taint_row)
         ):
-            self.feature_version += 1
+            self.node_feature_version += 1
         self.n_label_bits[row] = label_row
         self.n_taint_bits[row] = taint_row
 
@@ -632,34 +634,42 @@ class ColumnStore:
         if not self._task_bits_dirty:
             return
         self._task_bits_dirty = False
-        self.feature_version += 1
+        self.task_feature_version += 1
         for row in self._sel_rows:
             self._fill_sel_bits(row, self.task_by_row[row])
         for row in self._tol_rows:
             self._fill_tol_bits(row, self.task_by_row[row])
 
-    # snapshot field → ingest-static backing column (resident_features)
+    # snapshot field → (backing column, version axis): per-axis versions
+    # keep pod churn (every successful bind produces a pod update) from
+    # flushing the node columns and vice versa
     FEATURE_FIELDS = {
-        "task_req": "t_init32",
-        "task_resreq": "t_res32",
-        "task_job": "t_job",
-        "task_prio": "t_prio",
-        "task_creation": "t_creation",
-        "task_best_effort": "t_best_effort",
-        "task_critical": "t_critical",
-        "task_needs_host": "t_needs_host",
-        "task_sel_bits": "t_sel_bits",
-        "task_sel_impossible": "t_sel_impossible",
-        "task_tol_bits": "t_tol_bits",
-        "node_alloc": "n_alloc",
-        "node_label_bits": "n_label_bits",
-        "node_taint_bits": "n_taint_bits",
+        "task_req": ("t_init32", "task"),
+        "task_resreq": ("t_res32", "task"),
+        "task_job": ("t_job", "task"),
+        "task_prio": ("t_prio", "task"),
+        "task_creation": ("t_creation", "task"),
+        "task_best_effort": ("t_best_effort", "task"),
+        "task_critical": ("t_critical", "task"),
+        "task_needs_host": ("t_needs_host", "task"),
+        "task_sel_bits": ("t_sel_bits", "task"),
+        "task_sel_impossible": ("t_sel_impossible", "task"),
+        "task_tol_bits": ("t_tol_bits", "task"),
+        "node_alloc": ("n_alloc", "node"),
+        "node_label_bits": ("n_label_bits", "node"),
+        "node_taint_bits": ("n_taint_bits", "node"),
     }
+
+    def bump_task_features(self) -> None:
+        self.task_feature_version += 1
+
+    def bump_node_features(self) -> None:
+        self.node_feature_version += 1
 
     def resident_features(self, snap, mesh=None):
         """`snap` with the ingest-static feature arrays swapped for cached
-        DEVICE-RESIDENT copies, re-uploaded only when feature_version moved
-        since the last call — steady-state cycles then ship only the truly
+        DEVICE-RESIDENT copies, re-uploaded only when the column's axis
+        version moved since the last call — steady-state cycles then ship only the truly
         per-cycle columns (statuses, node ledgers, job/queue rows) to the
         device (SURVEY §7.3's one-transfer-in budget; decisive on a
         network-tunneled TPU).  `shardings`/`key` select a placement (the
@@ -681,9 +691,11 @@ class ColumnStore:
 
             shardings = snapshot_shardings(mesh)
         cache = self._dev_cache.setdefault(mesh, {})
-        version = self.feature_version
+        versions = {"task": self.task_feature_version,
+                    "node": self.node_feature_version}
         updates = {}
-        for field, col in self.FEATURE_FIELDS.items():
+        for field, (col, axis) in self.FEATURE_FIELDS.items():
+            version = versions[axis]
             ver, arr = cache.get(field, (-1, None))
             host = getattr(self, col)
             if ver != version or arr.shape != host.shape:

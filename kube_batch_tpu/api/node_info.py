@@ -134,7 +134,7 @@ class NodeInfo:
             # store's matrices stay the single source of truth; an actual
             # allocatable change invalidates the device-resident n_alloc
             if not np.array_equal(self.allocatable.vec, alloc.vec):
-                self._cols.feature_version += 1
+                self._cols.bump_node_features()
             self.allocatable.vec[:] = alloc.vec
             self.capability.vec[:] = cap.vec
             self.idle.vec[:] = idle_v
@@ -154,6 +154,35 @@ class NodeInfo:
     # algebra, and the 50k-placement replay skips 50k task clones. Readers
     # of node.tasks see live status (the reference's SetNode replay reads
     # live status the same way).
+    def demote_to_placeholder(self) -> None:
+        """Forget the Node object but KEEP the resident task registrations —
+        the inverse of the pod-before-node ingest placeholder. Used when a
+        node is deleted while pods are still bound to it: the tasks outlive
+        the Node (the reference keeps their NodeName too), accounting zeroes
+        out, the node drops out of snapshots (state NotReady), and a later
+        re-add replays everything through set_node."""
+        self.node = None
+        if self._cols is None:
+            # unbound: rebind fresh Resources — clones share allocatable/
+            # capability objects and must not see the zeroing
+            self.allocatable = self.spec.empty()
+            self.capability = self.spec.empty()
+            self.idle = self.spec.empty()
+            self.used = self.spec.empty()
+            self.releasing = self.spec.empty()
+        else:
+            # column-bound: the ledger views are the store's matrices —
+            # zero them in place.  n_alloc is a CACHED feature column and
+            # sync_node_meta early-returns below (no Node object), so the
+            # invalidation must happen here
+            for res in (self.idle, self.used, self.releasing,
+                        self.allocatable, self.capability):
+                res.vec[:] = 0.0
+            self._cols.bump_node_features()
+        self._set_state()
+        if self._cols is not None:
+            self._cols.sync_node_meta(self)
+
     def add_task(self, task: TaskInfo) -> None:
         key = task.key()
         graft_assert(key not in self.tasks, f"duplicate task {key} on node {self.name}")

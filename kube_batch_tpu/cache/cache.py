@@ -388,6 +388,11 @@ class SchedulerCache:
                 node = self.nodes.get(task.node_name) if task.node_name else None
                 if node is not None and task.key() in node.tasks:
                     node.remove_task(task)
+                    # a deleted-node placeholder exists only to carry its
+                    # residents; the last one leaving retires it
+                    if node.node is None and not node.tasks:
+                        self.nodes.pop(node.name, None)
+                        self.columns.free_node(node)
                 self.columns.free_task(task)
             self._maybe_collect_job(job)
 
@@ -429,9 +434,20 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.delete_node, name):
                 return
-            node = self.nodes.pop(name, None)
-            if node is not None:
-                self.columns.free_node(node)
+            node = self.nodes.get(name)
+            if node is None:
+                return
+            if node.tasks:
+                # resident pods outlive the Node object (their NodeName
+                # persists, like the reference's); demote to the nodeless
+                # placeholder the pod-before-node ingest uses instead of
+                # orphaning them — a re-added node then replays their
+                # accounting via set_node, and a kubelet update can't
+                # re-account a task into already-consumed fresh capacity
+                node.demote_to_placeholder()
+                return
+            self.nodes.pop(name)
+            self.columns.free_node(node)
 
     # ------------------------------------------------------------------
     # ingest: podgroups (event_handlers.go:362-481)

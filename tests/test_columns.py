@@ -161,20 +161,54 @@ class TestColumnConsistency:
         assert cache.binder.binds == {"c1/sel": "n1"}
         assert_consistent(cache)
 
-    def test_node_delete_row_reuse_no_alias(self):
-        """Deleting a node with resident bound pods must clear their t_node
-        rows — a later node reusing the freed row must not inherit them."""
+    def test_node_delete_with_residents_demotes_then_retires(self):
+        """Deleting a node with resident bound pods keeps them registered on
+        a nodeless placeholder (zero capacity, excluded from snapshots) — a
+        re-added node replays their accounting via set_node, and a kubelet
+        update can't re-account a task into fresh capacity (the underflow
+        the 150-cycle soak caught). The placeholder retires with its last
+        resident, freeing the row with no task aliasing it."""
+        cols_pods = [build_pod("c1", "resident", "n1", PodPhase.RUNNING,
+                               {"cpu": 500, "memory": GiB})]
         cache = build_cache(
-            queues=["default"],
-            nodes=[build_node("n1")],
-            pods=[build_pod("c1", "resident", "n1", PodPhase.RUNNING,
-                            {"cpu": 500, "memory": GiB})],
+            queues=["default"], nodes=[build_node("n1")], pods=cols_pods,
         )
+        cols = cache.columns
         cache.delete_node("n1")
-        assert (cache.columns.t_node == -1).all()
-        cache.add_node(build_node("n2"))  # reuses the freed row
-        row = cache.columns.node_rows["n2"]
-        assert not (cache.columns.t_node == row).any()
+        # demoted, not freed: resident stays attached, node leaves snapshots
+        node = cache.nodes["n1"]
+        assert node.node is None and "c1/resident" in node.tasks
+        assert not cols.n_valid[node._row]
+        assert (node.allocatable.vec == 0).all()
+        assert_consistent(cache)
+        # re-add: accounting replays (underflow-free), pod still resident
+        cache.add_node(build_node("n1", cpu=4000, mem=8 * GiB))
+        node = cache.nodes["n1"]
+        assert node.node is not None
+        assert node.idle.milli_cpu == 3500.0
+        assert_consistent(cache)
+        # delete again, then the resident dies → placeholder retires
+        cache.delete_node("n1")
+        row = cache.nodes["n1"]._row
+        cache.delete_pod(cache.pods["c1/resident"])
+        assert "n1" not in cache.nodes
+        assert not (cols.t_node == row).any()
+        cache.add_node(build_node("n2"))  # may reuse the freed row
+        row2 = cols.node_rows["n2"]
+        assert not (cols.t_node == row2).any()
+        assert_consistent(cache)
+
+    def test_node_delete_without_residents_frees_row(self):
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")],
+                            pods=[])
+        cols = cache.columns
+        row = cols.node_rows["n1"]
+        live_before = cols.nodes.n_live
+        cache.delete_node("n1")
+        assert "n1" not in cache.nodes
+        assert "n1" not in cols.node_rows  # the COLUMN row was freed too
+        assert cols.nodes.n_live == live_before - 1
+        assert not cols.n_valid[row]
         assert_consistent(cache)
 
     def test_allocate_action_picks_sharded_path(self):
@@ -205,10 +239,13 @@ class TestColumnConsistency:
         assert len(cache.binder.binds) == 4
         assert_consistent(cache)
 
-    def test_node_delete_readd_keeps_task_detached_until_pod_event(self):
-        """A re-added node starts with no resident tasks (the reference's
-        convergence: pods re-attach on their next event); t_node stays -1 —
-        'accounted on', not 'named by' — until the pod update re-attaches."""
+    def test_node_delete_readd_keeps_resident_accounted(self):
+        """A re-added node replays its surviving residents' accounting
+        immediately (the delete demoted, not orphaned, them) — there is no
+        window where bound capacity reads as free (the 150-cycle soak's
+        underflow: the scheduler filled the 'free' capacity, then the pod's
+        next event re-accounted it). A later pod update must not
+        double-account either."""
         cache = build_cache(
             queues=["default"],
             nodes=[build_node("n1")],
@@ -219,15 +256,17 @@ class TestColumnConsistency:
         row = task._row
         cache.delete_node("n1")
         cache.add_node(build_node("n1"))
-        assert int(cache.columns.t_node[row]) == -1
-        assert "c1/res" not in cache.nodes["n1"].tasks
+        node = cache.nodes["n1"]
+        assert int(cache.columns.t_node[row]) == node._row
+        assert "c1/res" in node.tasks
+        idle_cpu = node.idle.milli_cpu
+        assert idle_cpu == node.allocatable.milli_cpu - 500
         assert_consistent(cache)
-        # the pod's next event re-attaches it (informer resync analog)
-        pod = cache.pods["c1/res"]
-        cache.update_pod(pod)
-        task = cache.jobs["c1/res"].tasks["c1/res"]
-        assert "c1/res" in cache.nodes["n1"].tasks
-        assert int(cache.columns.t_node[task._row]) == cache.columns.node_rows["n1"]
+        # the pod's next event (informer resync analog) is idempotent
+        cache.update_pod(cache.pods["c1/res"])
+        node = cache.nodes["n1"]
+        assert node.idle.milli_cpu == idle_cpu
+        assert "c1/res" in node.tasks
         assert_consistent(cache)
 
     def test_randomized_churn_soak(self):
@@ -438,13 +477,13 @@ class TestResidentFeatureCache:
         finally:
             close_session(ssn)
         # ingest invalidates: a new task must appear in the next upload
-        v0 = cols.feature_version
+        v0 = cols.task_feature_version
         cache.add_pod_group(PodGroup(name="g1", namespace="c", min_member=1,
                                      queue="default"))
         cache.add_pod(build_pod("c", "p1", None, PodPhase.PENDING,
                                 {"cpu": 2000, "memory": GiB},
                                 group_name="g1"))
-        assert cols.feature_version > v0
+        assert cols.task_feature_version > v0
         ssn = open_session(cache, conf.tiers)
         try:
             snap2, meta2 = cols.device_snapshot(ssn)
