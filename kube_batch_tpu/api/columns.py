@@ -660,9 +660,6 @@ class ColumnStore:
         "node_taint_bits": ("n_taint_bits", "node"),
     }
 
-    def bump_task_features(self) -> None:
-        self.task_feature_version += 1
-
     def bump_node_features(self) -> None:
         self.node_feature_version += 1
 

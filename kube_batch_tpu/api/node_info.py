@@ -159,7 +159,7 @@ class NodeInfo:
         the inverse of the pod-before-node ingest placeholder. Used when a
         node is deleted while pods are still bound to it: the tasks outlive
         the Node (the reference keeps their NodeName too), accounting zeroes
-        out, the node drops out of snapshots (state NotReady), and a later
+        out, the node drops out of snapshots (state UnInitialized), and a later
         re-add replays everything through set_node."""
         self.node = None
         if self._cols is None:

@@ -326,6 +326,12 @@ class SchedulerCache:
                 return
             if not self._owns(pod):
                 return
+            if pod.key() in self.pods:
+                # informer semantics are add-or-update: a duplicate ADDED
+                # (watch reconnect races, replayed seeds) must upsert, not
+                # trip the duplicate-task invariant
+                self.update_pod(pod)
+                return
             self._resolve_pod_priority(pod)
             self.pods[pod.key()] = pod
             task = TaskInfo(pod, self.spec)
@@ -361,7 +367,10 @@ class SchedulerCache:
             stored = self.pods.get(pod.key())
             if stored is not None and stored.node_name and not pod.node_name:
                 pod.node_name = stored.node_name
-            self._delete_pod_locked(pod)
+            # the add below would immediately recreate a placeholder the
+            # delete retired — keep it alive across an update, or every
+            # status event for such a pod flushes the node feature cache
+            self._delete_pod_locked(pod, retire_placeholder=not self._owns(pod))
             if self._owns(pod):
                 self._resolve_pod_priority(pod)
                 self.pods[pod.key()] = pod
@@ -373,7 +382,7 @@ class SchedulerCache:
                 return
             self._delete_pod_locked(pod)
 
-    def _delete_pod_locked(self, pod: Pod) -> None:
+    def _delete_pod_locked(self, pod: Pod, retire_placeholder: bool = True) -> None:
         self.pods.pop(pod.key(), None)
         self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
         release = getattr(self.volume_binder, "release_task", None)
@@ -390,7 +399,7 @@ class SchedulerCache:
                     node.remove_task(task)
                     # a deleted-node placeholder exists only to carry its
                     # residents; the last one leaving retires it
-                    if node.node is None and not node.tasks:
+                    if retire_placeholder and node.node is None and not node.tasks:
                         self.nodes.pop(node.name, None)
                         self.columns.free_node(node)
                 self.columns.free_task(task)

@@ -564,3 +564,60 @@ class TestVolumeK8sMode:
                if "persistentvolumeclaims/scratch" in r[1]]
         assert ann and ann[0][2]["metadata"]["annotations"][
             SELECTED_NODE_ANNOTATION] == "node-a"
+
+
+class TestEventFuzz:
+    def test_shuffled_duplicate_events_keep_cache_consistent(self):
+        """Watch streams can deliver duplicates and orderings the happy path
+        never sees (reconnect races, re-list overlap): random multisets of
+        ADDED/MODIFIED/DELETED per object, shuffled, must leave a consistent
+        cache that still schedules — duplicate ADDED upserts (informer
+        add-or-update semantics), DELETED of unknowns no-ops."""
+        import numpy as np
+
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.scheduler import Scheduler
+
+        def node(name):
+            n = json.loads(json.dumps(FIXTURES["node"]))
+            n["metadata"]["name"] = name
+            n["spec"]["taints"] = []
+            return n
+
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            cache = SchedulerCache(spec=ResourceSpec(scalar_names=(GPU,)),
+                                   volume_binder=K8sPVLedger())
+            adapter = WatchAdapter(cache, api_server="http://unused")
+            objects = (
+                [("queues", FIXTURES["queue"]),
+                 ("queues", {"metadata": {"name": "default"},
+                             "spec": {"weight": 1}}),
+                 ("priorityclasses", FIXTURES["priorityclass"]),
+                 ("podgroups", FIXTURES["podgroup"]),
+                 ("storageclasses", FIXTURES["storageclass_local"]),
+                 ("persistentvolumes", FIXTURES["pv_local"]),
+                 ("persistentvolumeclaims", FIXTURES["pvc_unbound"]),
+                 ("poddisruptionbudgets", FIXTURES["pdb"])]
+                + [("nodes", node(f"n{i}")) for i in range(3)]
+                + [("pods", _gang_pod(i)) for i in range(4)]
+            )
+            events = []
+            for kind, obj in objects:
+                for _ in range(int(rng.integers(1, 4))):
+                    events.append((kind, str(rng.choice(
+                        ["ADDED", "MODIFIED", "DELETED"])), obj))
+            order = rng.permutation(len(events))
+            adapter.replay([events[i] for i in order])
+            cache.mark_synced()
+            sched = Scheduler(cache)
+            sched.run_once()
+            cache.flush_binds()
+            errs = cache.columns.check_consistency(cache)
+            assert not errs, (seed, errs[:5])
+            # a full re-list (everything as MODIFIED upserts) converges
+            adapter.replay([(k, "MODIFIED", o) for k, o in objects])
+            sched.run_once()
+            cache.flush_binds()
+            errs = cache.columns.check_consistency(cache)
+            assert not errs, (seed, "after relist", errs[:5])
