@@ -324,13 +324,16 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.add_pod, pod):
                 return
-            if not self._owns(pod):
-                return
             if pod.key() in self.pods:
                 # informer semantics are add-or-update: a duplicate ADDED
                 # (watch reconnect races, replayed seeds) must upsert, not
-                # trip the duplicate-task invariant
+                # trip the duplicate-task invariant.  Checked BEFORE the
+                # ownership gate: the new state may have LEFT our ownership
+                # (rebound to another scheduler) and update_pod drops the
+                # stale cached task either way
                 self.update_pod(pod)
+                return
+            if not self._owns(pod):
                 return
             self._resolve_pod_priority(pod)
             self.pods[pod.key()] = pod

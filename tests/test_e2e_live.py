@@ -13,16 +13,35 @@ import sys
 import pytest
 
 
-@pytest.mark.slow
-def test_e2e_scenarios_against_stub_apiserver():
+def _run_e2e(*args, timeout=650):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
     )
-    r = subprocess.run(
-        [sys.executable, "-m", "kube_batch_tpu.testing.e2e", "--stub"],
-        capture_output=True, text=True, timeout=560, env=env, cwd=repo,
+    return subprocess.run(
+        [sys.executable, "-m", "kube_batch_tpu.testing.e2e", "--stub", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=repo,
     )
+
+
+@pytest.mark.slow
+def test_e2e_scenarios_against_stub_apiserver():
+    r = _run_e2e()
     assert r.returncode == 0, f"e2e driver failed:\n{r.stdout[-6000:]}\n{r.stderr[-2000:]}"
     assert "9/9 scenarios passed" in r.stdout, r.stdout[-3000:]
+
+
+@pytest.mark.slow
+def test_density_benchmark_against_stub():
+    """The kubemark density benchmark (reduced) through the live protocol:
+    a 100-pod gang (the driver's min(100, pods)) + 150 latency pods on 30
+    hollow nodes, all scheduled.  Subprocess timeout exceeds run_density's
+    own 600s wait so a stall still surfaces the scheduler diagnostics."""
+    r = _run_e2e("--density", "--density-pods", "150", "--density-nodes", "30",
+                 timeout=800)
+    assert r.returncode == 0, f"{r.stdout[-4000:]}\n{r.stderr[-2000:]}"
+    import json as _json
+
+    out = _json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["pods"] == 150 and out["startup_p99_ms"] > 0
