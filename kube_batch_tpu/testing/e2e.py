@@ -31,6 +31,7 @@ cluster; there the kubelet/PV controller do that work.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import logging
 import queue as _queue
@@ -685,9 +686,6 @@ SCENARIOS = {
 # ---------------------------------------------------------------------------
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def scheduler_process(master: str, extra_args=(), **auth):
     """The REAL CLI scheduler (`python -m kube_batch_tpu.cmd.main --master
@@ -751,17 +749,19 @@ def scheduler_process(master: str, extra_args=(), **auth):
 
 
 def run_scenario(name: str, master: str, **auth) -> None:
-    """One scenario: scheduler up, scenario body, scheduler down."""
+    """One scenario: scheduler up, scenario body, scheduler DOWN, then
+    teardown — deleting the scenario's objects under a live scheduler would
+    bury failure-time log diagnostics in teardown-reaction noise."""
     c = Cluster(master, **auth)
-    with scheduler_process(master, **auth) as proc:
-        try:
+    try:
+        with scheduler_process(master, **auth) as proc:
             c.ensure_namespace(f"e2e-{name.replace('_', '-')}")
             SCENARIOS[name](c, ns=f"e2e-{name.replace('_', '-')}")
             if proc.poll() is not None:
                 raise RuntimeError(
                     f"scheduler exited early rc={proc.returncode}")
-        finally:
-            c.teardown()
+    finally:
+        c.teardown()
 
 
 def run_density(master: str, n_pods: int = 3000, n_nodes: int = 100,
@@ -779,10 +779,11 @@ def run_density(master: str, n_pods: int = 3000, n_nodes: int = 100,
     # density is a THROUGHPUT measurement: lift the client egress throttle
     # (kube-api-qps 50 would serialize the per-cycle status writeback into
     # the latency signal; the reference's kubemark rig tunes QPS up too)
-    with scheduler_process(master, extra_args=(
-            "--kube-api-qps", "5000", "--kube-api-burst", "10000"), **auth), \
-            contextlib.ExitStack() as stack:
+    # teardown runs AFTER the scheduler process exits (see run_scenario)
+    with contextlib.ExitStack() as stack:
         stack.callback(c.teardown)
+        stack.enter_context(scheduler_process(master, extra_args=(
+            "--kube-api-qps", "5000", "--kube-api-burst", "10000"), **auth))
         c.queue(f"{ns}-q", 1)
         for i in range(n_nodes):
             c.create(_COLLECTIONS["nodes"],
