@@ -565,6 +565,31 @@ class TestDebugEndpoints:
         finally:
             srv.stop()
 
+    def test_pprof_samples_other_threads(self):
+        """/debug/pprof is a SAMPLING profiler over every thread — it must
+        attribute samples to a busy worker thread, not just itself."""
+        import threading as _threading
+
+        cache = SchedulerCache()
+        srv = AdminServer(cache, port=0)
+        srv.start()
+        stop = _threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        t = _threading.Thread(target=busy, daemon=True)
+        t.start()
+        try:
+            body = _get(srv.port, "/debug/pprof?seconds=0.5")
+            assert "samples:" in body
+            assert "busy" in body, body[:500]
+        finally:
+            stop.set()
+            srv.stop()
+
 
 class TestCacheSyncBarrier:
     def test_wait_for_cache_sync(self):
