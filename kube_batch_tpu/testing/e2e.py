@@ -20,6 +20,7 @@ predicates.go:35,84,161):
   taints            — only tolerating pods land on a tainted node
   hostport          — same hostPort forces distinct nodes
   volume            — a local-PV claim pins its pod; the PV pre-binds
+  job_priority      — a PriorityClass-backed job wins contended capacity
 
 With --stub, an in-process fake apiserver (real HTTP, real watch streams)
 plays the cluster, including the kubelet's part: a Binding POST transitions
@@ -668,6 +669,27 @@ def scenario_volume(c: Cluster, ns: str) -> None:
     c.wait(claim_ref_landed, timeout=30, what="PV claimRef pre-bound")
 
 
+def scenario_job_priority(c: Cluster, ns: str) -> None:
+    """Job priority (job.go:410): when both jobs are pending and capacity
+    fits only one, the PriorityClass-backed job wins it atomically."""
+    c.queue(f"{ns}-q", 1)
+    c.create(_COLLECTIONS["priorityclasses"], {
+        "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+        "metadata": {"name": f"{ns}-high"}, "value": 1000,
+    })
+    c.create(_COLLECTIONS["nodes"], c.node_obj(f"{ns}-n1", cpu_m=4000))
+    # low submitted FIRST (earlier creation would win a priority tie)
+    c.podgroup(ns, "low", 4, f"{ns}-q")
+    for i in range(4):
+        c.pod(ns, f"low{i}", "low")
+    c.podgroup(ns, "high", 4, f"{ns}-q")
+    for i in range(4):
+        c.pod(ns, f"high{i}", "high", priority=1000)
+    c.wait(lambda: c.n_on_nodes(ns, "high") == 4, timeout=60,
+           what="high-priority job placed first")
+    assert c.n_on_nodes(ns, "low") == 0, "low job took the contended capacity"
+
+
 SCENARIOS = {
     "gang": scenario_gang,
     "gang_full": scenario_gang_full,
@@ -678,6 +700,7 @@ SCENARIOS = {
     "taints": scenario_taints,
     "hostport": scenario_hostport,
     "volume": scenario_volume,
+    "job_priority": scenario_job_priority,
 }
 
 

@@ -29,7 +29,7 @@ def _run_e2e(*args, timeout=650):
 def test_e2e_scenarios_against_stub_apiserver():
     r = _run_e2e()
     assert r.returncode == 0, f"e2e driver failed:\n{r.stdout[-6000:]}\n{r.stderr[-2000:]}"
-    assert "9/9 scenarios passed" in r.stdout, r.stdout[-3000:]
+    assert "10/10 scenarios passed" in r.stdout, r.stdout[-3000:]
 
 
 @pytest.mark.slow
