@@ -259,10 +259,9 @@ def main() -> None:
 
     if section("pipeline5", margin_s=180):
         with guarded("pipeline5"):
-            conf5 = load_scheduler_conf(
-                os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "config", "kube-batch-tpu-conf.yaml")
-            )
+            from kube_batch_tpu.framework.conf import shipped_conf_path
+
+            conf5 = load_scheduler_conf(shipped_conf_path())
 
             def pending_cluster():
                 cache = synthetic_cluster(

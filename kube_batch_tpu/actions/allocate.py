@@ -682,7 +682,12 @@ class AllocateAction(Action):
                 "job %s not ready after device solve (%d placements), discarding",
                 job.uid, int(idxs.size),
             )
+            # the session carries the control signal (backfill's real-request
+            # gate reads ssn.host_discards — ADVICE.md #5: the registry
+            # singleton's counter crossed wires between scheduler instances);
+            # the instance counter stays as a bench/diagnostics record
             self.last_host_discards += 1
+            ssn.host_discards += 1
             stmt.discard()
 
     def _record_fit_errors(self, ssn, meta, fail_hist, assigned, task_job, pending) -> None:

@@ -21,7 +21,7 @@ import logging
 
 from kube_batch_tpu.api.job_info import FitError, FitErrors
 from kube_batch_tpu.api.types import PodGroupPhase, TaskStatus
-from kube_batch_tpu.framework.interface import Action, get_action
+from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import FitFailure
 
 logger = logging.getLogger("kube_batch_tpu")
@@ -66,12 +66,12 @@ class BackfillAction(Action):
         # the pass re-pays a full [T, N] solve, so it only runs when the
         # allocate action actually stranded capacity this cycle; without
         # that signal the post-allocate pending set is exactly the set the
-        # solve just failed, and re-solving is wasted work
-        try:
-            alloc = get_action("allocate")
-        except KeyError:
-            return
-        if not getattr(alloc, "last_host_discards", 0):
+        # solve just failed, and re-solving is wasted work.  The signal
+        # rides the SESSION (set by allocate's discard path): the action
+        # registry is a process-global singleton, and reading its counter
+        # here crossed wires between scheduler instances sharing a process
+        # (tests, the simulator's many schedulers) — ADVICE.md #5
+        if not getattr(ssn, "host_discards", 0):
             return
         import jax
         import numpy as np

@@ -183,6 +183,10 @@ class K8sPVLedger(StandalonePVBinder):
     # failed cluster writes kept for retry — bounded so an apiserver outage
     # can't grow the queue (and replay staleness) without limit
     MAX_PENDING_WRITES = 256
+    # seconds between timer-driven retry flushes while writes are queued —
+    # an IDLE scheduler (no further binds) must still drain the queue
+    # (ADVICE.md #2: retries used to wait for the next bind_volumes call)
+    RETRY_FLUSH_INTERVAL = 5.0
 
     def __init__(self, transport=None, bucket=None):
         super().__init__()
@@ -193,6 +197,7 @@ class K8sPVLedger(StandalonePVBinder):
         self._selected_node: Dict[str, str] = {}  # task uid → chosen host
         self._pending_writes: list = []  # failed PATCHes awaiting retry
         self._writer = None  # lazy single-thread pool for cluster writes
+        self._retry_timer = None  # armed while _pending_writes is non-empty
 
     # -- ingest (pvc / storageclass informer analogs) --------------------
     def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
@@ -343,18 +348,25 @@ class K8sPVLedger(StandalonePVBinder):
 
     def drain_writes(self) -> None:
         """Block until every submitted cluster write ran (tests, shutdown)."""
-        if self._writer is not None:
-            self._writer.submit(lambda: None).result()
+        with self._lock:
+            writer = self._writer
+        # result() outside the lock: the queued _run_writes needs it
+        if writer is not None:
+            writer.submit(lambda: None).result()
 
     # -- throttled, retried, OFF-CYCLE cluster writes ---------------------
     def _submit_writes(self, writes) -> None:
-        if self._writer is None:
-            from concurrent.futures import ThreadPoolExecutor
+        # create + submit under the lock: the retry timer races the bind
+        # dispatch thread here, and two lazily-built executors would break
+        # the single-writer ordering (and drain_writes' fence)
+        with self._lock:
+            if self._writer is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._writer = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="pv-writes"
-            )
-        self._writer.submit(self._run_writes, writes)
+                self._writer = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="pv-writes"
+                )
+            self._writer.submit(self._run_writes, writes)
 
     def _run_writes(self, writes) -> None:
         with self._lock:
@@ -374,8 +386,50 @@ class K8sPVLedger(StandalonePVBinder):
                     self._pending_writes.append((path, body))
                     overflow = len(self._pending_writes) - self.MAX_PENDING_WRITES
                     if overflow > 0:
+                        dropped = self._pending_writes[:overflow]
                         del self._pending_writes[:overflow]
+                        self._forget_dropped_writes(dropped)
                         logger.warning(
                             "volume write retry queue full; dropped %d oldest "
-                            "(next cycles re-derive bindings)", overflow,
+                            "and released their ledger bindings so later "
+                            "cycles re-derive them", overflow,
                         )
+        with self._lock:
+            if self._pending_writes:
+                self._arm_retry_timer_locked()
+
+    def _forget_dropped_writes(self, dropped) -> None:
+        """A dropped claimRef PATCH must also drop its `bound` entry, or the
+        cluster-side bind is lost for good: the unbound-PVC watch event
+        deliberately doesn't clear `bound` (the in-flight-PATCH race above),
+        so nothing else would ever re-derive the write (ADVICE.md #2).
+        Selected-node annotation drops need no ledger undo — the claim re-
+        annotates on the task's next allocate/bind. Caller holds the lock."""
+        for path, body in dropped:
+            ref = ((body.get("spec") or {}).get("claimRef") or {})
+            if not ref.get("name"):
+                continue
+            key = f"{ref.get('namespace', 'default')}/{ref['name']}"
+            pv = path.rsplit("/", 1)[-1]
+            if self.bound.get(key) == pv:
+                del self.bound[key]
+
+    def _arm_retry_timer_locked(self) -> None:
+        """Schedule a timer-driven flush so queued retries drain even when
+        no further bind_volumes call arrives. One timer at a time; it
+        disarms itself and re-arms from _run_writes while work remains."""
+        if self._retry_timer is not None:
+            return
+        import threading
+
+        t = threading.Timer(self.RETRY_FLUSH_INTERVAL, self._timer_flush)
+        t.daemon = True
+        self._retry_timer = t
+        t.start()
+
+    def _timer_flush(self) -> None:
+        with self._lock:
+            self._retry_timer = None
+            if not self._pending_writes or self.transport is None:
+                return
+        self._submit_writes([])

@@ -289,18 +289,21 @@ class TokenBucket:
         self._time = _time
 
     def take(self) -> None:
+        """Reserve a token under the lock, sleep OUTSIDE it. The balance may
+        go negative: each waiter's debt position is its reservation, and its
+        wait is the time until its own token mints — so concurrent waiters
+        (the 16-worker status pool, the binder, the pv-writes thread) sleep
+        in parallel instead of serializing behind whoever holds the lock
+        (ADVICE.md #3). Aggregate rate is unchanged: tokens still mint at
+        qps with a burst cap, and reservations are FIFO by lock order."""
         with self._lock:
             now = self._time.monotonic()
             self._tokens = min(self._burst, self._tokens + (now - self._last) * self._qps)
             self._last = now
-            if self._tokens < 1.0:
-                wait = (1.0 - self._tokens) / self._qps
-                # the slept interval mints exactly the token consumed here
-                self._last = now + wait
-                self._tokens = 0.0
-                self._time.sleep(wait)
-            else:
-                self._tokens -= 1.0
+            self._tokens -= 1.0
+            wait = -self._tokens / self._qps if self._tokens < 0.0 else 0.0
+        if wait > 0.0:
+            self._time.sleep(wait)
 
 
 class RateLimitedBackend:

@@ -133,6 +133,18 @@ def default_configuration() -> SchedulerConfiguration:
     return parse_scheduler_conf(DEFAULT_CONF)
 
 
+def shipped_conf_path() -> str:
+    """Absolute path of the shipped 5-action conf
+    (config/kube-batch-tpu-conf.yaml) — the one deployment ships and the
+    e2e/bench/sim drivers load; resolved relative to the repo root."""
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "config", "kube-batch-tpu-conf.yaml")
+
+
 def load_scheduler_conf(path: Optional[str]) -> SchedulerConfiguration:
     """Load conf from a file path, or the built-in default when None
     (pkg/scheduler/util.go:44-61). Unknown actions raise KeyError at

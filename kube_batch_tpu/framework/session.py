@@ -175,6 +175,13 @@ class Session:
         # jobs the open gate dropped (gang-invalid, session.go:107-124) —
         # their podgroups still count toward QueueStatus phase counts
         self.gate_dropped_jobs: List[JobInfo] = []
+        # jobs whose placements the allocate replay DISCARDED host-side this
+        # cycle (JobReady failures after host predicate rejections, volume
+        # demotion dead-ends) — the backfill action's real-request pass keys
+        # off this. Carried on the session, NOT the process-global action
+        # registry singleton: multiple Scheduler/cache instances in one
+        # process (tests, the simulator) must not cross wires (ADVICE.md #5)
+        self.host_discards = 0
 
     def drop_job(self, uid: str) -> None:
         """Remove a job from the session (open-gate drops) and invalidate

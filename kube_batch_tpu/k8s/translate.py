@@ -360,18 +360,41 @@ def priority_class_from_k8s(obj: dict) -> PriorityClass:
     )
 
 
+# Sentinel "node" for a PV whose required nodeAffinity exists but isn't a
+# recognizable single-node pin: it never equals a real hostname, so the
+# ledger treats the PV as reachable from NO node (fail-closed). The previous
+# behavior — node=None, reachable from every node — let --master mode bind a
+# pod onto a node that cannot attach the volume (ADVICE.md #1); the reference
+# delegates to the k8s volumebinder, which honors full PV node affinity.
+PV_NODE_RESTRICTED_UNKNOWN = "__pv-node-affinity-unrecognized__"
+
+
 def _pv_node_from_affinity(spec: dict) -> Optional[str]:
     """A local PV's single reachable node, read from the
-    spec.nodeAffinity required terms (the kubernetes.io/hostname or
-    metadata.name expression local-storage provisioning writes); None for
-    network volumes reachable everywhere."""
+    spec.nodeAffinity required terms (the kubernetes.io/hostname label or
+    metadata.name field expression local-storage provisioning writes); None
+    only for volumes with NO required affinity (network volumes reachable
+    everywhere). Required terms are OR'd: any recognized single-node term
+    yields its node; required terms that are all unrecognized (zone/region
+    topology, operators other than In) are restrictive — the PV gets the
+    no-node sentinel rather than failing open."""
     required = ((spec.get("nodeAffinity") or {}).get("required") or {})
-    for term in required.get("nodeSelectorTerms") or []:
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return None
+    for term in terms:
+        # _match_expressions folds matchFields metadata.name In onto the
+        # hostname label (every kubelet sets it to the node name); some
+        # provisioners put metadata.name in matchExpressions instead
         for e in _match_expressions(term):
             key, op, values = e
-            if key == "kubernetes.io/hostname" and op == "In" and values:
+            if (
+                key in ("kubernetes.io/hostname", "metadata.name")
+                and op == "In"
+                and values
+            ):
                 return values[0]
-    return None
+    return PV_NODE_RESTRICTED_UNKNOWN
 
 
 def pv_from_k8s(obj: dict) -> PersistentVolume:

@@ -31,6 +31,12 @@ import jax
 # (ops.assignment's module-level jnp constants) initialises the XLA backend,
 # which must not happen before jax.distributed.initialize runs
 
+# fallback re-init guard for jax versions without
+# jax.distributed.is_initialized: without it a second initialize() call
+# skipped the guard entirely and raised from jax.distributed.initialize
+# (ADVICE.md #4). Set only on success, so a failed attempt stays retryable.
+_initialized = False
+
 
 def initialize(
     coordinator: Optional[str] = None,
@@ -48,20 +54,26 @@ def initialize(
     checks only the coordination-service client — backend-safe, and a
     failed earlier attempt (which leaves coordinator_address residue but no
     client) stays retryable."""
+    global _initialized
     is_init = getattr(jax.distributed, "is_initialized", None)
-    if is_init is not None and is_init():
-        return  # already initialized
+    if is_init is not None:
+        if is_init():
+            return  # already initialized
+    elif _initialized:
+        return  # module-level fallback guard (no is_initialized probe)
     if coordinator is None and num_processes is None:
         try:
             jax.distributed.initialize()
         except (RuntimeError, ValueError):
-            pass  # single-process / no cluster env — stay local
+            return  # single-process / no cluster env — stay local
+        _initialized = True
         return
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
     )
+    _initialized = True
 
 
 def global_mesh():

@@ -31,8 +31,15 @@ class Scheduler:
         conf_path: Optional[str] = None,
         schedule_period: float = 1.0,
         on_cycle_end=None,
+        clock=None,
     ):
         self.cache = cache
+        # injected time source for the loop's pacing (monotonic() + sleep());
+        # defaults to the wall clock. The virtual-time simulator
+        # (kube_batch_tpu/sim) injects its VirtualClock so cycle pacing is
+        # simulated time, while the latency *metrics* below stay wall-clock
+        # (they measure real compute, not scenario time).
+        self.clock = clock if clock is not None else time
         self.conf = conf if conf is not None else load_scheduler_conf(conf_path)
         # resolve actions at construction — unknown names raise (util.go:63-70)
         self.actions: List[Action] = [get_action(n) for n in self.conf.actions]
@@ -129,7 +136,7 @@ class Scheduler:
             cache_run(resync_period=min(self.schedule_period, 1.0))
         try:
             while not self._stop:
-                tick = time.perf_counter()
+                tick = self.clock.monotonic()
                 try:
                     self.run_once()
                 except Exception:  # noqa: BLE001 — next cycle self-corrects
@@ -144,8 +151,8 @@ class Scheduler:
                             recover()
                         except Exception:  # noqa: BLE001
                             logger.exception("re-list recovery failed")
-                elapsed = time.perf_counter() - tick
-                time.sleep(max(self.schedule_period - elapsed, 0.0))
+                elapsed = self.clock.monotonic() - tick
+                self.clock.sleep(max(self.schedule_period - elapsed, 0.0))
         finally:
             cache_stop = getattr(self.cache, "stop", None)
             if cache_stop is not None:

@@ -1,0 +1,8 @@
+"""Virtual-time cluster simulator: event-driven workload replay, fault
+injection, and longitudinal scheduling metrics over the real
+Scheduler/SchedulerCache. See sim/runner.py for the loop and
+`python -m kube_batch_tpu.sim --help` for the CLI."""
+
+from kube_batch_tpu.sim.runner import SimConfig, SimRunner, preset, run_preset
+
+__all__ = ["SimConfig", "SimRunner", "preset", "run_preset"]

@@ -1,0 +1,50 @@
+"""CLI for the virtual-time cluster simulator.
+
+    python -m kube_batch_tpu.sim --seed 7 --preset smoke
+    python -m kube_batch_tpu.sim --preset fault --trace /tmp/fault.jsonl
+
+Emits a single JSON report (BENCH_*.json style: `metric`/`value`/`unit`
+plus the longitudinal detail) on stdout. Same seed ⇒ byte-identical trace
+(`trace_sha256` in the report is the determinism receipt).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kube_batch_tpu.sim.runner import run_preset
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--preset", default="smoke",
+                    help="scenario: smoke | fault | churn (default smoke)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cycles", type=int, default=None,
+                    help="override the preset's virtual-cycle budget")
+    ap.add_argument("--trace", default=None,
+                    help="write the JSONL event trace to this path")
+    ap.add_argument("--report", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--no-fairness-series", action="store_true",
+                    help="omit the per-cycle fairness series (compact)")
+    args = ap.parse_args(argv)
+
+    report = run_preset(args.preset, seed=args.seed, cycles=args.cycles,
+                        trace_path=args.trace)
+    if args.no_fairness_series:
+        report.pop("fairness_series", None)
+    out = json.dumps(report, indent=2, sort_keys=True)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+    print(out, flush=True)
+    errs = report.get("invariants", {}).get("errors", [])
+    recovered = report.get("fault_recovery", {}).get("recovered", True)
+    return 0 if not errs and recovered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

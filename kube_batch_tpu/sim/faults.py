@@ -1,0 +1,131 @@
+"""Fault injection for the simulator: node crash/re-add, binder failure
+windows, watch-stream flaps, and eviction-termination delay.
+
+Faults are ordinary `SimEvent`s on the heap; the runner hands the fault
+kinds here. Each handler mutates the cluster through the same ingest
+surface a real failure would use (delete_node / update_pod / binder
+errors), so the scheduler sees faults exactly as it would in production —
+then schedules the deterministic fallout (pod losses, node return).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from kube_batch_tpu.api.pod import Node
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.sim import events as ev
+
+# resolve-at-apply-time crash target: the node carrying the most resident
+# sim pods when the fault fires (ties break by name) — guarantees the crash
+# actually displaces work regardless of where the solver placed it
+BUSIEST = "@busiest"
+
+
+def node_crash_script(t: float, node: str = BUSIEST, down_for: float = 10.0,
+                      pod_fail_after: float = 1.0) -> List[ev.SimEvent]:
+    """Crash `node` at t; its residents are lost pod_fail_after later (the
+    node-lifecycle controller's pod GC analog); the node returns at
+    t + down_for (re-add is scheduled at apply time, once the target
+    resolves)."""
+    return [ev.SimEvent(t, ev.NODE_CRASH, {
+        "node": node, "down_for": down_for,
+        "pod_fail_after": pod_fail_after,
+    })]
+
+
+def bind_fail_script(t: float, count: int) -> List[ev.SimEvent]:
+    return [ev.SimEvent(t, ev.BIND_FAIL, {"count": count})]
+
+
+def watch_flap_script(t: float) -> List[ev.SimEvent]:
+    return [ev.SimEvent(t, ev.WATCH_FLAP, {})]
+
+
+class FaultInjector:
+    """Applies fault events against a running simulation. The runner owns
+    the clock/heap/trace; this class owns what a fault *means*."""
+
+    def __init__(self, runner):
+        self.runner = runner
+        self.crashed_nodes = {}   # name -> Node object to re-add
+        self.displaced_jobs = set()  # job uids that lost pods to crashes
+
+    def apply(self, event: ev.SimEvent) -> None:
+        handler = {
+            ev.NODE_CRASH: self._node_crash,
+            ev.NODE_READD: self._node_readd,
+            ev.BIND_FAIL: self._bind_fail,
+            ev.WATCH_FLAP: self._watch_flap,
+        }[event.kind]
+        handler(event)
+
+    # ---- handlers --------------------------------------------------------
+    def _resolve_node(self, name: str) -> str:
+        if name != BUSIEST:
+            return name
+        counts = {}
+        for pod in self.runner.cache.pods.values():
+            if pod.node_name:
+                counts[pod.node_name] = counts.get(pod.node_name, 0) + 1
+        if not counts:  # nothing placed yet — crash the first node
+            return next(iter(self.runner.cache.nodes), "")
+        return max(counts, key=lambda n: (counts[n], n))
+
+    def _node_crash(self, event: ev.SimEvent) -> None:
+        runner = self.runner
+        name = self._resolve_node(event.data["node"])
+        node_info = runner.cache.nodes.get(name)
+        if node_info is None or node_info.node is None:
+            return
+        # keep the Node spec for the re-add; record resolved target in trace
+        self.crashed_nodes[name] = dataclasses.replace(node_info.node)
+        residents = sorted(
+            pod.key() for pod in runner.cache.pods.values()
+            if pod.node_name == name and pod.phase in (PodPhase.PENDING,
+                                                       PodPhase.RUNNING)
+        )
+        runner.trace.record(ev.SimEvent(event.time, ev.NODE_CRASH, {
+            "node": name, "residents": residents,
+        }))
+        runner.cache.delete_node(name)
+        t = event.time
+        for key in residents:
+            job = runner.job_of_pod(key)
+            if job is not None:
+                self.displaced_jobs.add(job)
+            runner.heap.push(ev.SimEvent(
+                t + event.data.get("pod_fail_after", 1.0), ev.POD_FAILED,
+                {"key": key, "node": name},
+            ))
+        runner.heap.push(ev.SimEvent(
+            t + event.data.get("down_for", 10.0), ev.NODE_READD, {"node": name}
+        ))
+
+    def _node_readd(self, event: ev.SimEvent) -> None:
+        name = event.data["node"]
+        node = self.crashed_nodes.pop(name, None)
+        if node is None:
+            return
+        self.runner.trace.record(event)
+        self.runner.cache.add_node(Node(
+            name=node.name, allocatable=dict(node.allocatable),
+            capacity=dict(node.capacity), labels=dict(node.labels),
+            taints=list(node.taints),
+        ))
+
+    def _bind_fail(self, event: ev.SimEvent) -> None:
+        self.runner.trace.record(event)
+        self.runner.kubelet.fail_next_binds(event.data["count"])
+
+    def _watch_flap(self, event: ev.SimEvent) -> None:
+        """Watch reconnect: the stream replays the whole store as MODIFIED
+        (StubApiServer's list→watch gap closure) — every pod re-ingests
+        through update_pod's upsert path."""
+        runner = self.runner
+        pods = list(runner.cache.pods.values())
+        runner.trace.record(ev.SimEvent(event.time, ev.WATCH_FLAP,
+                                        {"replayed": len(pods)}))
+        for pod in pods:
+            runner.cache.update_pod(dataclasses.replace(pod))

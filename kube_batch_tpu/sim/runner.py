@@ -1,0 +1,476 @@
+"""The virtual-time cluster simulator: event-driven workload replay over
+the REAL Scheduler/SchedulerCache.
+
+Each virtual cycle: (1) all due events apply to the cache through the
+ordinary ingest surface (add_pod/update_pod/add_node — the event-handler
+path a live watch stream feeds), (2) the real L1 `Scheduler.run_once()`
+executes the configured action pipeline, (3) binder/evictor acks drain
+from the simulated kubelet and schedule lifecycle follow-ups on the event
+heap, (4) longitudinal metrics sample the cache, (5) the virtual clock
+advances one schedule period. No apiserver, no wall-clock waits, no
+sampling during the run — same seed, byte-identical trace.
+
+`python -m kube_batch_tpu.sim --seed 7 --preset smoke` is the CLI front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue
+from kube_batch_tpu.api.types import PodPhase, TaskStatus, is_allocated
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.framework.conf import parse_scheduler_conf
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim import events as ev
+from kube_batch_tpu.sim import kubelet as kl
+from kube_batch_tpu.sim import workload
+from kube_batch_tpu.sim.clock import EventHeap, VirtualClock
+from kube_batch_tpu.sim.events import SimEvent, TraceRecorder
+from kube_batch_tpu.sim.faults import (
+    BUSIEST,
+    FaultInjector,
+    bind_fail_script,
+    node_crash_script,
+    watch_flap_script,
+)
+from kube_batch_tpu.sim.metrics import LongitudinalMetrics
+from kube_batch_tpu.testing.synthetic import GiB
+
+SIM_NS = workload.SIM_NS
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """One simulation scenario. Everything that shapes the run is here (and
+    is echoed into the report) so a config + seed IS the experiment."""
+
+    seed: int = 0
+    # cluster
+    n_nodes: int = 6
+    node_cpu: float = 16000.0
+    node_mem: float = 64 * GiB
+    node_pods: float = 110.0
+    queues: Tuple[Tuple[str, int], ...] = (("q0", 1), ("q1", 2))
+    # loop
+    cycles: int = 60
+    period: float = 1.0
+    # None → the SHIPPED 5-action conf (enqueue, reclaim, allocate,
+    # backfill, preempt), like the e2e driver — NOT the built-in 2-action
+    # fallback: without the enqueue action a job that misses its first
+    # cycle is written back PodGroupPending and the allocate gate then
+    # skips it forever (allocate.go:50-52 / enqueue.go:66,115)
+    conf_text: Optional[str] = None
+    # workload (poisson unless `arrivals` is given explicitly)
+    n_jobs: int = 16
+    arrival_rate: float = 2.0
+    gang_sizes: Tuple[int, ...] = (1, 2, 4)
+    duration_range: Tuple[float, float] = (3.0, 12.0)
+    start_latency: float = 0.5
+    arrivals: Optional[List[SimEvent]] = None  # pre-built / trace-driven
+    # faults
+    faults: Tuple[SimEvent, ...] = ()
+    evict_delay: float = 1.0
+    # whether an evicted replica is recreated Pending by the job controller
+    # (True models a Job/ReplicaSet owner; False mirrors the reference e2e's
+    # bare pods, where eviction is deletion — and avoids the re-claim race
+    # in which the recreated victim outranks the preemptor forever)
+    evict_recreates: bool = False
+
+
+def preset(name: str, seed: int = 0) -> SimConfig:
+    """Named scenarios. `smoke` is the tier-1-sized run; `fault` crashes
+    the busiest node under long-running gangs and must end with the
+    displaced gangs re-placed; `churn` layers binder failures and a watch
+    flap over the smoke workload (repair-path coverage)."""
+    if name == "smoke":
+        return SimConfig(seed=seed)
+    if name == "fault":
+        # 3 gangs of 4×4000m on 4×16000m nodes: ≥3 nodes carry pods, every
+        # pod runs for the whole horizon — the busiest node crashing at
+        # t=8 displaces at least one full gang member set
+        return SimConfig(
+            seed=seed,
+            n_nodes=4, node_cpu=16000.0,
+            queues=(("q0", 1),),
+            cycles=40, n_jobs=0,
+            arrivals=workload.fixed_gangs(
+                t=0.5, n_gangs=3, gang_size=4, cpu=4000.0, mem=2 * GiB,
+                duration=200.0, queues=("q0",),
+            ),
+            faults=tuple(node_crash_script(
+                t=8.0, node=BUSIEST, down_for=12.0, pod_fail_after=1.0
+            )),
+        )
+    if name == "churn":
+        cfg = SimConfig(seed=seed, cycles=80)
+        cfg.faults = (
+            *bind_fail_script(3.0, count=3),
+            *watch_flap_script(9.0),
+        )
+        return cfg
+    raise KeyError(f"unknown preset {name!r} (smoke | fault | churn)")
+
+
+class SimRunner:
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.clock = VirtualClock()
+        self.heap = EventHeap()
+        self.trace = TraceRecorder()
+        self.metrics = LongitudinalMetrics()
+        self.kubelet = kl.SimKubelet()
+        self.cache = SchedulerCache(binder=self.kubelet, evictor=self.kubelet)
+        if cfg.conf_text:
+            conf = parse_scheduler_conf(cfg.conf_text)
+        else:
+            from kube_batch_tpu.framework.conf import (
+                load_scheduler_conf, shipped_conf_path)
+
+            conf = load_scheduler_conf(shipped_conf_path())
+        # the sim drives run_once() itself, but the injected clock also
+        # makes run_forever() pace in virtual time if a caller wants it
+        self.scheduler = Scheduler(
+            self.cache, conf=conf, schedule_period=cfg.period,
+            clock=self.clock,
+        )
+        self.faults = FaultInjector(self)
+        # per-pod lifecycle info: key → {job, duration, start_latency}
+        self.pod_info: Dict[str, Dict] = {}
+        self.job_tasks: Dict[str, set] = {}      # job uid → pod keys
+        self.job_succeeded: Dict[str, set] = {}  # job uid → succeeded keys
+        self._creation = itertools.count(1)
+        self._reincarnation: Dict[str, int] = {}
+
+    # ---- shared lookups --------------------------------------------------
+    def job_of_pod(self, key: str) -> Optional[str]:
+        info = self.pod_info.get(key)
+        return info["job"] if info else None
+
+    # ---- setup -----------------------------------------------------------
+    def _setup(self) -> None:
+        cfg = self.cfg
+        for qname, weight in cfg.queues:
+            self.cache.add_queue(Queue(name=qname, uid=f"sim-q-{qname}",
+                                       weight=weight))
+            self.trace.record(SimEvent(0.0, "queue-add",
+                                       {"name": qname, "weight": weight}))
+        for i in range(cfg.n_nodes):
+            name = f"sim-n{i}"
+            self.cache.add_node(Node(
+                name=name,
+                allocatable={"cpu": cfg.node_cpu, "memory": cfg.node_mem,
+                             "pods": cfg.node_pods},
+            ))
+            self.trace.record(SimEvent(0.0, "node-add", {"name": name}))
+        arrivals = cfg.arrivals
+        if arrivals is None:
+            arrivals = workload.poisson_arrivals(
+                seed=cfg.seed, n_jobs=cfg.n_jobs, rate=cfg.arrival_rate,
+                queues=[q for q, _ in cfg.queues],
+                gang_sizes=cfg.gang_sizes,
+                duration_range=cfg.duration_range,
+                start_latency=cfg.start_latency,
+            )
+        self.heap.push_all(arrivals)
+        self.heap.push_all(SimEvent(e.time, e.kind, dict(e.data))
+                           for e in cfg.faults)
+
+    # ---- event application ----------------------------------------------
+    def _apply(self, event: SimEvent) -> None:
+        if event.kind in ev.FAULT_KINDS:
+            self.faults.apply(event)  # records its own (resolved) trace
+            return
+        handler = {
+            ev.JOB_ARRIVAL: self._on_job_arrival,
+            ev.POD_RUNNING: self._on_pod_running,
+            ev.POD_SUCCEEDED: self._on_pod_succeeded,
+            ev.POD_FAILED: self._on_pod_failed,
+            ev.EVICT_TERMINATED: self._on_evict_terminated,
+        }[event.kind]
+        handler(event)
+
+    def _on_job_arrival(self, event: SimEvent) -> None:
+        d = event.data
+        job_uid = f"{d['namespace']}/{d['name']}"
+        self.cache.add_pod_group(PodGroup(
+            name=d["name"], namespace=d["namespace"],
+            uid=f"sim-pg-{d['name']}",
+            min_member=d["min_member"], queue=d["queue"],
+            creation_index=next(self._creation),
+        ))
+        keys = set()
+        for t in d["tasks"]:
+            pod = Pod(
+                name=t["name"], namespace=d["namespace"],
+                uid=f"sim-pod-{t['name']}-r0",
+                requests={"cpu": t["cpu"], "memory": t["mem"]},
+                annotations={GROUP_NAME_ANNOTATION: d["name"]},
+                phase=PodPhase.PENDING,
+                priority=int(t.get("priority", 0)),
+                creation_index=next(self._creation),
+            )
+            key = pod.key()
+            keys.add(key)
+            self.pod_info[key] = {
+                "job": job_uid,
+                "duration": t["duration"],
+                "start_latency": t["start_latency"],
+            }
+            self.cache.add_pod(pod)
+        self.job_tasks[job_uid] = keys
+        self.job_succeeded[job_uid] = set()
+        self.metrics.note_arrival(job_uid, event.time)
+        self.trace.record(event)
+
+    def _stale(self, event: SimEvent) -> bool:
+        """Lifecycle events are pinned to a pod INCARNATION by uid: a heap
+        event queued for an incarnation that has since been crash-lost or
+        evicted and recreated must not fire against its successor (the
+        stale first-life POD_SUCCEEDED would complete the rerun early, and
+        a stale POD_RUNNING would start the recreated pod on its old,
+        possibly still-crashed node)."""
+        stored = self.cache.pods.get(event.data["key"])
+        return stored is None or stored.uid != event.data["uid"]
+
+    def _on_pod_running(self, event: SimEvent) -> None:
+        key = event.data["key"]
+        if self._stale(event):
+            return  # lost to a crash/eviction while starting
+        if not kl.set_running(self.cache, key, event.data["node"]):
+            return
+        self.trace.record(event)
+        info = self.pod_info[key]
+        self.heap.push(SimEvent(event.time + info["duration"],
+                                ev.POD_SUCCEEDED,
+                                {"key": key, "uid": event.data["uid"]}))
+
+    def _on_pod_succeeded(self, event: SimEvent) -> None:
+        key = event.data["key"]
+        if self._stale(event) or not kl.set_succeeded(self.cache, key):
+            return
+        self.trace.record(event)
+        job = self.job_of_pod(key)
+        if job is None:
+            return
+        done = self.job_succeeded.setdefault(job, set())
+        done.add(key)
+        if done >= self.job_tasks.get(job, set()):
+            self._complete_job(job, event.time)
+
+    def _complete_job(self, job_uid: str, t: float) -> None:
+        self.metrics.note_completion(job_uid, t)
+        for key in sorted(self.job_tasks.get(job_uid, ())):
+            kl.delete_pod(self.cache, key)
+        self.cache.delete_pod_group(job_uid)
+        self.trace.record(SimEvent(t, ev.JOB_COMPLETE, {"job": job_uid}))
+
+    def _reincarnate(self, key: str, t: float, kind: str, node: str = "") -> None:
+        """Crash-lost / evicted replica → the job controller recreates it
+        as a fresh Pending pod (deterministic reincarnated uid)."""
+        n = self._reincarnation.get(key, 0) + 1
+        self._reincarnation[key] = n
+        name = key.split("/", 1)[1]
+        data = {"key": key, "reincarnation": n}
+        if node:
+            data["node"] = node
+        if kl.replace_pending(self.cache, key, f"sim-pod-{name}-r{n}",
+                              next(self._creation)):
+            job = self.job_of_pod(key)
+            if job is not None:
+                self.job_succeeded.get(job, set()).discard(key)
+            self.trace.record(SimEvent(t, kind, data))
+
+    def _on_pod_failed(self, event: SimEvent) -> None:
+        self._reincarnate(event.data["key"], event.time, ev.POD_FAILED,
+                          event.data.get("node", ""))
+
+    def _on_evict_terminated(self, event: SimEvent) -> None:
+        key = event.data["key"]
+        if self._stale(event):
+            return  # the evicted incarnation is already gone
+        if self.cfg.evict_recreates:
+            self._reincarnate(key, event.time, ev.EVICT_TERMINATED)
+            return
+        if not kl.delete_pod(self.cache, key):
+            return
+        self.trace.record(SimEvent(event.time, ev.EVICT_TERMINATED,
+                                   {"key": key, "deleted": True}))
+        job = self.job_of_pod(key)
+        if job is None:
+            return
+        tasks = self.job_tasks.get(job)
+        if tasks is None:
+            return
+        tasks.discard(key)
+        done = self.job_succeeded.get(job, set())
+        done.discard(key)
+        if tasks and done >= tasks:
+            self._complete_job(job, event.time)
+
+    # ---- per-cycle observation ------------------------------------------
+    def _drain_kubelet(self, now: float) -> None:
+        binds, evicts = self.kubelet.drain()
+        for key, node in binds:
+            self.trace.record(SimEvent(now, ev.BIND,
+                                       {"key": key, "node": node}))
+            info = self.pod_info.get(key)
+            if info is None:
+                continue
+            self.metrics.note_bind(info["job"], now)
+            stored = self.cache.pods.get(key)
+            if stored is not None:
+                # uid pins the follow-up to THIS incarnation (see _stale)
+                self.heap.push(SimEvent(
+                    now + info["start_latency"], ev.POD_RUNNING,
+                    {"key": key, "node": node, "uid": stored.uid},
+                ))
+        for key in evicts:
+            self.trace.record(SimEvent(now, ev.EVICT, {"key": key}))
+            self.metrics.note_eviction()
+            stored = self.cache.pods.get(key)
+            if stored is not None:
+                self.heap.push(SimEvent(
+                    now + self.cfg.evict_delay, ev.EVICT_TERMINATED,
+                    {"key": key, "uid": stored.uid},
+                ))
+
+    def _queue_shares(self) -> Dict[str, Dict]:
+        total = np.zeros(self.cache.spec.n)
+        for node in self.cache.nodes.values():
+            total += node.allocatable.vec
+        alloc: Dict[str, np.ndarray] = {
+            q: np.zeros(self.cache.spec.n) for q, _ in self.cfg.queues
+        }
+        for job in self.cache.jobs.values():
+            if job.queue in alloc:
+                alloc[job.queue] += job.allocated.vec
+        weights = dict(self.cfg.queues)
+        wsum = sum(weights.values()) or 1
+        nz = total > 0
+        out = {}
+        for q, _ in self.cfg.queues:
+            share = float(np.max(alloc[q][nz] / total[nz])) if nz.any() else 0.0
+            out[q] = {
+                "share": round(share, 6),
+                "entitlement": round(weights[q] / wsum, 6),
+            }
+        return out
+
+    def _task_counts(self) -> Tuple[int, int]:
+        pending = running = 0
+        for job in self.cache.jobs.values():
+            pending += len(job.task_status_index.get(TaskStatus.PENDING, {}))
+            running += len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+        return pending, running
+
+    # ---- the loop --------------------------------------------------------
+    def run(self) -> Dict:
+        self._setup()
+        cfg = self.cfg
+        cycles_run = 0
+        for _ in range(cfg.cycles):
+            now = self.clock.now()
+            for event in self.heap.pop_due(now):
+                self._apply(event)
+            self.scheduler.run_once()  # flushes async binds at its end
+            self._drain_kubelet(now)
+            pending, running = self._task_counts()
+            self.metrics.note_cycle(now, self._queue_shares(),
+                                    pending, running)
+            cycles_run += 1
+            submitted = len(self.metrics.arrivals)
+            if (not self.heap and pending == 0
+                    and submitted
+                    and len(self.metrics.completions) == submitted):
+                break  # workload fully drained — nothing left to simulate
+            self.clock.sleep(cfg.period)
+        return self._finalize(cycles_run)
+
+    # ---- end-of-run checks ----------------------------------------------
+    def _invariant_errors(self) -> List[str]:
+        errs = list(self.cache.columns.check_consistency(self.cache))
+        for name, node in self.cache.nodes.items():
+            if not np.allclose(node.idle.vec + node.used.vec,
+                               node.allocatable.vec):
+                errs.append(f"node {name} accounting drift: "
+                            f"idle+used != allocatable")
+            resident = np.zeros(self.cache.spec.n)
+            for task in node.tasks.values():
+                # RELEASING occupies `used` too (eviction in flight keeps
+                # the capacity charged until the pod terminates,
+                # node_info.py add_task); PIPELINED would not, but it is
+                # session-only state reverted at close — never resident here
+                if is_allocated(task.status) or (
+                        task.status == TaskStatus.RELEASING):
+                    resident += task.resreq.vec
+            if not np.allclose(resident, node.used.vec):
+                errs.append(f"node {name} used != Σ resident resreq")
+        return errs
+
+    def _fault_recovery(self) -> Optional[Dict]:
+        displaced = sorted(self.faults.displaced_jobs)
+        if not displaced and not self.faults.crashed_nodes:
+            return None
+        detail = {}
+        all_ok = True
+        for uid in displaced:
+            job = self.cache.jobs.get(uid)
+            if uid in self.metrics.completions:
+                detail[uid] = "completed"
+            elif job is not None and job.ready():
+                detail[uid] = "re-placed"
+            else:
+                detail[uid] = "NOT re-placed"
+                all_ok = False
+        return {
+            "displaced_jobs": detail,
+            "recovered": all_ok,
+            "nodes_still_down": sorted(self.faults.crashed_nodes),
+        }
+
+    def _finalize(self, cycles_run: int) -> Dict:
+        report = self.metrics.report()
+        cfg = self.cfg
+        report.update({
+            "unit": "virtual_seconds",
+            "seed": cfg.seed,
+            "cycles_run": cycles_run,
+            "config": {
+                "n_nodes": cfg.n_nodes,
+                "queues": list(map(list, cfg.queues)),
+                "cycles": cfg.cycles,
+                "period": cfg.period,
+                "n_jobs_poisson": cfg.n_jobs if cfg.arrivals is None else 0,
+                "faults": [e.kind for e in cfg.faults],
+            },
+            "invariants": {"errors": self._invariant_errors()},
+            "bind_failures_injected": self.kubelet.bind_failures,
+            "trace_events": len(self.trace),
+            "trace_sha256": self.trace.sha256(),
+        })
+        recovery = self._fault_recovery()
+        if recovery is not None:
+            report["fault_recovery"] = recovery
+        return report
+
+
+def run_preset(name: str, seed: int = 0, cycles: Optional[int] = None,
+               trace_path: Optional[str] = None) -> Dict:
+    """One-call entrypoint used by the CLI and the tests."""
+    cfg = preset(name, seed=seed)
+    if cycles is not None:
+        cfg.cycles = cycles
+    runner = SimRunner(cfg)
+    report = runner.run()
+    report["metric"] = f"sim_{name}_makespan_vt"
+    report["value"] = report.get("makespan_vt")
+    report["preset"] = name
+    if trace_path:
+        runner.trace.write(trace_path)
+        report["trace_path"] = trace_path
+    return report

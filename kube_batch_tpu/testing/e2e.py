@@ -723,7 +723,9 @@ def scheduler_process(master: str, extra_args=(), **auth):
 
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    conf = os.path.join(repo, "config", "kube-batch-tpu-conf.yaml")
+    from kube_batch_tpu.framework.conf import shipped_conf_path
+
+    conf = shipped_conf_path()
     env = hardened_cpu_env()
     env["PYTHONPATH"] = os.pathsep.join(
         [repo] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]

@@ -1129,6 +1129,10 @@ class TestRealRequestBackfill:
         from kube_batch_tpu.framework.interface import get_action
 
         assert get_action("allocate").last_host_discards == 1
+        # the control signal backfill consumed rides the SESSION, not the
+        # process-global action registry (ADVICE.md #5) — ≥1 because the
+        # backfill helper replay's own discards accumulate on it too
+        assert ssn.host_discards >= 1
         # G discarded entirely; S backfilled into the freed capacity
         assert set(cache.binder.binds) == {"c1/s-0"}
         assert not cache.evictor.evicts

@@ -61,3 +61,29 @@ def test_two_process_global_mesh_solve_matches_single():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert "MATCH placed=" in out, f"rank {rank} output:\n{out[-4000:]}"
+
+
+def test_initialize_reinit_guard_without_is_initialized(monkeypatch):
+    """ADVICE.md #4 regression: on a jax version lacking
+    jax.distributed.is_initialized, a second initialize() call must no-op
+    via the module-level flag instead of raising from
+    jax.distributed.initialize."""
+    import jax
+
+    from kube_batch_tpu.parallel import distributed
+
+    calls = []
+
+    class _Stub:
+        # no is_initialized attribute at all — the old-jax shape
+        @staticmethod
+        def initialize(**kw):
+            calls.append(kw)
+            if len(calls) > 1:
+                raise RuntimeError("coordinator already configured")
+
+    monkeypatch.setattr(jax, "distributed", _Stub())
+    monkeypatch.setattr(distributed, "_initialized", False)
+    distributed.initialize(coordinator="h:1", num_processes=1, process_id=0)
+    distributed.initialize(coordinator="h:1", num_processes=1, process_id=0)
+    assert len(calls) == 1  # second call guarded by the fallback flag

@@ -4,6 +4,7 @@ tested path from real API objects to the cache)."""
 
 import json
 import os
+import time
 
 import pytest
 
@@ -621,3 +622,159 @@ class TestEventFuzz:
             cache.flush_binds()
             errs = cache.columns.check_consistency(cache)
             assert not errs, (seed, "after relist", errs[:5])
+
+
+class TestPvNodeAffinityFailClosed:
+    """ADVICE.md #1 regression: a PV whose REQUIRED nodeAffinity terms are
+    unrecognized must translate as restrictive (reachable from no node),
+    never as node=None (reachable from every node); metadata.name In
+    expressions are a recognized single-node pin."""
+
+    @staticmethod
+    def _pv(node_affinity):
+        spec = {"storageClassName": "local-ssd"}
+        if node_affinity is not None:
+            spec["nodeAffinity"] = node_affinity
+        return {"apiVersion": "v1", "kind": "PersistentVolume",
+                "metadata": {"name": "pv-x"}, "spec": spec}
+
+    def test_no_required_affinity_is_reachable_everywhere(self):
+        from kube_batch_tpu.k8s.translate import pv_from_k8s
+
+        assert pv_from_k8s(self._pv(None)).node is None
+
+    def test_hostname_in_term_pins_the_node(self):
+        from kube_batch_tpu.k8s.translate import pv_from_k8s
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "kubernetes.io/hostname", "operator": "In",
+             "values": ["node-b"]}]}]}}
+        assert pv_from_k8s(self._pv(aff)).node == "node-b"
+
+    def test_metadata_name_expression_pins_the_node(self):
+        from kube_batch_tpu.k8s.translate import pv_from_k8s
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "metadata.name", "operator": "In",
+             "values": ["node-c"]}]}]}}
+        assert pv_from_k8s(self._pv(aff)).node == "node-c"
+
+    def test_metadata_name_match_fields_pin_the_node(self):
+        from kube_batch_tpu.k8s.translate import pv_from_k8s
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchFields": [
+            {"key": "metadata.name", "operator": "In",
+             "values": ["node-d"]}]}]}}
+        assert pv_from_k8s(self._pv(aff)).node == "node-d"
+
+    def test_unrecognized_required_terms_fail_closed(self):
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.k8s.translate import (
+            PV_NODE_RESTRICTED_UNKNOWN, pv_from_k8s, pvc_from_k8s)
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "topology.kubernetes.io/zone", "operator": "In",
+             "values": ["us-central1-a"]}]}]}}
+        pv = pv_from_k8s(self._pv(aff))
+        assert pv.node == PV_NODE_RESTRICTED_UNKNOWN
+        # and the ledger treats it as unreachable from every node, so the
+        # placement fails instead of landing where the volume can't attach
+        led = K8sPVLedger()
+        led.add_storage_class("local-ssd", "kubernetes.io/no-provisioner")
+        led.add_pv(pv)
+        led.add_pvc(pvc_from_k8s({
+            "metadata": {"name": "zonal-data", "namespace": "ml"},
+            "spec": {"storageClassName": "local-ssd"},
+            "status": {"phase": "Pending"},
+        }))
+
+        class T:
+            uid = "ml/consumer"
+            pod = type("P", (), {"namespace": "ml",
+                                 "volume_claims": ("zonal-data",)})()
+
+        assert not led.volume_feasible(T(), "node-a")
+        assert not led.volume_feasible(T(), "us-central1-a")
+
+
+class TestPvLedgerRetryQueue:
+    """ADVICE.md #2 regression: retry-queue overflow must release the
+    dropped claimRef's ledger binding (so it re-derives), and queued
+    retries must drain on a timer even when the scheduler goes idle."""
+
+    class _Transport:
+        def __init__(self, fail_next=0):
+            self.requests = []
+            self.fail_next = fail_next
+
+        def request(self, method, path, body=None, **kw):
+            if self.fail_next:
+                self.fail_next -= 1
+                raise OSError("apiserver away")
+            self.requests.append((method, path, body))
+
+    @staticmethod
+    def _task(name, claims):
+        class T:
+            uid = f"ml/{name}"
+            pod = type("P", (), {"namespace": "ml",
+                                 "volume_claims": tuple(claims)})()
+
+        return T()
+
+    def _led(self, transport):
+        from kube_batch_tpu.api.pod import (
+            PersistentVolume, PersistentVolumeClaim)
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+
+        led = K8sPVLedger(transport=transport)
+        led.add_storage_class("local-ssd", "kubernetes.io/no-provisioner")
+        for pv in ("pv-1", "pv-2"):
+            led.add_pv(PersistentVolume(name=pv, node="node-a",
+                                        storage_class="local-ssd"))
+        for claim in ("c1", "c2"):
+            led.add_pvc(PersistentVolumeClaim(name=claim, namespace="ml",
+                                              storage_class="local-ssd"))
+        return led
+
+    def test_overflow_releases_dropped_bindings(self):
+        tr = self._Transport(fail_next=100)  # apiserver down throughout
+        led = self._led(tr)
+        led.MAX_PENDING_WRITES = 1
+        led.RETRY_FLUSH_INTERVAL = 3600.0  # keep the timer out of this test
+        t1 = self._task("a", ["c1"])
+        led.allocate_volumes(t1, "node-a")
+        led.bind_volumes(t1)
+        led.drain_writes()
+        assert led._pending_writes and "ml/c1" in led.bound
+        dropped_pv = led.bound["ml/c1"]
+        t2 = self._task("b", ["c2"])
+        led.allocate_volumes(t2, "node-a")
+        led.bind_volumes(t2)  # retry of c1 fails again, c2 fails → overflow
+        led.drain_writes()
+        assert len(led._pending_writes) == 1
+        # the dropped claimRef's binding is released for re-derivation —
+        # before the fix it stayed in `bound` with no queued write forever
+        assert "ml/c1" not in led.bound
+        assert "ml/c2" in led.bound
+        # and the freed PV is claimable again
+        t3 = self._task("c", ["c1"])
+        led.allocate_volumes(t3, "node-a")
+        assert led.reservations[t3.uid]["ml/c1"] == dropped_pv
+
+    def test_idle_timer_flushes_queued_retries(self):
+        tr = self._Transport(fail_next=1)
+        led = self._led(tr)
+        led.RETRY_FLUSH_INTERVAL = 0.05
+        t1 = self._task("a", ["c1"])
+        led.allocate_volumes(t1, "node-a")
+        led.bind_volumes(t1)  # first PATCH fails → queued, timer armed
+        led.drain_writes()
+        assert led._pending_writes
+        # NO further bind_volumes call: the timer alone must drain it
+        deadline = time.time() + 5.0
+        while led._pending_writes and time.time() < deadline:
+            time.sleep(0.02)
+        led.drain_writes()
+        assert not led._pending_writes
+        assert any("persistentvolumes/" in r[1] for r in tr.requests)

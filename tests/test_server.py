@@ -650,3 +650,43 @@ class TestRestartWithBindings:
         # the restarted process schedules nothing new
         Scheduler(fresh, conf=load_scheduler_conf(None)).run_once()
         assert fresh.binder.binds == {}
+
+
+class TestTokenBucketConcurrency:
+    def test_take_sleeps_outside_the_lock(self):
+        """ADVICE.md #3 regression: a waiter must reserve under the lock and
+        sleep OUTSIDE it — a sleeper holding self._lock serializes the
+        16-worker status pool and head-of-line blocks the bind loop."""
+        from kube_batch_tpu.cmd.server import TokenBucket
+
+        bucket = TokenBucket(qps=4.0, burst=1)
+        bucket.take()  # consume the burst token; next take waits ~0.25s
+        waiter = threading.Thread(target=bucket.take)
+        waiter.start()
+        try:
+            time.sleep(0.05)  # let the waiter reserve and start sleeping
+            acquired = bucket._lock.acquire(timeout=0.05)
+            if acquired:
+                bucket._lock.release()
+            assert acquired, "take() held the lock through its sleep"
+        finally:
+            waiter.join()
+
+    def test_parallel_waiters_keep_aggregate_rate(self):
+        """Reservations are debt positions: N concurrent waiters sleep in
+        parallel yet tokens still mint at qps overall."""
+        from kube_batch_tpu.cmd.server import TokenBucket
+
+        bucket = TokenBucket(qps=100.0, burst=1)
+        threads = [threading.Thread(target=bucket.take) for _ in range(9)]
+        t0 = time.perf_counter()
+        bucket.take()  # burst token
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        # 10 takes, burst 1 → 9 minted tokens at 100/s ≈ ≥0.09s aggregate,
+        # and nowhere near 9 serialized full waits either
+        assert elapsed >= 0.07
+        assert elapsed < 1.0

@@ -1,0 +1,174 @@
+"""Virtual-time simulator suite (kube_batch_tpu/sim) — the tier-1 smoke
+config: small cluster, tens of virtual cycles, real Scheduler/cache
+underneath. Pins the determinism contract (same seed ⇒ byte-identical
+trace), fault convergence (node crash mid-gang → gang re-placed, no
+accounting drift), the injected-binder-failure resync path, and trace
+replayability."""
+
+import json
+
+from kube_batch_tpu.sim import SimConfig, SimRunner, preset, run_preset
+from kube_batch_tpu.sim.workload import trace_arrivals
+
+
+class TestSimSmoke:
+    def test_smoke_deterministic_and_complete(self, tmp_path):
+        """`--seed 7 --preset smoke` twice: byte-identical traces, full
+        workload drain, longitudinal percentiles, clean invariants."""
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        r1 = run_preset("smoke", seed=7, trace_path=a)
+        r2 = run_preset("smoke", seed=7, trace_path=b)
+        assert r1["trace_sha256"] == r2["trace_sha256"]
+        assert (tmp_path / "a.jsonl").read_bytes() == (
+            tmp_path / "b.jsonl").read_bytes()
+        # a different seed is a different run
+        assert run_preset("smoke", seed=8, cycles=12)[
+            "trace_sha256"] != r1["trace_sha256"]
+        # full drain + the longitudinal report
+        assert r1["jobs"]["submitted"] > 0
+        assert r1["jobs"]["completed"] == r1["jobs"]["submitted"]
+        assert r1["jct_vt"]["p50"] > 0 and r1["jct_vt"]["p99"] >= r1["jct_vt"]["p50"]
+        assert r1["wait_vt"]["n"] == r1["jobs"]["submitted"]
+        assert r1["makespan_vt"] and r1["binds"] > 0
+        assert r1["invariants"]["errors"] == []
+        # per-queue fairness series: every cycle carries share + entitlement
+        series = r1["fairness_series"]
+        assert len(series) == r1["cycles_run"]
+        for q, rec in series[-1]["queues"].items():
+            assert 0.0 <= rec["share"] <= 1.0
+            assert 0.0 < rec["entitlement"] < 1.0
+
+    def test_trace_replay_reproduces_run(self, tmp_path):
+        """A recorded trace's JOB_ARRIVAL events re-drive an identical run
+        (trace-driven workload — the recordable/replayable contract)."""
+        path = str(tmp_path / "rec.jsonl")
+        original = run_preset("smoke", seed=7, trace_path=path)
+        cfg = preset("smoke", seed=7)
+        cfg.arrivals = trace_arrivals(path)
+        replay = SimRunner(cfg).run()
+        assert replay["trace_sha256"] == original["trace_sha256"]
+
+    def test_cli_emits_json_report(self, capsys):
+        from kube_batch_tpu.sim.__main__ import main
+
+        rc = main(["--preset", "smoke", "--seed", "7", "--cycles", "25",
+                   "--no-fairness-series"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["metric"] == "sim_smoke_makespan_vt"
+        assert rep["unit"] == "virtual_seconds"
+        assert "fairness_series" not in rep
+
+
+class TestSimFaults:
+    def test_node_crash_mid_gang_recovers(self):
+        """The fault preset: the busiest node crashes under running gangs;
+        the displaced gangs must be re-placed (or completed) by the end,
+        the node returns, and cache accounting shows no drift."""
+        r = run_preset("fault", seed=3)
+        rec = r["fault_recovery"]
+        assert rec["displaced_jobs"], "crash displaced nothing — vacuous run"
+        assert rec["recovered"], rec
+        assert all(v in ("re-placed", "completed")
+                   for v in rec["displaced_jobs"].values()), rec
+        assert rec["nodes_still_down"] == []
+        assert r["invariants"]["errors"] == []
+
+    def test_reincarnated_pod_ignores_stale_lifecycle_events(self):
+        """A crash-lost replica's queued POD_SUCCEEDED must not complete
+        its reincarnation early: lifecycle events are uid-pinned to one
+        incarnation, so the rerun serves its FULL duration after
+        re-placement."""
+        from kube_batch_tpu.sim.faults import node_crash_script
+        from kube_batch_tpu.sim.workload import fixed_gangs
+
+        cfg = SimConfig(
+            seed=0, n_nodes=2, node_cpu=8000.0, queues=(("q0", 1),),
+            cycles=40, n_jobs=0,
+            arrivals=fixed_gangs(t=0.5, n_gangs=1, gang_size=2, cpu=4000.0,
+                                 mem=2**30, duration=10.0, queues=("q0",)),
+            faults=tuple(node_crash_script(t=2.0, down_for=2.0,
+                                           pod_fail_after=1.0)),
+        )
+        r = SimRunner(cfg).run()
+        assert r["fault_recovery"]["recovered"], r["fault_recovery"]
+        assert r["jobs"]["completed"] == 1
+        # displaced replica restarts ~t≥5 and runs its full 10 vt: the job
+        # completes after ~15, not at the first incarnation's ~11.5 mark
+        assert r["jct_vt"]["p50"] > 12.0, r["jct_vt"]
+        assert r["invariants"]["errors"] == []
+
+    def test_injected_bind_failures_converge_via_resync(self):
+        """The churn preset injects binder failures + a watch flap: failed
+        binds take the cache's resync repair path and the workload still
+        fully completes with clean invariants."""
+        r = run_preset("churn", seed=1)
+        assert r["bind_failures_injected"] > 0
+        assert r["jobs"]["completed"] == r["jobs"]["submitted"]
+        assert r["invariants"]["errors"] == []
+
+    def test_preemption_frees_capacity_for_high_priority(self):
+        """Preemption in virtual time (default evict_recreates=False, the
+        reference e2e's bare-pod semantics): a high-priority singleton
+        arriving into a full cluster evicts low-priority gang slack after
+        the eviction-termination delay and completes on the freed
+        capacity; churn is counted."""
+        from kube_batch_tpu.sim.workload import fixed_gangs
+
+        arrivals = fixed_gangs(t=0.5, n_gangs=1, gang_size=4, cpu=4000.0,
+                               mem=2**30, duration=300.0, queues=("q0",),
+                               name_prefix="low")
+        # gang slack 2 (minMember 2, 4 replicas): victims the gang plugin
+        # permits, like e2e's scenario_preemption
+        arrivals[0].data["min_member"] = 2
+        # high-priority singleton needs capacity only an eviction can free
+        high = fixed_gangs(t=5.0, n_gangs=1, gang_size=1, cpu=4000.0,
+                           mem=2**30, duration=5.0, queues=("q0",),
+                           name_prefix="high")
+        for e in high:
+            for t in e.data["tasks"]:
+                t["priority"] = 1000
+        # default conf = the shipped 5-action pipeline (includes preempt)
+        cfg = SimConfig(
+            seed=0, n_nodes=1, node_cpu=16000.0, queues=(("q0", 1),),
+            cycles=30, n_jobs=0, arrivals=arrivals + high,
+        )
+        r = SimRunner(cfg).run()
+        assert r["evictions"] >= 1
+        assert r["invariants"]["errors"] == []
+        # the high-priority job ran to completion on the freed capacity
+        assert r["jobs"]["completed"] >= 1
+
+    def test_evict_recreates_controller_restores_pending_replica(self):
+        """evict_recreates=True models a Job/ReplicaSet owner: the evicted
+        replica reincarnates Pending (fresh uid) instead of vanishing, and
+        stays a member of its job."""
+        from kube_batch_tpu.sim.workload import fixed_gangs
+
+        arrivals = fixed_gangs(t=0.5, n_gangs=1, gang_size=4, cpu=4000.0,
+                               mem=2**30, duration=300.0, queues=("q0",),
+                               name_prefix="low")
+        arrivals[0].data["min_member"] = 2
+        high = fixed_gangs(t=5.0, n_gangs=1, gang_size=1, cpu=4000.0,
+                           mem=2**30, duration=300.0, queues=("q0",),
+                           name_prefix="high")
+        for e in high:
+            for t in e.data["tasks"]:
+                t["priority"] = 1000
+        cfg = SimConfig(
+            seed=0, n_nodes=1, node_cpu=16000.0, queues=(("q0", 1),),
+            cycles=12, n_jobs=0, arrivals=arrivals + high,
+            evict_recreates=True,
+        )
+        runner = SimRunner(cfg)
+        r = runner.run()
+        assert r["evictions"] >= 1
+        assert r["invariants"]["errors"] == []
+        # every low replica is still a member of its job, and at least one
+        # carries a reincarnated uid (-r1+) from the recreation branch
+        low_keys = runner.job_tasks["sim/low000"]
+        assert len(low_keys) == 4
+        reincarnated = [k for k in low_keys
+                        if k in runner.cache.pods
+                        and not runner.cache.pods[k].uid.endswith("-r0")]
+        assert reincarnated, "no evicted replica was recreated"
