@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
-import time
+from kube_batch_tpu.utils import telemetry
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -52,10 +52,10 @@ class _PhaseMarks:
 
     def __init__(self, sink: Dict[str, float]):
         self.sink = sink
-        self.t = time.perf_counter()
+        self.t = telemetry.perf_counter()
 
     def mark(self, key: str) -> None:
-        now = time.perf_counter()
+        now = telemetry.perf_counter()
         self.sink[key] = self.sink.get(key, 0.0) + (now - self.t) * 1e3
         self.t = now
 
@@ -156,7 +156,7 @@ class AllocateAction(Action):
         if not ssn.jobs or not ssn.nodes:
             return
 
-        t0 = time.perf_counter()
+        t0 = telemetry.perf_counter()
         cols = ssn.columns
         if cols is not None and not cols.has_schedulable_pending():
             # steady-state idle cycle: nothing schedulable anywhere — skip
@@ -167,7 +167,7 @@ class AllocateAction(Action):
                                   "fit_errors": 0.0, "replay": 0.0}
             return
         snap, meta = build_session_snapshot(ssn)
-        t1 = time.perf_counter()
+        t1 = telemetry.perf_counter()
         # multi-chip parts shard the node axis over the ICI mesh — the
         # production analog of the reference's always-on 16-worker fan-out
         # (scheduler_helper.go:34-64); single-chip or small-N stays local
@@ -183,7 +183,7 @@ class AllocateAction(Action):
         self.last_solve_rounds = int(rounds_run)
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
-        t2 = time.perf_counter()
+        t2 = telemetry.perf_counter()
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
         # fit errors only for tasks of jobs that are IN this session (the
         # columnar row space also carries rows of jobs the session dropped —
@@ -201,7 +201,7 @@ class AllocateAction(Action):
         # (allocate.go:151-155 builds FitErrors only for failing tasks);
         # timed under its own key so failure cycles don't read as a
         # replay-phase regression in the bench breakdown
-        t_fit0 = time.perf_counter()
+        t_fit0 = telemetry.perf_counter()
         if bool(np.any(pending & (assigned < 0))):
             if self.last_solve_mode == "sharded":
                 from kube_batch_tpu.parallel.mesh import (
@@ -221,9 +221,9 @@ class AllocateAction(Action):
             self._record_fit_errors(
                 ssn, meta, fail_hist, assigned, task_job, pending
             )
-        t_fit1 = time.perf_counter()
+        t_fit1 = telemetry.perf_counter()
         self._replay(ssn, snap, meta, assigned, pipelined, task_job)
-        t3 = time.perf_counter()
+        t3 = telemetry.perf_counter()
         # update, not replace: _replay already folded its replay_* sub-phases in
         self.last_phase_ms.update(
             snapshot_build=(t1 - t0) * 1e3,

@@ -1,0 +1,30 @@
+"""kbt-check — project-specific static analysis + runtime lock-order checks.
+
+The reference kube-batch is Go: `go vet` and `go test -race` catch whole bug
+classes for free. This Python/JAX port has no such net, and every advisor
+finding to date (sleep-under-lock in TokenBucket, the process-global
+allocate→backfill discard signal, the fail-open PV nodeAffinity translation,
+PR 1's writer-executor race) was an instance of a mechanically detectable
+pattern. This package builds the checks once so the class stops recurring:
+
+- `engine` / `rules`: an AST lint engine (stdlib `ast`, no new deps) with
+  rules KBT001–KBT005, each grounded in a real past bug. Run it with
+  `python -m kube_batch_tpu.analysis` (add `--jsonl` for CI).
+- `lockdep`: a runtime lock-order validator in the spirit of the Linux
+  kernel's lockdep — instrumented Lock/RLock factories record per-thread
+  held-lock sets, build the acquisition-order graph, and flag A→B/B→A
+  inversions and blocking calls made while a lock is held.
+- `pytest_plugin`: enables lockdep for the whole test suite and fails the
+  run on violations (wired into tests/conftest.py, so tier-1 enforces it).
+
+Suppressions: `# kbt: allow[KBT00X] reason` on the flagged line (or the
+line directly above). The reason is mandatory — an allow without one does
+not suppress. See ANALYSIS.md for the rule catalog.
+"""
+
+from kube_batch_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    check_source,
+    run_paths,
+)
+from kube_batch_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,71 @@
+"""`python -m kube_batch_tpu.analysis` — run the kbt-check lint rules.
+
+Exit status: 0 clean, 1 findings, 2 usage error. `--jsonl` emits one JSON
+object per finding on stdout for CI consumption; the human format is
+`path:line:col: RULE message` (clickable in most editors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kube_batch_tpu.analysis.engine import run_paths
+from kube_batch_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kube_batch_tpu.analysis",
+        description="kbt-check: project-specific static analysis "
+                    "(rule catalog: ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the kube_batch_tpu "
+             "package tree)",
+    )
+    parser.add_argument(
+        "--jsonl", action="store_true",
+        help="machine-readable output: one JSON object per finding",
+    )
+    parser.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            scope = ", ".join(rule.scope) if rule.scope else "package-wide"
+            print(f"{rule.id}  {rule.title}  [{scope}]")
+        return 0
+
+    rules = None
+    if args.select:
+        ids = [r.strip() for r in args.select.split(",") if r.strip()]
+        unknown = [r for r in ids if r not in RULES_BY_ID]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[r] for r in ids]
+
+    findings = run_paths(args.paths, rules=rules)
+    for f in findings:
+        if args.jsonl:
+            print(json.dumps(f.to_dict(), sort_keys=True))
+        else:
+            print(f.render())
+    if not args.jsonl:
+        n = len(findings)
+        print(f"kbt-check: {n} finding{'s' if n != 1 else ''}"
+              if n else "kbt-check: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""pytest plugin: run the whole suite under the lockdep runtime validator.
+
+Wired via ``pytest_plugins`` in tests/conftest.py, so the ordinary tier-1
+run doubles as a lock-order regression test (the `go test -race` analog).
+Violations accumulated over the session print a report and fail the run.
+
+Opt out with ``KBT_LOCKDEP=0`` (e.g. when bisecting an unrelated failure).
+Tests that deliberately provoke violations (tests/test_lockdep.py) run
+against their own private ``LockdepState`` and never touch the
+session-global one.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kube_batch_tpu.analysis import lockdep
+
+
+def _enabled() -> bool:
+    return os.environ.get("KBT_LOCKDEP", "1").lower() not in ("0", "false", "no")
+
+
+def pytest_configure(config):
+    if _enabled():
+        config._kbt_lockdep_state = lockdep.install()
+
+
+def pytest_unconfigure(config):
+    if getattr(config, "_kbt_lockdep_state", None) is not None:
+        lockdep.uninstall()
+        config._kbt_lockdep_state = None
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    state = getattr(config, "_kbt_lockdep_state", None)
+    if state is None:
+        return
+    if state.violations:
+        terminalreporter.section("kbt lockdep violations")
+        terminalreporter.write_line(state.report())
+    else:
+        terminalreporter.write_line(
+            f"kbt lockdep: clean ({len(state.edges)} lock-order edges observed)"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    state = getattr(session.config, "_kbt_lockdep_state", None)
+    if state is not None and state.violations and session.exitstatus == 0:
+        session.exitstatus = 1

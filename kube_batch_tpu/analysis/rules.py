@@ -1,0 +1,429 @@
+"""The KBT rule set. Every rule is grounded in a bug this codebase actually
+shipped (rounds 1–5); the historical incident is named in each docstring and
+cataloged in ANALYSIS.md.
+
+Rules report (line, col, message) triples; scoping and suppression live in
+the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from kube_batch_tpu.analysis.engine import Rule
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+
+def _leftmost_name(node: ast.AST) -> str:
+    """Base identifier of an attribute chain (``a.b.c()`` → ``a``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """Rightmost identifier (``self._lock`` → ``_lock``; ``lock`` → ``lock``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+class _ImportMap(ast.NodeVisitor):
+    """Names bound to the time/datetime/numpy/urllib modules anywhere in the
+    module (top-level or function-local imports both count)."""
+
+    def __init__(self) -> None:
+        self.time_names: Set[str] = set()
+        self.datetime_names: Set[str] = set()  # module or datetime class
+        self.numpy_names: Set[str] = set()
+        # from-imports of individual wall-clock / blocking callables:
+        # local name → original attribute name
+        self.from_time: Dict[str, str] = {}
+        self.from_urllib: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            if alias.name == "time":
+                self.time_names.add(bound)
+            elif alias.name == "datetime":
+                self.datetime_names.add(bound)
+            elif alias.name == "numpy":
+                self.numpy_names.add(bound)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                self.from_time[alias.asname or alias.name] = alias.name
+        elif node.module == "datetime":
+            for alias in node.names:
+                if alias.name == "datetime":
+                    self.datetime_names.add(alias.asname or alias.name)
+        elif node.module == "numpy":
+            for alias in node.names:
+                if alias.name in ("asarray", "array"):
+                    self.numpy_names.add(alias.asname or alias.name)
+        elif node.module in ("urllib.request", "urllib"):
+            for alias in node.names:
+                if alias.name in ("urlopen", "request"):
+                    self.from_urllib.add(alias.asname or alias.name)
+
+
+def _walk_skipping_defs(body: Iterable[ast.AST]) -> Iterable[ast.AST]:
+    """Yield statements/expressions lexically in ``body`` without descending
+    into nested function/class bodies (their code runs later, elsewhere)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------------
+# KBT001 — wall clock outside the Clock seam
+# --------------------------------------------------------------------------
+
+
+class WallClockRule(Rule):
+    """Historical bug: the simulator (PR 1) needed a clock seam because the
+    Scheduler loop read `time` directly; any direct wall-clock call in the
+    scheduler/actions/cache/sim/framework paths silently breaks virtual-time
+    replay determinism again. Telemetry that deliberately measures real
+    compute (perf_counter spans feeding metrics) stays — annotated."""
+
+    id = "KBT001"
+    title = "wall-clock call outside the Clock seam"
+    scope = ("scheduler.py", "actions/", "cache/", "sim/", "framework/")
+
+    TIME_ATTRS = {
+        "time", "monotonic", "sleep", "perf_counter", "process_time",
+        "time_ns", "monotonic_ns", "perf_counter_ns",
+    }
+    DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+    def check(self, tree: ast.Module, relpath: str):
+        imports = _ImportMap()
+        imports.visit(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                base = _leftmost_name(func)
+                if base in imports.time_names and func.attr in self.TIME_ATTRS:
+                    yield (node.lineno, node.col_offset,
+                           f"wall-clock call `{base}.{func.attr}()` in a "
+                           "clock-seamed path; read the injected clock "
+                           "(Scheduler.clock / sim VirtualClock) instead")
+                elif (base in imports.datetime_names
+                        and func.attr in self.DATETIME_ATTRS):
+                    yield (node.lineno, node.col_offset,
+                           f"wall-clock call `{base}.{func.attr}()` in a "
+                           "clock-seamed path; carry timestamps through the "
+                           "injected clock")
+            elif isinstance(func, ast.Name):
+                orig = imports.from_time.get(func.id)
+                if orig in self.TIME_ATTRS:
+                    yield (node.lineno, node.col_offset,
+                           f"wall-clock call `{func.id}()` (time.{orig}) in a "
+                           "clock-seamed path; read the injected clock instead")
+
+
+# --------------------------------------------------------------------------
+# KBT002 — blocking call inside a lock body
+# --------------------------------------------------------------------------
+
+
+class BlockingUnderLockRule(Rule):
+    """Historical bug: TokenBucket.take() slept while holding its lock, so
+    concurrent waiters (the 16-worker status pool, the binder, the pv-writes
+    thread) serialized behind whoever slept first (round-5 ADVICE #3). Any
+    call that can block for I/O or scheduling latency inside a
+    `with <lock>:` body stalls every other thread contending for that lock."""
+
+    id = "KBT002"
+    title = "blocking call while holding a lock"
+    scope = ()  # package-wide
+
+    # attribute calls that block regardless of receiver
+    BLOCKING_ATTRS = {
+        "sleep", "result", "wait", "urlopen", "getresponse", "recv",
+        "recvfrom", "accept", "connect", "sendall", "select", "serve_forever",
+    }
+    # attribute calls that block only on specific receivers (heuristic on the
+    # receiver's terminal identifier)
+    CONDITIONAL_ATTRS = {
+        "get": ("queue", "q"),            # queue.Queue.get, not dict.get
+        "join": ("thread", "pool", "proc", "writer"),
+        "take": ("bucket",),              # TokenBucket.take may sleep
+        "request": ("transport", "conn", "session"),
+        "shutdown": ("pool", "executor", "writer"),
+    }
+
+    @staticmethod
+    def _lockish(expr: ast.AST) -> bool:
+        name = _terminal_name(expr).lower()
+        return "lock" in name or "mutex" in name
+
+    def _blocking_call(self, call: ast.Call, imports: _ImportMap):
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            recv = _terminal_name(func.value).lower()
+            if func.attr in self.BLOCKING_ATTRS:
+                return f"`.{func.attr}()`"
+            hints = self.CONDITIONAL_ATTRS.get(func.attr)
+            if hints and any(h in recv for h in hints if h != "q"):
+                return f"`{recv}.{func.attr}()`"
+            if hints and recv in hints:  # exact match (the bare `q`)
+                return f"`{recv}.{func.attr}()`"
+        elif isinstance(func, ast.Name):
+            if imports.from_time.get(func.id) == "sleep" or func.id == "sleep":
+                return f"`{func.id}()`"
+            if func.id in imports.from_urllib:
+                return f"`{func.id}()`"
+        return None
+
+    def check(self, tree: ast.Module, relpath: str):
+        imports = _ImportMap()
+        imports.visit(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(self._lockish(item.context_expr) for item in node.items):
+                continue
+            lock_name = next(
+                _terminal_name(i.context_expr)
+                for i in node.items if self._lockish(i.context_expr)
+            )
+            for inner in _walk_skipping_defs(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                what = self._blocking_call(inner, imports)
+                if what is not None:
+                    yield (inner.lineno, inner.col_offset,
+                           f"blocking call {what} inside `with {lock_name}:`; "
+                           "reserve state under the lock and block outside it "
+                           "(the TokenBucket.take pattern)")
+
+
+# --------------------------------------------------------------------------
+# KBT003 — module-level mutable state in actions/ and framework/
+# --------------------------------------------------------------------------
+
+
+class ModuleStateRule(Rule):
+    """Historical bug: allocate published its per-cycle host-discard count in
+    a module global that backfill read — a process-global carrying a
+    per-session signal, wrong the moment two schedulers/sessions share the
+    interpreter (round-5 advisor finding; PR 1 moved it onto the Session).
+    Import-time registries are legitimate — annotate them as such."""
+
+    id = "KBT003"
+    title = "module-level mutable state in actions/framework"
+    scope = ("actions/", "framework/")
+
+    MUTABLE_FACTORIES = {
+        "dict", "list", "set", "defaultdict", "deque", "Counter",
+        "OrderedDict",
+    }
+
+    def _mutable_value(self, value: ast.AST) -> str:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return type(value).__name__.lower()
+        if isinstance(value, ast.Call):
+            name = _terminal_name(value.func)
+            if name in self.MUTABLE_FACTORIES:
+                return f"{name}()"
+        return ""
+
+    @staticmethod
+    def _constant_name(name: str) -> bool:
+        return name.upper() == name or name.startswith("__")
+
+    def _top_level_statements(self, tree: ast.Module):
+        """Module body, descending through If/Try but not into defs."""
+        stack = list(tree.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.If, ast.Try)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, tree: ast.Module, relpath: str):
+        for node in self._top_level_statements(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target] if isinstance(node.target, ast.Name) else []
+                value = node.value
+            else:
+                continue
+            kind = self._mutable_value(value)
+            if not kind:
+                continue
+            for t in targets:
+                if self._constant_name(t.id):
+                    continue
+                yield (node.lineno, node.col_offset,
+                       f"module-level mutable {kind} `{t.id}` can carry "
+                       "per-session/per-cycle state across cycles and "
+                       "schedulers; move it onto the Session (the "
+                       "last_host_discards fix) or annotate it as an "
+                       "import-time registry")
+        # writes to module globals from function bodies are the same bug in
+        # verb form — the allocate→backfill signal was exactly this
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global):
+                yield (node.lineno, node.col_offset,
+                       f"`global {', '.join(node.names)}` write from a "
+                       "function in actions/framework; per-cycle signals "
+                       "belong on the Session")
+
+
+# --------------------------------------------------------------------------
+# KBT004 — fail-open defaults in the translate layer
+# --------------------------------------------------------------------------
+
+
+class FailOpenTranslateRule(Rule):
+    """Historical bug: unrecognized PV nodeAffinity translated to node=None
+    ("reachable from every node"), letting --master mode bind pods onto
+    nodes that could not attach the volume (round-5 ADVICE #1). In the
+    translate layer, a None/empty return on unrecognized input is a policy
+    decision to fail open — it must be written down or fail closed."""
+
+    id = "KBT004"
+    title = "translate-layer fail-open default return"
+    scope = ("k8s/translate.py", "api/serialize.py")
+
+    @staticmethod
+    def _is_failopen_value(value) -> str:
+        if value is None:
+            return "bare `return`"
+        if isinstance(value, ast.Constant):
+            if value.value is None:
+                return "`return None`"
+            if value.value == "":
+                return '`return ""`'
+        if isinstance(value, (ast.List, ast.Tuple, ast.Set)) and not value.elts:
+            return "empty-collection return"
+        if isinstance(value, ast.Dict) and not value.keys:
+            return "empty-dict return"
+        if (isinstance(value, ast.Call) and not value.args
+                and not value.keywords
+                and _terminal_name(value.func) in ("dict", "list", "tuple", "set")):
+            return f"`return {_terminal_name(value.func)}()`"
+        return ""
+
+    def check(self, tree: ast.Module, relpath: str):
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            returns = [
+                n for n in _walk_skipping_defs(node.body)
+                if isinstance(n, ast.Return)
+            ]
+            # procedures (every return valueless/None) aren't translators
+            # with a fail-open default — only value-producing functions are
+            if not any(not self._is_failopen_value(r.value) for r in returns):
+                continue
+            for r in returns:
+                what = self._is_failopen_value(r.value)
+                if what:
+                    yield (r.lineno, r.col_offset,
+                           f"{what} in translate-layer `{node.name}` is a "
+                           "fail-open default on unrecognized input; fail "
+                           "closed (sentinel / raise) or annotate why open "
+                           "is sound")
+
+
+# --------------------------------------------------------------------------
+# KBT005 — host-device sync in ops/ hot paths
+# --------------------------------------------------------------------------
+
+
+class HostSyncRule(Rule):
+    """Guards the <1s/50k-pod cycle target: a host-device sync inside ops/
+    (np.asarray on device arrays, float()/int() materialization,
+    .block_until_ready, per-iteration jnp dispatch in Python loops) stalls
+    the device pipeline. Deliberate sync points (the solve's single
+    readback) are annotated."""
+
+    id = "KBT005"
+    title = "host-device sync in ops/ hot path"
+    scope = ("ops/",)
+
+    JAX_BASES = {"jnp", "jax", "lax"}
+    SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+
+    def check(self, tree: ast.Module, relpath: str):
+        imports = _ImportMap()
+        imports.visit(tree)
+        loop_spans: List[Tuple[int, int]] = []  # (first, last) line of loop bodies
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                end = max(
+                    (getattr(n, "end_lineno", None) or n.lineno)
+                    for n in _walk_skipping_defs(node.body)
+                    if hasattr(n, "lineno")
+                )
+                loop_spans.append((node.body[0].lineno, end))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                base = _leftmost_name(func)
+                if func.attr in self.SYNC_ATTRS:
+                    yield (node.lineno, node.col_offset,
+                           f"`.{func.attr}()` forces a host-device sync in an "
+                           "ops/ hot path; keep results on device or annotate "
+                           "the sync point")
+                    continue
+                if (base in imports.numpy_names or base == "np") \
+                        and func.attr in ("asarray", "array"):
+                    yield (node.lineno, node.col_offset,
+                           f"`{base}.{func.attr}()` materializes device data "
+                           "on host in an ops/ hot path; stay in jnp or "
+                           "annotate the sync point")
+                    continue
+                if base in self.JAX_BASES and any(
+                    lo <= node.lineno <= hi for lo, hi in loop_spans
+                ):
+                    yield (node.lineno, node.col_offset,
+                           f"`{base}.{func.attr}` dispatched inside a Python "
+                           "loop in ops/ — per-iteration device dispatch; "
+                           "vectorize, lax.scan, or annotate (trace-time "
+                           "unrolls are annotation-worthy, not rewrites)")
+            elif isinstance(func, ast.Name) and func.id in ("float", "int"):
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, (ast.Name, ast.Subscript)):
+                    yield (node.lineno, node.col_offset,
+                           f"`{func.id}()` on an array value forces a "
+                           "host-device sync in an ops/ hot path; keep the "
+                           "value on device or annotate the sync point")
+
+
+ALL_RULES = (
+    WallClockRule(),
+    BlockingUnderLockRule(),
+    ModuleStateRule(),
+    FailOpenTranslateRule(),
+    HostSyncRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

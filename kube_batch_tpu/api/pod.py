@@ -56,6 +56,46 @@ class Taint:
 HOSTNAME_TOPOLOGY = "kubernetes.io/hostname"
 
 
+def node_selector_terms_match(
+    terms, labels: Mapping[str, str]
+) -> bool:
+    """Evaluate v1.NodeSelectorTerms against a node's labels: terms are OR'd,
+    the (key, operator, values) requirements within a term are AND'd — the
+    vendored MatchNodeSelector semantics (predicates.go:194-205). Shared by
+    the host predicate (plugins/predicates.py) and the PV ledger's node
+    reachability check (cache/volume.py).
+
+    Operators: In / NotIn / Exists / DoesNotExist / Gt / Lt. An operator
+    outside that set fails its requirement (fail closed) — the reference's
+    selector constructor errors on unknown operators rather than matching."""
+
+    def _req_ok(key: str, op: str, values) -> bool:
+        present = key in labels
+        val = labels.get(key)
+        if op == "In":
+            return val in values
+        if op == "NotIn":
+            return val not in values
+        if op == "Exists":
+            return present
+        if op == "DoesNotExist":
+            return not present
+        if op in ("Gt", "Lt"):
+            if not present or not values:
+                return False
+            try:
+                lv, rv = int(val), int(values[0])
+            except (TypeError, ValueError):
+                return False
+            return lv > rv if op == "Gt" else lv < rv
+        return False
+
+    return any(
+        all(_req_ok(key, op, values) for key, op, values in term)
+        for term in terms
+    )
+
+
 @dataclasses.dataclass
 class PodAffinityTerm:
     """Required inter-pod (anti-)affinity term (the
@@ -136,6 +176,12 @@ class PersistentVolume:
     # k8s mode: PVs bind only claims of the same storage class; standalone
     # ingest leaves it empty (matches empty-class claims)
     storage_class: str = ""
+    # full spec.nodeAffinity.required nodeSelectorTerms (same (key, op,
+    # values) shape as Affinity.node_terms): carried whenever the PV has
+    # required affinity, so the ledger can evaluate zonal/regional topology
+    # against candidate node labels instead of failing closed on anything
+    # beyond a single-node pin (`node` stays the recognized-pin fast path)
+    node_terms: Tuple = ()
 
 
 @dataclasses.dataclass

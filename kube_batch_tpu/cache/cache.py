@@ -438,6 +438,11 @@ class SchedulerCache:
                 self.columns.bind_node(info)
             else:
                 existing.set_node(node)
+            # topology-restricted PVs evaluate their nodeSelectorTerms
+            # against these labels in the volume ledger (cache/volume.py)
+            set_labels = getattr(self.volume_binder, "set_node_labels", None)
+            if set_labels is not None:
+                set_labels(node.name, node.labels)
 
     def update_node(self, node: Node) -> None:
         self.add_node(node)
@@ -449,6 +454,11 @@ class SchedulerCache:
             node = self.nodes.get(name)
             if node is None:
                 return
+            # a gone node can't attach volumes: drop its labels so ledger
+            # reachability fails closed for it immediately
+            forget = getattr(self.volume_binder, "forget_node_labels", None)
+            if forget is not None:
+                forget(name)
             if node.tasks:
                 # resident pods outlive the Node object (their NodeName
                 # persists, like the reference's); demote to the nodeless
@@ -653,83 +663,93 @@ class SchedulerCache:
                 # binder dispatch + Scheduled events.  task.pod IS the stored
                 # pod here (ingest replaces the TaskInfo with the pod, and
                 # deletes are deferred while the session owns the cache), so
-                # the per-task store lookup is skipped
-                self._dispatch_async([(t, h, t.pod) for t, h in tasks_hosts])
-                return
-            pods_get = self.pods.get
-            staged = []
-            jobs_get = self.jobs.get
-            nodes_get = self.nodes.get
-            by_job: Dict[str, list] = {}
-            by_node: Dict[str, list] = {}
-            # the allocate replay emits binds grouped by job — run-length
-            # the job lookup instead of paying two dict probes per task
-            prev_job_uid = None
-            job = None
-            jlst: list = []
-            stale_jobs: set = set()
-            stale_nodes: set = set()
-            for task, hostname in tasks_hosts:
-                key = task._key
-                if task.job != prev_job_uid:
-                    prev_job_uid = task.job
-                    job = jobs_get(task.job)
-                    jlst = by_job.get(task.job)
-                    if jlst is None and job is not None:
-                        jlst = by_job[task.job] = []
-                own = job.tasks.get(key) if job is not None else None
-                if own is not None:
-                    if own.resreq is not task.resreq:  # pod updated mid-cycle
-                        stale_jobs.add(task.job)
-                        stale_nodes.add(hostname)
-                    own.node_name = hostname
-                    jlst.append(own)
-                    node = nodes_get(hostname)
-                    if node is not None and key not in node.tasks:
-                        nlst = by_node.get(hostname)
-                        if nlst is None:
-                            nlst = by_node[hostname] = []
-                        nlst.append(own)
-                staged.append((task, hostname, pods_get(key)))
-            nR = self.spec.n
-            for job_uid, owns in by_job.items():
-                job = self.jobs[job_uid]
-                # bulk_transition needs a homogeneous allocated-ness flip;
-                # a rebound task may already carry an allocated status
-                flip = [t for t in owns if not is_allocated(t.status)]
-                noflip = [t for t in owns if is_allocated(t.status)]
-                if flip:
-                    pre = None
-                    if (
-                        job_sums is not None and not noflip
-                        and job_uid not in stale_jobs
-                    ):
-                        entry = job_sums.get(job_uid)
-                        if entry is not None and entry[0] == len(flip):
-                            pre = entry[1]
-                    if pre is None:
-                        # tight accumulation beats np.sum-over-list at gang sizes
-                        pre = np.zeros(nR)
-                        for t in flip:
-                            pre += t.resreq.vec
-                    pre_r = self.spec.wrap_vec(pre)
-                    job.bulk_transition(flip, TaskStatus.BINDING, pre_r,
-                                        pending_sum=pre_r)
-                if noflip:
-                    job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
-            for hostname, owns in by_node.items():
-                node = self.nodes[hostname]
+                # the per-task store lookup is skipped.  The dispatch itself
+                # runs AFTER the lock releases, like the non-exclusive path:
+                # the executor's first submit spawns its worker thread, and
+                # blocking on a thread start under the cache's big lock is
+                # exactly what the lockdep check flags (and flagged here)
+                staged = [(t, h, t.pod) for t, h in tasks_hosts]
+            else:
+                staged = self._bulk_bind_locked(tasks_hosts, job_sums, node_sums)
+        self._dispatch_async(staged)
+
+    def _bulk_bind_locked(self, tasks_hosts, job_sums, node_sums) -> list:
+        """The non-exclusive bulk_bind body: apply job/node accounting under
+        the (held) cache lock and return the staged binder dispatch."""
+        pods_get = self.pods.get
+        staged = []
+        jobs_get = self.jobs.get
+        nodes_get = self.nodes.get
+        by_job: Dict[str, list] = {}
+        by_node: Dict[str, list] = {}
+        # the allocate replay emits binds grouped by job — run-length
+        # the job lookup instead of paying two dict probes per task
+        prev_job_uid = None
+        job = None
+        jlst: list = []
+        stale_jobs: set = set()
+        stale_nodes: set = set()
+        for task, hostname in tasks_hosts:
+            key = task._key
+            if task.job != prev_job_uid:
+                prev_job_uid = task.job
+                job = jobs_get(task.job)
+                jlst = by_job.get(task.job)
+                if jlst is None and job is not None:
+                    jlst = by_job[task.job] = []
+            own = job.tasks.get(key) if job is not None else None
+            if own is not None:
+                if own.resreq is not task.resreq:  # pod updated mid-cycle
+                    stale_jobs.add(task.job)
+                    stale_nodes.add(hostname)
+                own.node_name = hostname
+                jlst.append(own)
+                node = nodes_get(hostname)
+                if node is not None and key not in node.tasks:
+                    nlst = by_node.get(hostname)
+                    if nlst is None:
+                        nlst = by_node[hostname] = []
+                    nlst.append(own)
+            staged.append((task, hostname, pods_get(key)))
+        nR = self.spec.n
+        for job_uid, owns in by_job.items():
+            job = self.jobs[job_uid]
+            # bulk_transition needs a homogeneous allocated-ness flip;
+            # a rebound task may already carry an allocated status
+            flip = [t for t in owns if not is_allocated(t.status)]
+            noflip = [t for t in owns if is_allocated(t.status)]
+            if flip:
                 pre = None
-                if node_sums is not None and hostname not in stale_nodes:
-                    entry = node_sums.get(hostname)
-                    if entry is not None and entry[0] == len(owns):
+                if (
+                    job_sums is not None and not noflip
+                    and job_uid not in stale_jobs
+                ):
+                    entry = job_sums.get(job_uid)
+                    if entry is not None and entry[0] == len(flip):
                         pre = entry[1]
                 if pre is None:
+                    # tight accumulation beats np.sum-over-list at gang sizes
                     pre = np.zeros(nR)
-                    for t in owns:
+                    for t in flip:
                         pre += t.resreq.vec
-                node.bulk_add_tasks(owns, [], self.spec.wrap_vec(pre), self.spec.empty())
-        self._dispatch_async(staged)
+                pre_r = self.spec.wrap_vec(pre)
+                job.bulk_transition(flip, TaskStatus.BINDING, pre_r,
+                                    pending_sum=pre_r)
+            if noflip:
+                job.bulk_transition(noflip, TaskStatus.BINDING, self.spec.empty())
+        for hostname, owns in by_node.items():
+            node = self.nodes[hostname]
+            pre = None
+            if node_sums is not None and hostname not in stale_nodes:
+                entry = node_sums.get(hostname)
+                if entry is not None and entry[0] == len(owns):
+                    pre = entry[1]
+            if pre is None:
+                pre = np.zeros(nR)
+                for t in owns:
+                    pre += t.resreq.vec
+            node.bulk_add_tasks(owns, [], self.spec.wrap_vec(pre), self.spec.empty())
+        return staged
 
     def _dispatch_async(self, staged) -> None:
         """Run the binder calls off-cycle (the async goroutine,
@@ -982,6 +1002,8 @@ class SchedulerCache:
                     and (own_pg.running, own_pg.failed, own_pg.succeeded)
                     == (pg.running, pg.failed, pg.succeeded)
                 )
+            # kbt: allow[KBT001] status-write rate-limit cadence is wall-clock
+            # by design (job_updater.go:20-31); scheduling decisions never read it
             now = _time.monotonic()
             if condition_only and now < self._status_next_write.get(job.uid, 0.0):
                 write = False  # rate-limited; session state already updated
@@ -1017,6 +1039,8 @@ class SchedulerCache:
         to_write = []
         to_record = []
         with self._lock:
+            # kbt: allow[KBT001] same wall-clock rate-limit cadence as
+            # update_job_status above — write-stream pacing, not scenario time
             now = _time.monotonic()
             next_write = self._status_next_write
             jitter = np.random.uniform(60.0, 90.0, size=len(updates)).tolist()

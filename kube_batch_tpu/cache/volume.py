@@ -22,7 +22,12 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
-from kube_batch_tpu.api.pod import PersistentVolume, PersistentVolumeClaim
+from kube_batch_tpu.api.pod import (
+    HOSTNAME_TOPOLOGY,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    node_selector_terms_match,
+)
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -37,6 +42,10 @@ class StandalonePVBinder:
         self.bound: Dict[str, str] = {}  # claim → pv name (durable binding)
         # task uid → {claim: pv name} (assumed, this cycle)
         self.reservations: Dict[str, Dict[str, str]] = {}
+        # node name → labels, fed by the cache's node ingest: the full
+        # nodeSelectorTerms of a topology-restricted PV evaluate against
+        # these (the reference volumebinder reads node labels the same way)
+        self.node_labels: Dict[str, Dict[str, str]] = {}
         self._sorted_pvs: list = None  # memo; invalidated on ledger change
         # ingest arrives from watch / admin-HTTP threads while the
         # scheduling cycle reads — one coarse lock covers both ledgers
@@ -55,6 +64,49 @@ class StandalonePVBinder:
         with self._lock:
             self.pvs.pop(name, None)
             self._sorted_pvs = None
+
+    # -- node-label ingest (cache.add_node/delete_node feed this) --------
+    def set_node_labels(self, name: str, labels: Dict[str, str]) -> None:
+        # synthesize the kubelet-set hostname label and the metadata.name
+        # field ONCE here (both equal the node name) so the per-(PV, node)
+        # _reachable probe evaluates terms without copying the label map
+        merged = {HOSTNAME_TOPOLOGY: name, "metadata.name": name,
+                  **(labels or {})}
+        with self._lock:
+            self.node_labels[name] = merged
+
+    def forget_node_labels(self, name: str) -> None:
+        with self._lock:
+            self.node_labels.pop(name, None)
+
+    def _reachable(self, pv: PersistentVolume, hostname: str) -> bool:
+        """Can `hostname` attach `pv`? The single-node pin (or no affinity)
+        answers without labels; a topology-restricted PV evaluates its full
+        required nodeSelectorTerms against the candidate's labels. Unknown
+        labels fail closed — the PV_NODE_RESTRICTED_UNKNOWN floor of
+        ADVICE.md #1 — so an unlabeled/unseen node never fails open."""
+        if pv.node is None or pv.node == hostname:
+            return True
+        terms = getattr(pv, "node_terms", ())
+        if not terms:
+            return False
+        labels = self.node_labels.get(hostname)
+        if labels is None:
+            # no ingested labels for this node: only hostname-shaped terms
+            # are decidable (the kubelet always sets the hostname label /
+            # metadata.name IS the node name). Any other key must fail
+            # closed — evaluating e.g. a zone NotIn against a synthesized
+            # label map would match the absent key and fail OPEN
+            hostname_keys = (HOSTNAME_TOPOLOGY, "metadata.name")
+            if any(
+                key not in hostname_keys
+                for term in terms for key, _op, _vals in term
+            ):
+                return False
+            labels = {HOSTNAME_TOPOLOGY: hostname, "metadata.name": hostname}
+        # ingested maps already carry the synthesized hostname keys
+        # (set_node_labels) — no per-probe copy
+        return node_selector_terms_match(terms, labels)
 
     def _candidates(self) -> list:
         """PVs in match order (pre-bound first), memoized — _resolve runs
@@ -80,13 +132,13 @@ class StandalonePVBinder:
         bound_pv = self.bound.get(claim)
         if bound_pv is not None:
             pv = self.pvs.get(bound_pv)
-            if pv is not None and pv.node in (None, hostname):
+            if pv is not None and self._reachable(pv, hostname):
                 return bound_pv
             return None
         for pv in self._candidates():
             if pv.claim is not None and pv.claim != claim:
                 continue
-            if pv.node not in (None, hostname):
+            if not self._reachable(pv, hostname):
                 continue
             if pv.name in held:
                 continue
@@ -243,7 +295,7 @@ class K8sPVLedger(StandalonePVBinder):
         bound_pv = self.bound.get(key) or pvc.volume_name
         if bound_pv:
             pv = self.pvs.get(bound_pv)
-            if pv is not None and pv.node in (None, hostname):
+            if pv is not None and self._reachable(pv, hostname):
                 return pv.name
             return None
         if self._dynamic(pvc):
@@ -253,7 +305,7 @@ class K8sPVLedger(StandalonePVBinder):
                 continue
             if pv.storage_class != pvc.storage_class:
                 continue
-            if pv.node not in (None, hostname):
+            if not self._reachable(pv, hostname):
                 continue
             if pv.name in held:
                 continue
@@ -356,9 +408,12 @@ class K8sPVLedger(StandalonePVBinder):
 
     # -- throttled, retried, OFF-CYCLE cluster writes ---------------------
     def _submit_writes(self, writes) -> None:
+        from kube_batch_tpu.utils.blocking import allow_blocking
+
         # create + submit under the lock: the retry timer races the bind
-        # dispatch thread here, and two lazily-built executors would break
-        # the single-writer ordering (and drain_writes' fence)
+        # dispatch thread here, two lazily-built executors would break the
+        # single-writer ordering (and drain_writes' fence), and submits must
+        # enqueue in lock order for the earlier-failures-retry-first contract
         with self._lock:
             if self._writer is None:
                 from concurrent.futures import ThreadPoolExecutor
@@ -366,7 +421,11 @@ class K8sPVLedger(StandalonePVBinder):
                 self._writer = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="pv-writes"
                 )
-            self._writer.submit(self._run_writes, writes)
+            with allow_blocking(
+                "only the FIRST submit blocks (one-time pv-writes worker "
+                "spawn, bounded); the lock is the submit-ordering fence"
+            ):
+                self._writer.submit(self._run_writes, writes)
 
     def _run_writes(self, writes) -> None:
         with self._lock:
@@ -395,8 +454,13 @@ class K8sPVLedger(StandalonePVBinder):
                             "cycles re-derive them", overflow,
                         )
         with self._lock:
-            if self._pending_writes:
-                self._arm_retry_timer_locked()
+            timer = self._arm_retry_timer_locked() if self._pending_writes else None
+        if timer is not None:
+            # start OUTSIDE the lock: Thread.start blocks on the spawned
+            # thread's startup handshake (lockdep: blocking-under-lock);
+            # the timer can't fire before start, so arming under the lock
+            # and starting after it is race-free
+            timer.start()
 
     def _forget_dropped_writes(self, dropped) -> None:
         """A dropped claimRef PATCH must also drop its `bound` entry, or the
@@ -414,18 +478,20 @@ class K8sPVLedger(StandalonePVBinder):
             if self.bound.get(key) == pv:
                 del self.bound[key]
 
-    def _arm_retry_timer_locked(self) -> None:
-        """Schedule a timer-driven flush so queued retries drain even when
-        no further bind_volumes call arrives. One timer at a time; it
-        disarms itself and re-arms from _run_writes while work remains."""
+    def _arm_retry_timer_locked(self):
+        """Create + register a timer-driven flush so queued retries drain
+        even when no further bind_volumes call arrives. One timer at a time;
+        it disarms itself and re-arms from _run_writes while work remains.
+        Returns the timer for the CALLER to start after releasing the lock
+        (or None when one is already armed)."""
         if self._retry_timer is not None:
-            return
+            return None
         import threading
 
         t = threading.Timer(self.RETRY_FLUSH_INTERVAL, self._timer_flush)
         t.daemon = True
         self._retry_timer = t
-        t.start()
+        return t
 
     def _timer_flush(self) -> None:
         with self._lock:

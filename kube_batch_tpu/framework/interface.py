@@ -41,7 +41,10 @@ class Action:
 
 
 _lock = threading.Lock()
+# kbt: allow[KBT003] import-time registry: filled once by module import
+# (plugins/__init__, actions/__init__), read-only at scheduling time
 _plugin_builders: Dict[str, Callable[[Arguments], Plugin]] = {}
+# kbt: allow[KBT003] import-time registry, same contract as _plugin_builders
 _actions: Dict[str, Action] = {}
 
 

@@ -14,7 +14,6 @@ sequential semantics.
 from __future__ import annotations
 
 import itertools
-import time as _time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -31,6 +30,7 @@ from kube_batch_tpu.api.types import (
 )
 from kube_batch_tpu.framework.conf import Tier
 from kube_batch_tpu import metrics
+from kube_batch_tpu.utils import telemetry
 
 # fn-kind names used in the per-plugin registries
 JOB_ORDER, QUEUE_ORDER, TASK_ORDER = "job_order", "queue_order", "task_order"
@@ -644,11 +644,11 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
             for opt in tier.plugins:
                 plugin = get_plugin_builder(opt.name)(opt.arguments)
                 ssn.plugins.append(plugin)
-                t0 = _time.perf_counter()
+                t0 = telemetry.perf_counter()
                 plugin.on_session_open(ssn)
                 metrics.observe_plugin_latency(
                     opt.name, "OnSessionOpen",
-                    (_time.perf_counter() - t0) * 1e6,
+                    (telemetry.perf_counter() - t0) * 1e6,
                 )
         # gang-validity gate after plugins registered their JobValid fns.
         # Columnar sessions prefilter with one counts-matrix expression when
@@ -871,11 +871,11 @@ def close_session(ssn: Session) -> None:
     state, gone with a cloned session) and release the cache gate."""
     try:
         for plugin in ssn.plugins:
-            t0 = _time.perf_counter()
+            t0 = telemetry.perf_counter()
             plugin.on_session_close(ssn)
             metrics.observe_plugin_latency(
                 plugin.name, "OnSessionClose",
-                (_time.perf_counter() - t0) * 1e6,
+                (telemetry.perf_counter() - t0) * 1e6,
             )
         if ssn.columns is not None and ssn.jobs:
             _close_status_columnar(ssn)

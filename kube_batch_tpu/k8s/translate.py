@@ -126,6 +126,8 @@ def _controller_uid(meta: dict) -> Optional[str]:
     for ref in (meta or {}).get("ownerReferences") or []:
         if ref.get("controller"):
             return ref.get("uid") or ref.get("name")
+    # kbt: allow[KBT004] ownerless pods are a valid spec state (bare pods),
+    # not unrecognized input; None means "no controller", never a guess
     return None
 
 
@@ -169,6 +171,8 @@ def _weighted_pod_terms(spec: dict, key: str):
 
 def _affinity_from_k8s(aff: Optional[dict]) -> Optional[Affinity]:
     if not aff:
+        # kbt: allow[KBT004] absent affinity stanza = unconstrained pod by
+        # k8s spec; None is the documented "no affinity" value, not a default
         return None
     out = Affinity()
     node_aff = aff.get("nodeAffinity") or {}
@@ -199,6 +203,9 @@ def _affinity_from_k8s(aff: Optional[dict]) -> Optional[Affinity]:
         not out.node_terms and not out.pod_affinity and not out.pod_anti_affinity
         and not out.has_preferences()
     ):
+        # kbt: allow[KBT004] an affinity stanza that parses to zero terms is
+        # an empty selector (matches everything) per MatchNodeSelector
+        # semantics, predicates.go:194-205 — open IS the reference behavior
         return None
     return out
 
@@ -341,6 +348,9 @@ def pdb_from_k8s(obj: dict) -> Optional[PodDisruptionBudget]:
     spec = obj.get("spec") or {}
     min_available = spec.get("minAvailable")
     if not isinstance(min_available, int):
+        # kbt: allow[KBT004] percentage/unparseable minAvailable is not a
+        # gang signal; skipping matches the reference (event_handlers.go:
+        # 484-594) and only forgoes gang semantics, never placement safety
         return None
     return PodDisruptionBudget(
         name=meta.get("name", ""),
@@ -361,40 +371,57 @@ def priority_class_from_k8s(obj: dict) -> PriorityClass:
 
 
 # Sentinel "node" for a PV whose required nodeAffinity exists but isn't a
-# recognizable single-node pin: it never equals a real hostname, so the
-# ledger treats the PV as reachable from NO node (fail-closed). The previous
-# behavior — node=None, reachable from every node — let --master mode bind a
-# pod onto a node that cannot attach the volume (ADVICE.md #1); the reference
-# delegates to the k8s volumebinder, which honors full PV node affinity.
+# recognizable single-node pin: it never equals a real hostname, so a ledger
+# with no label knowledge treats the PV as reachable from NO node
+# (fail-closed). The full nodeSelectorTerms now ride along on
+# PersistentVolume.node_terms, and the ledger evaluates them against
+# candidate node labels (the reference volumebinder's behavior) — the
+# sentinel only bites when labels for the candidate are unknown, keeping
+# ADVICE.md #1's fail-closed floor without its zonal over-restriction.
 PV_NODE_RESTRICTED_UNKNOWN = "__pv-node-affinity-unrecognized__"
 
 
-def _pv_node_from_affinity(spec: dict) -> Optional[str]:
-    """A local PV's single reachable node, read from the
-    spec.nodeAffinity required terms (the kubernetes.io/hostname label or
-    metadata.name field expression local-storage provisioning writes); None
-    only for volumes with NO required affinity (network volumes reachable
-    everywhere). Required terms are OR'd: any recognized single-node term
-    yields its node; required terms that are all unrecognized (zone/region
-    topology, operators other than In) are restrictive — the PV gets the
-    no-node sentinel rather than failing open."""
+def _pv_node_affinity(spec: dict) -> Tuple[Optional[str], tuple]:
+    """A PV's (single-node pin, full required terms) from
+    spec.nodeAffinity.required.
+
+    The pin fast path reads the kubernetes.io/hostname / metadata.name In
+    expression local-storage provisioning writes, so the common local-PV
+    case never needs node labels. Terms are returned whenever required
+    affinity exists — OR'd, in Affinity.node_terms shape — and the ledger
+    evaluates them against candidate node labels; with affinity but no
+    recognized pin the `node` field gets the fail-closed sentinel."""
     required = ((spec.get("nodeAffinity") or {}).get("required") or {})
-    terms = required.get("nodeSelectorTerms") or []
-    if not terms:
-        return None
+    raw_terms = required.get("nodeSelectorTerms") or []
+    if not raw_terms:
+        # kbt: allow[KBT004] no required affinity = a network volume
+        # reachable from every node (spec semantics, not unrecognized input)
+        return None, ()
+    terms = tuple(
+        tuple(reqs) for reqs in (_match_expressions(t) for t in raw_terms) if reqs
+    )
+    pin = None
     for term in terms:
+        # the pin fast path must only bypass term evaluation when the term
+        # is NOTHING BUT the single-node expression: requirements within a
+        # term are AND'd, so a term pairing a hostname pin with e.g. a zone
+        # requirement pins conditionally and must evaluate in full — taking
+        # the hostname alone would fail open on a node whose other labels
+        # don't match (the ADVICE.md #1 bug class again)
+        if len(term) != 1:
+            continue
+        key, op, values = term[0]
         # _match_expressions folds matchFields metadata.name In onto the
         # hostname label (every kubelet sets it to the node name); some
         # provisioners put metadata.name in matchExpressions instead
-        for e in _match_expressions(term):
-            key, op, values = e
-            if (
-                key in ("kubernetes.io/hostname", "metadata.name")
-                and op == "In"
-                and values
-            ):
-                return values[0]
-    return PV_NODE_RESTRICTED_UNKNOWN
+        if (
+            key in ("kubernetes.io/hostname", "metadata.name")
+            and op == "In"
+            and values
+        ):
+            pin = values[0]
+            break
+    return (pin if pin is not None else PV_NODE_RESTRICTED_UNKNOWN), terms
 
 
 def pv_from_k8s(obj: dict) -> PersistentVolume:
@@ -405,11 +432,13 @@ def pv_from_k8s(obj: dict) -> PersistentVolume:
     claim = None
     if claim_ref.get("name"):
         claim = f"{claim_ref.get('namespace', 'default')}/{claim_ref['name']}"
+    node, node_terms = _pv_node_affinity(spec)
     return PersistentVolume(
         name=meta.get("name", ""),
-        node=_pv_node_from_affinity(spec),
+        node=node,
         claim=claim,
         storage_class=spec.get("storageClassName", ""),
+        node_terms=node_terms,
     )
 
 

@@ -268,6 +268,9 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
             # memory magnitudes (~1e11) dwarfs the 10 MiB quantum the
             # epsilon compare below relies on — exact f32 keeps the one-hot
             # contraction a true row selection
+            # kbt: allow[KBT005] trace-time unroll over the small static
+            # resource dim R inside jit — R fused matmuls in the compiled
+            # graph, zero per-iteration host dispatch
             cap_tr = jnp.matmul(
                 onehot_q, cap[:, :, r], precision=jax.lax.Precision.HIGHEST
             )                                                        # [T, N]

@@ -57,6 +57,8 @@ def multisort_ranks(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
     n = keys[0].shape[0]
     order = jnp.arange(n)
     for key in reversed(list(keys)):
+        # kbt: allow[KBT005] trace-time unroll over the static key list (a
+        # handful of sort keys) inside jit — no per-iteration host dispatch
         order = order[jnp.argsort(key[order], stable=True)]
     rank = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
     return rank

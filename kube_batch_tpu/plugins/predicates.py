@@ -20,6 +20,7 @@ Checks, mirroring predicates.go:154-298:
 from __future__ import annotations
 
 from kube_batch_tpu.api.node_info import NodeInfo
+from kube_batch_tpu.api.pod import node_selector_terms_match
 from kube_batch_tpu.api.snapshot import HARD_TAINT_EFFECTS
 from kube_batch_tpu.api.task_info import TaskInfo
 from kube_batch_tpu.framework.interface import Plugin
@@ -38,22 +39,11 @@ def match_node_selector(task: TaskInfo, node: NodeInfo) -> bool:
             return False
     if task.pod.affinity is not None:
         terms = task.pod.affinity.node_terms
-        if terms:
-            def term_ok(term):
-                for key, op, values in term:
-                    has = key in labels
-                    if op == "In" and labels.get(key) not in values:
-                        return False
-                    if op == "NotIn" and labels.get(key) in values:
-                        return False
-                    if op == "Exists" and not has:
-                        return False
-                    if op == "DoesNotExist" and has:
-                        return False
-                return True
-
-            if not any(term_ok(t) for t in terms):
-                return False
+        # shared evaluator (api/pod.py) — also the PV ledger's reachability
+        # check; adds Gt/Lt and fails closed on unknown operators (the old
+        # inline check silently passed them)
+        if terms and not node_selector_terms_match(terms, labels):
+            return False
     return True
 
 

@@ -19,6 +19,7 @@ from kube_batch_tpu.framework.conf import SchedulerConfiguration, load_scheduler
 from kube_batch_tpu.framework.interface import Action, get_action
 from kube_batch_tpu.framework.session import close_session, open_session
 from kube_batch_tpu import metrics
+from kube_batch_tpu.utils import telemetry
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -104,21 +105,21 @@ class Scheduler:
         if resync is not None:
             resync()
         self._maybe_reload_conf()
-        start = time.perf_counter()
+        start = telemetry.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
         # the configured pipeline, for actions whose behavior depends on
         # what runs after them (reclaim's idle-fit claimant gate)
         ssn.action_names = [a.name for a in self.actions]
         try:
             for action in self.actions:
-                a_start = time.perf_counter()
+                a_start = telemetry.perf_counter()
                 action.execute(ssn)
                 metrics.observe_action_latency(
-                    action.name, (time.perf_counter() - a_start) * 1e6
+                    action.name, (telemetry.perf_counter() - a_start) * 1e6
                 )
         finally:
             close_session(ssn)
-        metrics.observe_e2e_latency((time.perf_counter() - start) * 1e3)
+        metrics.observe_e2e_latency((telemetry.perf_counter() - start) * 1e3)
         # drain async binder dispatch (cache.go:478's goroutines) outside the
         # measured cycle so callers observe a deterministic post-cycle state
         flush = getattr(self.cache, "flush_binds", None)

@@ -22,7 +22,11 @@ def percentile_summary(values: List[float]) -> Optional[Dict]:
     n = len(xs)
 
     def pct(p: float) -> float:
-        return round(xs[min(n - 1, int(p * n))], 6)
+        # nearest-rank is ceil(p*n)-1; int(p*n) sat one rank high (p50 of a
+        # 2-sample read the max), overstating small-n tails
+        import math
+
+        return round(xs[min(n - 1, max(0, math.ceil(p * n) - 1))], 6)
 
     return {
         "n": n,

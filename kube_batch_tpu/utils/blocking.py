@@ -1,0 +1,38 @@
+"""`allow_blocking` — the runtime analog of `# kbt: allow[...]` for the
+lockdep blocking-under-lock check (kube_batch_tpu/analysis/lockdep.py).
+
+Lives in utils/ (stdlib-only, no analysis-package imports) because the
+RUNTIME core annotates with it — cache/volume.py fences its pv-writes
+submit — and pulling the AST lint engine into every scheduler process just
+to mark a sound blocking region would be backwards. The lockdep detector
+reads the same thread-local, so suppression works whether or not the
+detector is installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+# allow_blocking() nesting depth, per thread
+_blocking_ok = threading.local()
+
+
+@contextlib.contextmanager
+def allow_blocking(reason: str):
+    """Suppress lockdep blocking-under-lock reports for the enclosed region.
+    `reason` is mandatory and should say why the block is sound (bounded,
+    ordering-fenced, one-time spawn...) — it is what a reviewer greps for,
+    exactly like the static `# kbt: allow[...]` annotations."""
+    if not reason or not reason.strip():
+        raise ValueError("allow_blocking requires a non-empty reason")
+    depth = getattr(_blocking_ok, "depth", 0)
+    _blocking_ok.depth = depth + 1
+    try:
+        yield
+    finally:
+        _blocking_ok.depth = depth
+
+
+def blocking_allowed() -> bool:
+    return getattr(_blocking_ok, "depth", 0) > 0

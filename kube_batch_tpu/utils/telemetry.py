@@ -1,0 +1,19 @@
+"""Telemetry clock seam — the ONE sanctioned wall-clock read for latency
+metrics inside the clock-seamed paths (scheduler / actions / framework).
+
+KBT001 (kube_batch_tpu/analysis) bans raw `time.*` reads in those paths
+because the virtual-time simulator injects its own clock and a stray
+wall-clock read silently breaks replay determinism. Latency telemetry is
+the deliberate exception: it measures how long the real compute took, never
+scenario time, so it must NOT follow the injected clock. Routing every such
+read through this module keeps the exception greppable to a single import —
+`grep -rn 'telemetry.perf_counter'` is the complete audit of wall-clock
+telemetry in the scheduling core. Anything else that needs time goes
+through the injected clock (`Scheduler.clock`, sim `VirtualClock`) or
+carries a per-line `# kbt: allow[KBT001] reason` annotation.
+"""
+
+import time
+
+#: wall-clock monotonic high-resolution counter for latency spans only
+perf_counter = time.perf_counter

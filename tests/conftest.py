@@ -27,6 +27,13 @@ deregister_axon_backend()
 
 import pytest  # noqa: E402
 
+# Run the whole suite under the lockdep runtime lock-order validator (the
+# `go test -race` analog, kube_batch_tpu/analysis/lockdep.py): instrumented
+# locks in cache/, cmd/server, k8s/watch and metrics/ record the
+# acquisition-order graph while the ordinary tests execute; inversions or
+# blocking-under-lock fail the run. Disable with KBT_LOCKDEP=0.
+pytest_plugins = ["kube_batch_tpu.analysis.pytest_plugin"]
+
 
 def pytest_configure(config):
     config.addinivalue_line(

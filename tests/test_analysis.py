@@ -1,0 +1,404 @@
+"""kbt-check static analyzer: fixture-driven good/bad snippets per rule,
+suppression contract, CLI, and the tier-1 self-enforcement check that keeps
+the whole package clean."""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from kube_batch_tpu.analysis import check_source, run_paths
+from kube_batch_tpu.analysis.rules import RULES_BY_ID
+
+
+def findings_for(src: str, relpath: str):
+    return check_source(textwrap.dedent(src), relpath)
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# KBT001 — wall clock outside the Clock seam
+# ---------------------------------------------------------------------------
+
+
+class TestKBT001:
+    BAD = """
+    import time
+
+    def pace():
+        time.sleep(1.0)
+        return time.monotonic()
+    """
+
+    def test_bad_snippet_triggers_exactly_kbt001(self):
+        findings = findings_for(self.BAD, "actions/x.py")
+        assert rule_ids(findings) == ["KBT001"]
+        assert len(findings) == 2
+
+    def test_from_import_alias_is_caught(self):
+        findings = findings_for(
+            "from time import sleep as zzz\ndef f():\n    zzz(1)\n",
+            "sim/x.py",
+        )
+        assert rule_ids(findings) == ["KBT001"]
+
+    def test_datetime_now_is_caught(self):
+        findings = findings_for(
+            "import datetime\ndef f():\n    return datetime.datetime.now()\n",
+            "cache/x.py",
+        )
+        assert rule_ids(findings) == ["KBT001"]
+
+    def test_injected_clock_is_the_sanctioned_path(self):
+        good = """
+        class S:
+            def pace(self):
+                t = self.clock.monotonic()
+                self.clock.sleep(1.0)
+                return t
+        """
+        assert findings_for(good, "scheduler.py") == []
+
+    def test_out_of_scope_paths_unflagged(self):
+        # cmd/ owns real wall-clock concerns (leases, rate limits)
+        assert findings_for(self.BAD, "cmd/x.py") == []
+
+    def test_annotation_suppresses(self):
+        src = """
+        import time
+
+        def f():
+            # kbt: allow[KBT001] measures real compute for the bench
+            return time.perf_counter()
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT002 — blocking call under a lock
+# ---------------------------------------------------------------------------
+
+
+class TestKBT002:
+    def test_sleep_under_lock_triggers(self):
+        src = """
+        import time
+
+        def take(self):
+            with self._lock:
+                time.sleep(0.1)
+        """
+        # KBT002 everywhere; out of KBT001 scope so only the lock rule fires
+        findings = findings_for(src, "cmd/server.py")
+        assert rule_ids(findings) == ["KBT002"]
+
+    def test_future_result_and_queue_get_under_lock_trigger(self):
+        src = """
+        def drain(self):
+            with self._lock:
+                self.future.result()
+                item = work_queue.get()
+        """
+        findings = findings_for(src, "k8s/x.py")
+        assert len(findings) == 2 and rule_ids(findings) == ["KBT002"]
+
+    def test_tokenbucket_pattern_is_clean(self):
+        src = """
+        def take(self):
+            with self._lock:
+                self._tokens -= 1.0
+                wait = max(0.0, -self._tokens / self._qps)
+            if wait:
+                self._time.sleep(wait)
+        """
+        assert findings_for(src, "cmd/server.py") == []
+
+    def test_dict_get_under_lock_is_not_blocking(self):
+        src = """
+        def read(self):
+            with self._lock:
+                return self.index.get("k")
+        """
+        assert findings_for(src, "k8s/x.py") == []
+
+    def test_nested_def_body_is_not_under_the_lock(self):
+        src = """
+        import time
+
+        def sched(self):
+            with self._lock:
+                def later():
+                    time.sleep(1)
+                return later
+        """
+        assert findings_for(src, "cmd/x.py") == []
+
+    def test_non_lock_with_is_ignored(self):
+        src = """
+        import time
+
+        def f():
+            with open("x") as fh:
+                time.sleep(1)
+                return fh
+        """
+        assert findings_for(src, "cmd/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT003 — module-level mutable state in actions/framework
+# ---------------------------------------------------------------------------
+
+
+class TestKBT003:
+    def test_module_dict_and_global_write_trigger(self):
+        src = """
+        last_host_discards = {}
+
+        def execute(ssn):
+            global cycle_count
+            cycle_count = 1
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert rule_ids(findings) == ["KBT003"]
+        assert len(findings) == 2
+
+    def test_constants_and_dunders_are_fine(self):
+        src = """
+        OVERCOMMIT = {"cpu": 1.2}
+        __all__ = ["execute"]
+        logger = get_logger("x")
+        """
+        assert findings_for(src, "framework/x.py") == []
+
+    def test_annotated_registry_is_fine(self):
+        src = """
+        # kbt: allow[KBT003] import-time registry, read-only after import
+        _builders = {}
+        """
+        assert findings_for(src, "framework/x.py") == []
+
+    def test_out_of_scope_module_state_unflagged(self):
+        assert findings_for("cache = {}\n", "plugins/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT004 — translate-layer fail-open defaults
+# ---------------------------------------------------------------------------
+
+
+class TestKBT004:
+    def test_none_fallback_in_value_function_triggers(self):
+        src = """
+        def node_from(spec):
+            if spec.get("kind") == "node":
+                return spec["name"]
+            return None
+        """
+        findings = findings_for(src, "k8s/translate.py")
+        assert rule_ids(findings) == ["KBT004"]
+
+    def test_empty_collection_fallback_triggers(self):
+        src = """
+        def terms_from(spec):
+            if "terms" in spec:
+                return list(spec["terms"])
+            return []
+        """
+        assert rule_ids(findings_for(src, "k8s/translate.py")) == ["KBT004"]
+
+    def test_procedures_with_bare_returns_are_fine(self):
+        src = """
+        def apply(cache, obj):
+            if obj is None:
+                return
+            cache.add(obj)
+        """
+        assert findings_for(src, "k8s/translate.py") == []
+
+    def test_fail_closed_sentinel_is_fine(self):
+        src = """
+        SENTINEL = "__restricted__"
+
+        def node_from(spec):
+            if spec.get("kind") == "node":
+                return spec["name"]
+            return SENTINEL
+        """
+        assert findings_for(src, "k8s/translate.py") == []
+
+    def test_annotated_default_is_fine(self):
+        src = """
+        def owner_of(meta):
+            for ref in meta.get("ownerReferences") or []:
+                return ref["uid"]
+            # kbt: allow[KBT004] ownerless pods are a valid spec state
+            return None
+        """
+        assert findings_for(src, "k8s/translate.py") == []
+
+    def test_out_of_scope_none_returns_unflagged(self):
+        src = "def f(x):\n    if x:\n        return x\n    return None\n"
+        assert findings_for(src, "cache/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT005 — host-device sync in ops/
+# ---------------------------------------------------------------------------
+
+
+class TestKBT005:
+    def test_sync_calls_trigger(self):
+        src = """
+        import numpy as np
+
+        def solve(x):
+            y = np.asarray(x)
+            x.block_until_ready()
+            return float(y)
+        """
+        findings = findings_for(src, "ops/x.py")
+        assert rule_ids(findings) == ["KBT005"]
+        assert len(findings) == 3
+
+    def test_jnp_dispatch_in_python_loop_triggers(self):
+        src = """
+        import jax.numpy as jnp
+
+        def f(keys):
+            total = 0
+            for k in keys:
+                total = total + jnp.sum(k)
+            return total
+        """
+        assert rule_ids(findings_for(src, "ops/x.py")) == ["KBT005"]
+
+    def test_vectorized_jnp_is_fine(self):
+        src = """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x, axis=0)
+        """
+        assert findings_for(src, "ops/x.py") == []
+
+    def test_annotated_trace_time_unroll_is_fine(self):
+        src = """
+        import jax.numpy as jnp
+
+        def f(xs):
+            acc = xs[0]
+            for x in xs[1:]:
+                # kbt: allow[KBT005] trace-time unroll over a static tuple
+                acc = jnp.maximum(acc, x)
+            return acc
+        """
+        assert findings_for(src, "ops/x.py") == []
+
+    def test_out_of_scope_numpy_unflagged(self):
+        src = "import numpy as np\ndef f(x):\n    return np.asarray(x)\n"
+        assert findings_for(src, "cache/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# engine: suppression contract
+# ---------------------------------------------------------------------------
+
+
+class TestSuppressions:
+    def test_allow_without_reason_does_not_suppress(self):
+        src = """
+        import time
+
+        def f():
+            return time.time()  # kbt: allow[KBT001]
+        """
+        findings = findings_for(src, "actions/x.py")
+        # the original finding survives AND the empty allow is itself flagged
+        assert rule_ids(findings) == ["KBT000", "KBT001"]
+
+    def test_multiline_annotation_block_covers_next_statement(self):
+        src = """
+        import time
+
+        def f():
+            # kbt: allow[KBT001] long explanation of why this wall-clock
+            # read is deliberate, spilling onto a second comment line
+            return time.time()
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_allow_only_suppresses_its_own_rule(self):
+        src = """
+        import time
+
+        def f(self):
+            with self._lock:
+                # kbt: allow[KBT002] reason that names the wrong rule
+                time.sleep(1)
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert rule_ids(findings) == ["KBT001"]  # KBT002 suppressed, 001 not
+
+    def test_syntax_error_reports_kbt000(self):
+        findings = findings_for("def f(:\n", "actions/x.py")
+        assert rule_ids(findings) == ["KBT000"]
+
+
+# ---------------------------------------------------------------------------
+# self-enforcement: the package must be clean (tier-1)
+# ---------------------------------------------------------------------------
+
+
+class TestSelfEnforcement:
+    def test_package_has_zero_unsuppressed_findings(self):
+        findings = run_paths()  # defaults to the kube_batch_tpu tree
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+    def test_every_rule_has_title_and_grounding_doc(self):
+        for rule in RULES_BY_ID.values():
+            assert rule.title
+            # each rule documents the incident that motivated it
+            assert rule.__doc__ and len(rule.__doc__.strip()) > 40
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + JSONL
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "kube_batch_tpu.analysis", *args],
+            capture_output=True, text=True,
+            cwd=str(Path(__file__).resolve().parent.parent),
+        )
+
+    def test_clean_tree_exits_zero(self):
+        proc = self._run("kube_batch_tpu/analysis")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_findings_exit_nonzero_and_jsonl_parses(self, tmp_path):
+        bad = tmp_path / "ops" / "hot.py"
+        bad.parent.mkdir()
+        bad.write_text("def f(x):\n    x.block_until_ready()\n")
+        proc = self._run("--jsonl", str(bad))
+        assert proc.returncode == 1
+        rows = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+        assert rows and rows[0]["rule"] == "KBT005"
+        assert rows[0]["line"] == 2
+
+    def test_select_unknown_rule_is_usage_error(self):
+        proc = self._run("--select", "KBT999")
+        assert proc.returncode == 2
+
+    def test_nonexistent_path_is_a_finding_not_clean(self):
+        # a typo'd CI path must not report clean/exit 0
+        proc = self._run("no/such/dir")
+        assert proc.returncode == 1
+        assert "does not exist" in proc.stdout

@@ -778,3 +778,235 @@ class TestPvLedgerRetryQueue:
         led.drain_writes()
         assert not led._pending_writes
         assert any("persistentvolumes/" in r[1] for r in tr.requests)
+
+
+class TestPvTopologyAffinity:
+    """ROADMAP follow-on to the fail-closed floor: a PV restricted by
+    zonal/regional required terms is reachable from every node whose labels
+    satisfy the full nodeSelectorTerms (the reference volumebinder's
+    behavior) — the PV_NODE_RESTRICTED_UNKNOWN sentinel now only bites when
+    the candidate's labels are unknown to the ledger."""
+
+    ZONAL_AFF = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+        {"key": "topology.kubernetes.io/zone", "operator": "In",
+         "values": ["us-central1-a"]}]}]}}
+
+    @staticmethod
+    def _pv(node_affinity, name="pv-z"):
+        spec = {"storageClassName": "local-ssd"}
+        if node_affinity is not None:
+            spec["nodeAffinity"] = node_affinity
+        return {"apiVersion": "v1", "kind": "PersistentVolume",
+                "metadata": {"name": name}, "spec": spec}
+
+    @staticmethod
+    def _task(uid, claims):
+        class T:
+            pass
+
+        t = T()
+        t.uid = uid
+        t.pod = type("P", (), {"namespace": "ml", "volume_claims": tuple(claims)})()
+        return t
+
+    def _zonal_ledger(self):
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.k8s.translate import pv_from_k8s, pvc_from_k8s
+
+        led = K8sPVLedger()
+        led.add_storage_class("local-ssd", "kubernetes.io/no-provisioner")
+        led.add_pv(pv_from_k8s(self._pv(self.ZONAL_AFF)))
+        led.add_pvc(pvc_from_k8s({
+            "metadata": {"name": "zonal-data", "namespace": "ml"},
+            "spec": {"storageClassName": "local-ssd"},
+            "status": {"phase": "Pending"},
+        }))
+        return led
+
+    def test_translate_carries_full_terms(self):
+        from kube_batch_tpu.k8s.translate import (
+            PV_NODE_RESTRICTED_UNKNOWN, pv_from_k8s)
+
+        pv = pv_from_k8s(self._pv(self.ZONAL_AFF))
+        assert pv.node == PV_NODE_RESTRICTED_UNKNOWN
+        assert pv.node_terms == (
+            (("topology.kubernetes.io/zone", "In", ("us-central1-a",)),),
+        )
+
+    def test_single_node_pin_also_carries_terms(self):
+        from kube_batch_tpu.k8s.translate import pv_from_k8s
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "kubernetes.io/hostname", "operator": "In",
+             "values": ["node-b"]}]}]}}
+        pv = pv_from_k8s(self._pv(aff))
+        assert pv.node == "node-b"
+        assert pv.node_terms
+
+    def test_zonal_pv_feasible_on_labeled_in_zone_node_only(self):
+        led = self._zonal_ledger()
+        led.set_node_labels("node-a", {"topology.kubernetes.io/zone":
+                                       "us-central1-a"})
+        led.set_node_labels("node-b", {"topology.kubernetes.io/zone":
+                                       "us-central1-b"})
+        t = self._task("ml/consumer", ["zonal-data"])
+        assert led.volume_feasible(t, "node-a")
+        assert not led.volume_feasible(t, "node-b")
+        # a node the ledger has no labels for stays fail-closed
+        assert not led.volume_feasible(t, "node-unknown")
+
+    def test_allocate_and_bind_on_zone_match(self):
+        led = self._zonal_ledger()
+        led.set_node_labels("node-a", {"topology.kubernetes.io/zone":
+                                       "us-central1-a"})
+        t = self._task("ml/consumer", ["zonal-data"])
+        led.allocate_volumes(t, "node-a")
+        led.bind_volumes(t)
+        assert led.bound["ml/zonal-data"] == "pv-z"
+
+    def test_deleting_node_labels_fails_closed_again(self):
+        led = self._zonal_ledger()
+        led.set_node_labels("node-a", {"topology.kubernetes.io/zone":
+                                       "us-central1-a"})
+        t = self._task("ml/consumer", ["zonal-data"])
+        assert led.volume_feasible(t, "node-a")
+        led.forget_node_labels("node-a")
+        assert not led.volume_feasible(t, "node-a")
+
+    def test_cache_node_ingest_feeds_ledger_labels(self):
+        from kube_batch_tpu.api.pod import Node
+        from kube_batch_tpu.cache.cache import SchedulerCache
+
+        led = self._zonal_ledger()
+        cache = SchedulerCache(volume_binder=led)
+        cache.add_node(Node(
+            name="node-a",
+            allocatable={"cpu": 4000.0},
+            labels={"topology.kubernetes.io/zone": "us-central1-a"},
+        ))
+        t = self._task("ml/consumer", ["zonal-data"])
+        assert led.volume_feasible(t, "node-a")
+        cache.delete_node("node-a")
+        assert not led.volume_feasible(t, "node-a")
+
+    def test_hostname_terms_work_without_label_ingest(self):
+        # the kubelet-set hostname label is synthesized, so a multi-host
+        # hostname In [...] term works even on ledgers that never saw labels
+        led = self._zonal_ledger()
+        from kube_batch_tpu.k8s.translate import pv_from_k8s, pvc_from_k8s
+
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "kubernetes.io/hostname", "operator": "In",
+             "values": ["node-a", "node-b"]}]}]}}
+        led.add_pv(pv_from_k8s(self._pv(aff, name="pv-two-hosts")))
+        led.add_pvc(pvc_from_k8s({
+            "metadata": {"name": "dual", "namespace": "ml"},
+            "spec": {"storageClassName": "local-ssd"},
+            "status": {"phase": "Pending"},
+        }))
+        t = self._task("ml/dual-consumer", ["dual"])
+        # pin fast path covers node-a (first value); terms cover node-b too
+        assert led.volume_feasible(t, "node-a")
+        assert led.volume_feasible(t, "node-b")
+        assert not led.volume_feasible(t, "node-c")
+
+
+class TestNodeSelectorTermsMatch:
+    """Shared evaluator semantics (api/pod.py): OR across terms, AND within,
+    Gt/Lt numeric, unknown operators fail closed."""
+
+    def test_or_across_terms_and_within(self):
+        from kube_batch_tpu.api.pod import node_selector_terms_match
+
+        terms = (
+            (("zone", "In", ("a",)), ("disk", "In", ("ssd",))),
+            (("region", "In", ("r1",)),),
+        )
+        assert node_selector_terms_match(terms, {"zone": "a", "disk": "ssd"})
+        assert node_selector_terms_match(terms, {"region": "r1"})
+        assert not node_selector_terms_match(terms, {"zone": "a", "disk": "hdd"})
+
+    def test_exists_notin_gt_lt(self):
+        from kube_batch_tpu.api.pod import node_selector_terms_match
+
+        assert node_selector_terms_match(
+            ((("gpu", "Exists", ()),),), {"gpu": "1"})
+        assert not node_selector_terms_match(
+            ((("gpu", "DoesNotExist", ()),),), {"gpu": "1"})
+        assert node_selector_terms_match(
+            ((("slots", "Gt", ("4",)),),), {"slots": "8"})
+        assert not node_selector_terms_match(
+            ((("slots", "Lt", ("4",)),),), {"slots": "8"})
+
+    def test_unknown_operator_fails_closed(self):
+        from kube_batch_tpu.api.pod import node_selector_terms_match
+
+        assert not node_selector_terms_match(
+            ((("zone", "Near", ("a",)),),), {"zone": "a"})
+
+
+class TestPvAffinityReviewRegressions:
+    """Two fail-open holes caught in review of the topology-affinity change:
+    a hostname pin AND'd with further requirements must not bypass term
+    evaluation, and unlabeled nodes must not satisfy negative operators."""
+
+    def test_pin_with_anded_zone_requirement_does_not_fail_open(self):
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.k8s.translate import (
+            PV_NODE_RESTRICTED_UNKNOWN, pv_from_k8s, pvc_from_k8s)
+
+        # ONE term: hostname In [n1] AND zone In [z1] — conditional pin
+        aff = {"required": {"nodeSelectorTerms": [{"matchExpressions": [
+            {"key": "kubernetes.io/hostname", "operator": "In",
+             "values": ["n1"]},
+            {"key": "topology.kubernetes.io/zone", "operator": "In",
+             "values": ["z1"]}]}]}}
+        pv = pv_from_k8s({"apiVersion": "v1", "kind": "PersistentVolume",
+                          "metadata": {"name": "pv-cond"},
+                          "spec": {"storageClassName": "local-ssd",
+                                   "nodeAffinity": aff}})
+        # the pin fast path must NOT claim n1 unconditionally
+        assert pv.node == PV_NODE_RESTRICTED_UNKNOWN
+        led = K8sPVLedger()
+        led.add_storage_class("local-ssd", "kubernetes.io/no-provisioner")
+        led.add_pv(pv)
+        led.add_pvc(pvc_from_k8s({
+            "metadata": {"name": "c", "namespace": "ml"},
+            "spec": {"storageClassName": "local-ssd"},
+            "status": {"phase": "Pending"},
+        }))
+        t = TestPvTopologyAffinity._task("ml/x", ["c"])
+        # n1 in the WRONG zone: both requirements are AND'd, so infeasible
+        led.set_node_labels("n1", {"topology.kubernetes.io/zone": "z2"})
+        assert not led.volume_feasible(t, "n1")
+        # n1 in the right zone: feasible
+        led.set_node_labels("n1", {"topology.kubernetes.io/zone": "z1"})
+        assert led.volume_feasible(t, "n1")
+
+    def test_negative_operator_on_unlabeled_node_fails_closed(self):
+        from kube_batch_tpu.api.pod import PersistentVolume
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.k8s.translate import (
+            PV_NODE_RESTRICTED_UNKNOWN, pvc_from_k8s)
+
+        led = K8sPVLedger()
+        led.add_storage_class("local-ssd", "kubernetes.io/no-provisioner")
+        led.add_pv(PersistentVolume(
+            name="pv-neg", storage_class="local-ssd",
+            node=PV_NODE_RESTRICTED_UNKNOWN,
+            node_terms=((("topology.kubernetes.io/zone", "NotIn", ("z1",)),),),
+        ))
+        led.add_pvc(pvc_from_k8s({
+            "metadata": {"name": "c", "namespace": "ml"},
+            "spec": {"storageClassName": "local-ssd"},
+            "status": {"phase": "Pending"},
+        }))
+        t = TestPvTopologyAffinity._task("ml/x", ["c"])
+        # ledger never saw labels for this node: NotIn must NOT match the
+        # absent key (the node may well be IN z1) — fail closed
+        assert not led.volume_feasible(t, "mystery-node")
+        # with labels ingested the genuine semantics apply
+        led.set_node_labels("n-out", {"topology.kubernetes.io/zone": "z2"})
+        assert led.volume_feasible(t, "n-out")
+        led.set_node_labels("n-in", {"topology.kubernetes.io/zone": "z1"})
+        assert not led.volume_feasible(t, "n-in")
