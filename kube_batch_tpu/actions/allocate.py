@@ -198,10 +198,15 @@ class AllocateAction(Action):
         )
         # the fit-error histogram is a SEPARATE lazy dispatch: only cycles
         # with unplaced pending tasks pay its [T, N] predicate re-walk
-        # (allocate.go:151-155 builds FitErrors only for failing tasks);
-        # timed under its own key so failure cycles don't read as a
-        # replay-phase regression in the bench breakdown
+        # (allocate.go:151-155 builds FitErrors only for failing tasks).
+        # It is DISPATCHED here but read back only after the host replay:
+        # jax dispatch is async, so the device grinds the histogram while
+        # the host replays the assignment — the solve/replay overlap that
+        # extends the async-binder seam one stage earlier into the cycle.
+        # Timed under its own key (dispatch + post-replay readback) so
+        # failure cycles don't read as a replay-phase regression.
         t_fit0 = telemetry.perf_counter()
+        fail_hist_dev = None
         if bool(np.any(pending & (assigned < 0))):
             if self.last_solve_mode == "sharded":
                 from kube_batch_tpu.parallel.mesh import (
@@ -209,26 +214,32 @@ class AllocateAction(Action):
                 )
 
                 mesh = _dm()
-                fail_hist = np.asarray(sharded_failure_histogram(
+                fail_hist_dev = sharded_failure_histogram(
                     resident_snap(cols, snap, mesh), mesh
-                ))
+                )
             else:
                 from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
-                fail_hist = np.asarray(failure_histogram_solve(
+                fail_hist_dev = failure_histogram_solve(
                     resident_snap(cols, snap)
-                ))
-            self._record_fit_errors(
-                ssn, meta, fail_hist, assigned, task_job, pending
-            )
+                )
         t_fit1 = telemetry.perf_counter()
         self._replay(ssn, snap, meta, assigned, pipelined, task_job)
         t3 = telemetry.perf_counter()
+        if fail_hist_dev is not None:
+            # blocks only on whatever the device hasn't finished during the
+            # replay; fit-error recording touches job diagnostic dicts the
+            # replay never reads, so the reordering is invisible to it
+            self._record_fit_errors(
+                ssn, meta, np.asarray(fail_hist_dev), assigned, task_job,
+                pending,
+            )
+        t4 = telemetry.perf_counter()
         # update, not replace: _replay already folded its replay_* sub-phases in
         self.last_phase_ms.update(
             snapshot_build=(t1 - t0) * 1e3,
             solve=(t2 - t1) * 1e3,
-            fit_errors=(t_fit1 - t_fit0) * 1e3,
+            fit_errors=((t_fit1 - t_fit0) + (t4 - t3)) * 1e3,
             replay=(t3 - t_fit1) * 1e3,
         )
         if self._n_applied:
@@ -236,7 +247,7 @@ class AllocateAction(Action):
             # (bulk-committed + statement-committed), so the histogram count
             # matches real placements (metrics.go:66-72 analog)
             metrics.observe_task_latencies(
-                (t3 - t0) * 1e6 / self._n_applied, self._n_applied
+                (t4 - t0) * 1e6 / self._n_applied, self._n_applied
             )
 
     # ------------------------------------------------------------------
@@ -531,6 +542,7 @@ class AllocateAction(Action):
                             job.nodes_fit_delta[name] = (
                                 t.init_resreq.fit_delta(pnode.idle)
                             )
+                            ssn.note_fit_state(job)
                         pipe_tasks.append(t)
                         slot[1].append(t)
                     else:
@@ -557,6 +569,7 @@ class AllocateAction(Action):
                         job.nodes_fit_delta[t.node_name] = (
                             t.init_resreq.fit_delta(pnode.idle)
                         )
+                        ssn.note_fit_state(job)
                     pipe_tasks.append(t)
                     slot[1].append(t)
                 else:
@@ -662,6 +675,7 @@ class AllocateAction(Action):
                         job.nodes_fit_delta[node_name] = (
                             task.init_resreq.fit_delta(node.idle)
                         )
+                        ssn.note_fit_state(job)
                     stmt.pipeline(task, node_name)
                 else:
                     # raises FitFailure before mutating when a volume claim
@@ -718,6 +732,7 @@ class AllocateAction(Action):
             fe = FitErrors()
             fe.set_histogram(counts, n_nodes)
             job.nodes_fit_errors[task.uid] = fe
+            ssn.note_fit_state(job)
 
     def _port_rows(self, cols) -> Dict[int, list]:
         """Lazily built per-execute: port → [task rows] of EVERY ported task
@@ -828,6 +843,7 @@ class AllocateAction(Action):
                 node = ssn.nodes.get(name)
                 if job is not None and node is not None:
                     job.nodes_fit_delta[name] = task.init_resreq.fit_delta(node.idle)
+                    ssn.note_fit_state(job)
                 stmt.pipeline(task, name)
         except FitFailure as e:
             logger.info("columns host placement %s→%s failed: %s",
@@ -878,6 +894,7 @@ class AllocateAction(Action):
                     job.nodes_fit_delta[best.name] = (
                         task.init_resreq.fit_delta(best.idle)
                     )
+                    ssn.note_fit_state(job)
                 stmt.pipeline(task, best.name)
         except FitFailure as e:
             # e.g. a same-cycle reservation raced the feasibility probe;

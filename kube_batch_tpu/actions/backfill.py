@@ -56,6 +56,7 @@ class BackfillAction(Action):
                     break
                 else:
                     job.nodes_fit_errors[task.uid] = fit_errors
+                    ssn.note_fit_state(job)
 
     # ---- beyond-reference: stranded-capacity real-request pass ----------
     def _real_requests(self, ssn) -> None:

@@ -19,6 +19,17 @@ class EnqueueAction(Action):
     name = "enqueue"
 
     def execute(self, ssn) -> None:
+        cols = ssn.columns
+
+        def promote(job):
+            """Pending → Inqueue, mirrored into the j_sched column: the
+            device snapshot's schedulability row is synced at session open
+            (delta across cycles), so a mid-cycle phase flip must write
+            through or this cycle's allocate would still skip the job."""
+            job.pod_group.phase = PodGroupPhase.INQUEUE
+            if cols is not None and job._cols is cols and job._row >= 0:
+                cols.j_sched[job._row] = True
+
         queues = PriorityQueue(less=ssn.queue_order_fn)
         queue_set = set()
         jobs_map = {}
@@ -34,7 +45,7 @@ class EnqueueAction(Action):
                 # they skip the priority-queue machinery entirely — at 12.5k
                 # Pending podgroups the tiered order comparisons alone were
                 # ~0.8s of host time
-                job.pod_group.phase = PodGroupPhase.INQUEUE
+                promote(job)
                 continue
             any_min_res = True
             queue = ssn.queues[job.queue]
@@ -69,6 +80,6 @@ class EnqueueAction(Action):
                 if name in ssn.spec:
                     min_req.vec[ssn.spec.index(name)] = float(v)
             if ssn.job_enqueueable(job) and min_req.less_equal(idle):
-                job.pod_group.phase = PodGroupPhase.INQUEUE
+                promote(job)
                 idle.sub_(min_req)
             queues.push(queue)

@@ -11,7 +11,11 @@ the lock-acquisition-order graph:
   A — a deadlock waiting for the right interleaving. Locks are grouped by
   CREATION SITE (module:line), the analog of lockdep's lock classes, so an
   inversion between any two instances of the same site pair is caught even
-  when the individual test never deadlocks.
+  when the individual test never deadlocks.  Detection is TRANSITIVE over
+  the recorded acquisition graph: a new edge A→B is a violation whenever a
+  path B→…→A already exists, so the 3-lock cycle A→B→C→A (no direct
+  two-lock inversion anywhere) reports the moment its closing edge lands,
+  with the full chain and each edge's first-observed stack.
 - **Blocking under lock**: `time.sleep` / `Future.result` / `Event.wait`
   reached while the thread holds any tracked lock (the TokenBucket bug, as
   a runtime check).
@@ -23,11 +27,10 @@ metrics/) — stdlib and third-party locks are untouched. The pytest plugin
 (`kube_batch_tpu.analysis.pytest_plugin`) installs this for the whole
 suite and fails the run on violations.
 
-Deliberate scope limits (documented, not accidental): same-site nesting
+Deliberate scope limit (documented, not accidental): same-site nesting
 (two instances of one lock class) is skipped — the cache's per-object
-locks nest legitimately and we have no nesting annotations; and the graph
-records direct edges only, so a 3-cycle with no 2-cycle is missed. Both
-trade recall for zero false positives on the known-good suite.
+locks nest legitimately and we have no nesting annotations. It trades
+recall for zero false positives on the known-good suite.
 """
 
 from __future__ import annotations
@@ -86,8 +89,26 @@ class LockdepState:
         self._mu = _REAL_LOCK()
         # (site_a, site_b) -> stack where a->b was first observed
         self.edges: Dict[Tuple[str, str], str] = {}
+        # site -> successor sites (the same graph as `edges`, shaped for
+        # the transitive-cycle search)
+        self._adj: Dict[str, set] = {}
         self.violations: List[Violation] = []
         self._local = threading.local()
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """A site path src → … → dst over the recorded acquisition edges
+        (iterative DFS; the class graph is tiny), or None."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._adj.get(node, ()):
+                if nxt == dst:
+                    return path + [dst]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
 
     # -- held-set helpers --------------------------------------------------
     def _held(self) -> List[list]:
@@ -122,19 +143,47 @@ class LockdepState:
             inversions = []
             with self._mu:
                 for edge in candidates:
-                    back = (edge[1], edge[0])
-                    if back in self.edges and edge not in self.edges:
-                        inversions.append((edge, self.edges[back]))
-                    self.edges.setdefault(edge, stack)
-                for (a, b), first_stack in inversions:
-                    self.violations.append(Violation(
-                        "order-inversion",
-                        f"lock order inverted: this thread acquired "
-                        f"{a} then {b}, but {b} -> {a} was previously "
-                        f"observed",
-                        f"--- {a} -> {b} acquired at:\n{stack}"
-                        f"--- {b} -> {a} first observed at:\n{first_stack}",
-                    ))
+                    a, b = edge
+                    if edge in self.edges:
+                        continue  # raced in since the unlocked probe
+                    # a NEW a->b edge closes a deadlock cycle iff a path
+                    # b ->* a already exists — length 1 is the direct
+                    # inversion, longer is the transitive A→B→C→A case
+                    cycle = self._path(b, a)
+                    self.edges[edge] = stack
+                    self._adj.setdefault(a, set()).add(b)
+                    if cycle is not None:
+                        inversions.append((edge, cycle))
+                for (a, b), cycle in inversions:
+                    if len(cycle) == 2:
+                        desc = (
+                            f"lock order inverted: this thread acquired "
+                            f"{a} then {b}, but {b} -> {a} was previously "
+                            f"observed"
+                        )
+                        detail = (
+                            f"--- {a} -> {b} acquired at:\n{stack}"
+                            f"--- {b} -> {a} first observed at:\n"
+                            f"{self.edges[(b, a)]}"
+                        )
+                    else:
+                        chain = " -> ".join(cycle)
+                        desc = (
+                            f"lock order inverted (transitive): this thread "
+                            f"acquired {a} then {b}, closing the cycle "
+                            f"{a} -> {b} against the previously observed "
+                            f"chain {chain}"
+                        )
+                        parts = [f"--- {a} -> {b} acquired at:\n{stack}"]
+                        parts.extend(
+                            f"--- {x} -> {y} first observed at:\n"
+                            f"{self.edges[(x, y)]}"
+                            for x, y in zip(cycle, cycle[1:])
+                        )
+                        detail = "".join(parts)
+                    self.violations.append(
+                        Violation("order-inversion", desc, detail)
+                    )
         held.append([site, lock_id, 1])
 
     def on_released(self, lock_id: int) -> None:

@@ -69,10 +69,17 @@ VALID_STATUSES = READY_STATUSES + (
 
 
 def resident_snap(cols, snap, mesh=None):
-    """The call-site shape for the device-resident feature cache: swap in
+    """The call-site shape for the device-resident snapshot cache: swap in
     cached device arrays when a ColumnStore backs the session, pass the
-    snapshot through untouched otherwise."""
-    return cols.resident_features(snap, mesh=mesh) if cols is not None else snap
+    snapshot through untouched otherwise.  Static ingest features ride the
+    version-keyed cache (resident_features); the per-cycle columns ride the
+    scatter-delta cache (api/resident.py) on single-device dispatches."""
+    if cols is None:
+        return snap
+    snap = cols.resident_features(snap, mesh=mesh)
+    if mesh is None:
+        snap = cols.per_cycle_resident(snap)
+    return snap
 
 
 def _grow(arr: np.ndarray, cap: int) -> np.ndarray:
@@ -203,6 +210,13 @@ class ColumnStore:
         self.task_feature_version = 0
         self.node_feature_version = 0
         self._dev_cache: Dict = {}
+        # per-cycle device-resident cache (api/resident.py): the truly
+        # per-cycle snapshot columns stay alive on device between cycles and
+        # are refreshed by scatter deltas instead of full uploads
+        self._per_cycle_dev = None
+        # which path the most recent session row-sync took ("delta"|"full")
+        # — surfaced in the bench JSON and the sim's longitudinal report
+        self.last_snapshot_path = "full"
 
     # ==================================================================
     # task axis
@@ -379,6 +393,10 @@ class ColumnStore:
             return
         job._cols = None
         job._row = -1
+        # session-row state must not leak onto the row's next tenant (the
+        # delta row-sync only rewrites rows of dirty jobs)
+        self.j_sess[row] = False
+        self.j_sched[row] = False
         # give the job back private buffers (copies of its final state)
         job.allocated.vec = self.j_alloc[row].copy()
         job.total_request.vec = self.j_total[row].copy()
@@ -589,6 +607,85 @@ class ColumnStore:
         self.queues.on_grown(cap)
 
     # ==================================================================
+    # capacity reservation
+    # ==================================================================
+    def reserve(self, n_tasks: int = 0, n_nodes: int = 0, n_jobs: int = 0,
+                n_queues: int = 0) -> None:
+        """Pre-grow axes to cover an expected peak so steady-state count
+        wobble stays inside one shape bucket — the jit cache then hits every
+        cycle (zero retraces after warmup).  Axis capacity never shrinks, so
+        this is a one-way warmup knob."""
+        while self.tasks.cap < n_tasks:
+            self._grow_tasks()
+        while self.nodes.cap < n_nodes:
+            self._grow_nodes()
+        while self.jobs.cap < n_jobs:
+            self._grow_jobs()
+        while self.queues.cap < n_queues:
+            self._grow_queues()
+
+    # ==================================================================
+    # per-session job-row sync (delta or full)
+    # ==================================================================
+    def _sync_job_row(self, job, queue_rows_get) -> None:
+        """Derive one session job's row state (shared by both sync paths —
+        the delta path is bit-exact because it IS this same derivation)."""
+        row = job._row
+        if row < 0 or job._cols is not self:
+            return  # foreign/unbound job (isolated-session object)
+        qi = queue_rows_get(job.queue, -1)
+        if qi < 0:
+            self.j_sess[row] = False
+            return
+        self.j_sess[row] = True
+        self.j_min[row] = job.min_available
+        self.j_queue[row] = qi
+        self.j_prio[row] = job.priority
+        self.j_creation[row] = job.creation_index
+        pg = job.pod_group
+        self.j_sched[row] = pg is None or pg.phase != PodGroupPhase.PENDING
+
+    def sync_session_rows(self, ssn, dirty_uids=None, restore_rows=()) -> None:
+        """Fill the session-scoped job-row arrays (j_sess membership, j_min,
+        j_queue, j_prio, j_creation, j_sched) for an exclusive session.
+
+        ``dirty_uids=None`` is the full rescan (one Python pass over every
+        session job — the previous per-cycle cost).  A set re-derives ONLY
+        those uids against the live objects: rows of jobs that left the
+        session clear, dirty members re-fill, everything else keeps last
+        cycle's values — which are still exact because every input
+        (membership, min_available, queue row, priority, creation, phase)
+        moves only through choke points that stamp the dirty set.
+        ``restore_rows`` re-admits rows the previous gate dropped; this
+        cycle's gate re-votes on them immediately after."""
+        queue_rows_get = self.queue_rows.get
+        if dirty_uids is None:
+            self.last_snapshot_path = "full"
+            self.j_sess[:] = False
+            self.j_sched[:] = False
+            for job in ssn.jobs.values():
+                self._sync_job_row(job, queue_rows_get)
+            return
+        self.last_snapshot_path = "delta"
+        jobs_get = ssn.jobs.get
+        job_by_row = self.job_by_row
+        for row in restore_rows:
+            job = job_by_row[row]
+            if job is not None and jobs_get(job.uid) is job:
+                self.j_sess[row] = True
+        cache_jobs_get = ssn.cache.jobs.get
+        for uid in dirty_uids:
+            job = jobs_get(uid)
+            if job is None:
+                # left the session (deleted, or membership lost): clear the
+                # row it may still hold on the authoritative cache object
+                job = cache_jobs_get(uid)
+                if job is not None and job._cols is self and job._row >= 0:
+                    self.j_sess[job._row] = False
+                continue
+            self._sync_job_row(job, queue_rows_get)
+
+    # ==================================================================
     # per-cycle device snapshot
     # ==================================================================
     def schedulable_pending_mask(self) -> np.ndarray:
@@ -663,6 +760,22 @@ class ColumnStore:
     def bump_node_features(self) -> None:
         self.node_feature_version += 1
 
+    def per_cycle_resident(self, snap):
+        """Swap the per-cycle snapshot columns for their device-resident
+        copies, refreshed by scatter deltas (api/resident.py).  Shares the
+        KB_DEVICE_CACHE kill switch with the static feature cache."""
+        import os
+
+        if os.environ.get("KB_DEVICE_CACHE", "").strip().lower() in (
+            "0", "false", "off", "no"
+        ):
+            return snap
+        if self._per_cycle_dev is None:
+            from kube_batch_tpu.api.resident import PerCycleDeviceCache
+
+            self._per_cycle_dev = PerCycleDeviceCache()
+        return self._per_cycle_dev.swap(snap)
+
     def resident_features(self, snap, mesh=None):
         """`snap` with the ingest-static feature arrays swapped for cached
         DEVICE-RESIDENT copies, re-uploaded only when the column's axis
@@ -712,8 +825,9 @@ class ColumnStore:
         session straight from the columns.  Row space == device axis: the
         assignment vector indexes task rows; node/job indices are rows.
 
-        Per-cycle work: one Python scan over the session's jobs (metadata the
-        object model owns — min_available, queue, priority, phase gate), the
+        Per-cycle work: the session job-row sync (already done by
+        open_session for exclusive sessions — delta when churn allows; the
+        full rescan runs here only for sessions that skipped it), the
         sparse affinity/preference rows, a few [cap, R] float32 casts, and
         vectorized derived masks.  Everything else is already columnar.
         """
@@ -722,27 +836,15 @@ class ColumnStore:
         capT, capN = self.tasks.cap, self.nodes.cap
         capJ, capQ = self.jobs.cap, self.queues.cap
 
-        # ---- job scan (session membership + object-owned metadata) ------
+        # ---- job rows (session membership + object-owned metadata) ------
+        # open_session syncs these (delta against the previous cycle when
+        # churn is low) and marks the session; direct callers — tests, the
+        # backfill real-request pass on hand-built sessions — get the full
+        # rescan here
+        if not getattr(ssn, "rows_synced", False):
+            self.sync_session_rows(ssn)
         j_min, j_queue, j_prio = self.j_min, self.j_queue, self.j_prio
         j_creation, j_sess, j_sched = self.j_creation, self.j_sess, self.j_sched
-        j_sess[:] = False
-        j_sched[:] = False
-        queue_rows_get = self.queue_rows.get
-        PENDING_PHASE = PodGroupPhase.PENDING
-        for job in ssn.jobs.values():
-            row = job._row
-            if row < 0 or job._cols is not self:
-                continue  # foreign/unbound job (isolated-session object)
-            qi = queue_rows_get(job.queue, -1)
-            if qi < 0:
-                continue
-            j_sess[row] = True
-            j_min[row] = job.min_available
-            j_queue[row] = qi
-            j_prio[row] = job.priority
-            j_creation[row] = job.creation_index
-            pg = job.pod_group
-            j_sched[row] = pg is None or pg.phase != PENDING_PHASE
 
         counts = self.j_counts
         job_ready = counts[:, READY_STATUSES].sum(axis=1, dtype=np.int32)

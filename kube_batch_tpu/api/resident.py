@@ -1,0 +1,160 @@
+"""Per-cycle device-resident snapshot columns with scatter-delta refresh.
+
+``ColumnStore.resident_features`` already keeps the ingest-static columns
+(task requests/bitsets, node allocatable) alive on device across cycles.
+This module extends residency to the *per-cycle* columns — statuses, node
+ledgers, job/queue rows — which until now were re-uploaded wholesale by
+every solve dispatch even when a steady-state cycle changed a few hundred
+rows out of 50k.
+
+Mechanism: for each cached field the host keeps a mirror of what the device
+holds.  Each cycle the freshly built host column is diffed against the
+mirror (one vectorized compare — cheaper than the upload it replaces):
+
+- no rows changed  → the cached device array is handed to the solve as-is;
+- a small delta    → the (rows, values) pair is padded to a FIXED slot
+  count and applied on device as one scatter (``.at[rows].set(mode="drop")``
+  with out-of-range padding indices), with the stale device buffer DONATED
+  to the update so XLA writes in place instead of allocating;
+- a large delta or a shape change (axis growth) → full re-upload.
+
+The fixed slot width keeps the scatter's jit cache to one specialization
+per (field shape, dtype): steady-state cycles compile nothing (the
+bench's retrace counters prove it).  Values are bit-identical to a full
+upload by construction — the scatter writes exactly the host rows — and
+tests/test_snapshot_delta.py checks the round-trip.
+
+Donation is skipped on the CPU backend (unsupported there; jax would warn
+every cycle).  The mesh-sharded solve path keeps full uploads — sharded
+scatter residency is a follow-on (ROADMAP).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from kube_batch_tpu.utils import jitstats
+
+# snapshot fields refreshed per cycle (everything the static feature cache
+# does not own, minus the variable-K sparse affinity rows)
+PER_CYCLE_FIELDS: Tuple[str, ...] = (
+    "task_status", "task_node", "task_valid", "task_pending",
+    "task_best_effort",
+    "node_idle", "node_releasing", "node_used", "node_valid", "node_sched",
+    "job_min_avail", "job_ready", "job_queue", "job_prio", "job_creation",
+    "job_valid", "job_schedulable", "job_allocated",
+    "queue_weight", "queue_capability", "queue_alloc", "queue_request",
+    "queue_valid",
+    "total",
+)
+
+#: fixed scatter width — one compiled scatter per (field shape, dtype);
+#: deltas wider than this take the full-upload path (at which point the
+#: transfer is no longer the bottleneck anyway)
+SCATTER_SLOTS = 4096
+
+
+_SCATTER = None
+
+
+def _scatter_fn():
+    """The shared jitted scatter — ONE module-level function so every cache
+    instance (simulator multi-scheduler runs, bench pairs, the test suite)
+    reuses the same compiled specializations and jitstats tracks a single
+    entry instead of retaining one wrapper per dead instance."""
+    global _SCATTER
+    if _SCATTER is None:
+        import jax
+
+        def scatter(dev, rows, vals):
+            return dev.at[rows].set(vals, mode="drop")
+
+        # donate the stale device buffer on real accelerators so the
+        # update writes in place; CPU ignores donation (and warns), so
+        # skip it there
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        _SCATTER = jitstats.register(
+            "resident_scatter", jax.jit(scatter, donate_argnums=donate)
+        )
+    return _SCATTER
+
+
+class PerCycleDeviceCache:
+    def __init__(self) -> None:
+        self._mirror: Dict[str, np.ndarray] = {}
+        self._dev: Dict[str, object] = {}
+        # last (input snap, swapped result): the failure-histogram dispatch
+        # re-swaps the SAME snap the solve dispatch just synced — a
+        # guaranteed all-clean diff over every field, skipped by identity
+        self._last_in = None
+        self._last_out = None
+        # diagnostics for the bench / tests
+        self.full_uploads = 0
+        self.scatter_updates = 0
+        self.clean_hits = 0
+
+    def _refresh(self, field: str, host: np.ndarray):
+        import jax
+
+        mirror = self._mirror.get(field)
+        if (
+            mirror is None
+            or mirror.shape != host.shape
+            or mirror.dtype != host.dtype
+        ):
+            self.full_uploads += 1
+            dev = jax.device_put(host)
+            # pre-warm the scatter specialization for this (shape, dtype)
+            # NOW — an all-out-of-range index vector writes nothing, so the
+            # values are untouched, but the first real delta in a later
+            # steady-state cycle becomes a cache hit instead of a retrace
+            rows = np.full(SCATTER_SLOTS, host.shape[0], np.int32)
+            vals = np.zeros((SCATTER_SLOTS,) + host.shape[1:], host.dtype)
+            dev = _scatter_fn()(dev, rows, vals)
+            self._mirror[field] = host.copy()
+            self._dev[field] = dev
+            return dev
+        if host.ndim == 1:
+            changed = np.flatnonzero(mirror != host)
+        else:
+            changed = np.flatnonzero(np.any(mirror != host, axis=1))
+        if changed.size == 0:
+            self.clean_hits += 1
+            return self._dev[field]
+        if changed.size > SCATTER_SLOTS:
+            self.full_uploads += 1
+            dev = jax.device_put(host)
+            self._mirror[field] = host.copy()
+            self._dev[field] = dev
+            return dev
+        n = host.shape[0]
+        # pad with an out-of-range row index — mode="drop" discards the
+        # padding writes, so the scatter shape never depends on delta size
+        rows = np.full(SCATTER_SLOTS, n, np.int32)
+        rows[: changed.size] = changed
+        vals = np.zeros((SCATTER_SLOTS,) + host.shape[1:], host.dtype)
+        vals[: changed.size] = host[changed]
+        dev = _scatter_fn()(self._dev[field], rows, vals)
+        mirror[changed] = host[changed]
+        self._dev[field] = dev
+        self.scatter_updates += 1
+        return dev
+
+    def swap(self, snap):
+        """`snap` with every per-cycle field replaced by its device-resident
+        copy (refreshed by delta).  The caller keeps using the ORIGINAL
+        host-backed snap for numpy reads — only the returned copy feeds the
+        solve, mirroring the resident_features contract.  A repeat call
+        with the identical snap object (the same cycle's second dispatch)
+        returns the memoized result without re-diffing."""
+        if snap is self._last_in:
+            return self._last_out
+        updates = {
+            field: self._refresh(field, np.asarray(getattr(snap, field)))
+            for field in PER_CYCLE_FIELDS
+        }
+        out = snap._replace(**updates)
+        self._last_in, self._last_out = snap, out
+        return out

@@ -143,6 +143,31 @@ class SchedulerCache:
         from kube_batch_tpu.api.columns import ColumnStore
 
         self.columns = ColumnStore(spec)
+        # cross-cycle churn bookkeeping (cache/dirty.py): ingest handlers
+        # stamp a monotonic version + per-kind dirty sets so a low-churn
+        # session open can hand out a delta against the previous cycle's
+        # open state instead of re-deriving every per-job structure
+        from kube_batch_tpu.cache.dirty import DirtyTracker, OpenCache
+
+        self.dirty = DirtyTracker()
+        self.open_cache = OpenCache()
+        # jobs carrying per-session fit diagnostics (nodes_fit_delta/
+        # nodes_fit_errors/job_fit_errors) — the delta open clears exactly
+        # these instead of probing all 12.5k jobs (Session.note_fit_state)
+        self.fit_state_jobs: set = set()
+        import os as _os
+
+        self.delta_enabled = _os.environ.get(
+            "KB_SNAPSHOT_DELTA", "1"
+        ).strip().lower() not in ("0", "false", "off", "no")
+        # fraction of session jobs dirty above which the open falls back to
+        # the full rebuild (delta bookkeeping would cost more than it saves)
+        self.delta_churn_threshold = float(
+            _os.environ.get("KB_DELTA_CHURN_THRESHOLD", "0.25")
+        )
+        # diagnostics: which path the most recent open took, and its churn
+        self.last_open_path = "full"
+        self.last_churn = 0.0
         # --priority-class toggle (options.go:30, consumed cache.go:352,378)
         self.resolve_priority = resolve_priority
         self.binder = binder if binder is not None else FakeBinder()
@@ -342,6 +367,8 @@ class SchedulerCache:
 
     def _add_task(self, task: TaskInfo, pod: Pod) -> None:
         job = self._get_or_create_job(task, pod)
+        self.dirty.note_pod(task._key)
+        self.dirty.note_job(job.uid)
         job.add_task(task)
         self.columns.bind_task(task, job)
         if task.node_name:
@@ -388,6 +415,8 @@ class SchedulerCache:
     def _delete_pod_locked(self, pod: Pod, retire_placeholder: bool = True) -> None:
         self.pods.pop(pod.key(), None)
         self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
+        self.dirty.note_pod(pod.key())
+        self.dirty.note_job(job_id_for_pod(pod))
         release = getattr(self.volume_binder, "release_task", None)
         if release is not None:
             release(pod.uid)  # free assumed-but-unbound PV reservations
@@ -418,6 +447,7 @@ class SchedulerCache:
             and job.pdb is None
         ):
             if self.jobs.pop(job.uid, None) is not None:
+                self.dirty.note_job(job.uid)
                 self.columns.free_job(job)
                 from kube_batch_tpu import metrics
 
@@ -431,6 +461,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.add_node, node):
                 return
+            self.dirty.note_node(node.name)
             existing = self.nodes.get(node.name)
             if existing is None:
                 info = NodeInfo(node, self.spec)
@@ -454,6 +485,7 @@ class SchedulerCache:
             node = self.nodes.get(name)
             if node is None:
                 return
+            self.dirty.note_node(name)
             # a gone node can't attach volumes: drop its labels so ledger
             # reachability fails closed for it immediately
             forget = getattr(self.volume_binder, "forget_node_labels", None)
@@ -481,6 +513,7 @@ class SchedulerCache:
             if not pg.queue:
                 pg.queue = self.default_queue  # default fill
             job_id = pg.key()
+            self.dirty.note_job(job_id)
             job = self.jobs.get(job_id)
             if job is None:
                 job = JobInfo(job_id, self.spec)
@@ -495,6 +528,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.delete_pod_group, key):
                 return
+            self.dirty.note_job(key)
             job = self.jobs.get(key)
             if job is not None:
                 job.pod_group = None
@@ -520,6 +554,7 @@ class SchedulerCache:
             if self._gate(self.add_pdb, pdb):
                 return
             job_id = f"{pdb.namespace}/{pdb.owner}"
+            self.dirty.note_job(job_id)
             job = self.jobs.get(job_id)
             if job is None:
                 job = JobInfo(job_id, self.spec)
@@ -546,6 +581,7 @@ class SchedulerCache:
             job = self.jobs.get(f"{pdb.namespace}/{pdb.owner}")
             if job is None:
                 return
+            self.dirty.note_job(job.uid)
             job.unset_pdb()
             if job.tasks and job.pod_group is None:
                 # re-synthesize the shadow PodGroup the PDB displaced so the
@@ -570,6 +606,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.add_queue, queue):
                 return
+            self.dirty.mark_queues()
             qinfo = QueueInfo(queue)
             self.queues[queue.name] = qinfo
             self.columns.bind_queue(qinfo)
@@ -581,6 +618,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.delete_queue, name):
                 return
+            self.dirty.mark_queues()
             self.queues.pop(name, None)
             # a recreated queue must get a fresh status write even when its
             # first counts happen to equal the deleted one's last record
@@ -593,6 +631,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.add_priority_class, pc):
                 return
+            self.dirty.mark_priority_classes()
             self.priority_classes[pc.name] = pc
             if pc.global_default:
                 self.default_priority = pc.value
@@ -601,6 +640,7 @@ class SchedulerCache:
         with self._lock:
             if self._gate(self.delete_priority_class, name):
                 return
+            self.dirty.mark_priority_classes()
             pc = self.priority_classes.pop(name, None)
             if pc is not None and pc.global_default:
                 self.default_priority = 0
@@ -870,6 +910,10 @@ class SchedulerCache:
         analog); in-flight unacked binds rebuild as Pending and re-place
         next cycle."""
         with self._lock:
+            # everything below mutates task/job state wholesale — the next
+            # open must not trust any cross-cycle delta state
+            self.dirty.mark_full()
+            self.open_cache.invalidate()
             spec = self.spec
             for job in self.jobs.values():
                 for task in job.tasks.values():
@@ -1015,6 +1059,9 @@ class SchedulerCache:
                     own_pg.running = pg.running
                     own_pg.failed = pg.failed
                     own_pg.succeeded = pg.succeeded
+                # the authoritative PodGroup changed: the next delta open
+                # must re-read this job's status/schedulability
+                self.dirty.note_job(job.uid)
         if write:
             self.status_updater.update_pod_group(pg)
         # events accompany every status pass, rate-limited or not, once per
@@ -1044,10 +1091,16 @@ class SchedulerCache:
             now = _time.monotonic()
             next_write = self._status_next_write
             jitter = np.random.uniform(60.0, 90.0, size=len(updates)).tolist()
+            note_job = self.dirty.note_job
             for i, (job, changed, need_record) in enumerate(updates):
                 pg = job.pod_group
                 if pg is None or self.jobs.get(job.uid) is None:
                     continue  # deleted mid-cycle: no write, no events
+                if changed:
+                    # phase/counts moved this cycle (exclusive close mutates
+                    # the authoritative PodGroup in place) — the next delta
+                    # open re-reads exactly these jobs' open-state
+                    note_job(job.uid)
                 if need_record:
                     to_record.append(job)
                 if not changed and now < next_write.get(job.uid, 0.0):
@@ -1152,6 +1205,74 @@ class SchedulerCache:
                 clone.priority = self._resolve_job_priority(job)
                 ci.jobs[uid] = clone
             return ci
+
+    def take_dirty(self):
+        """Consume the accumulated ingest churn (one exclusive open's input).
+        Taken under the lock so it races nothing; during the session the
+        ingest gate defers mutations, so no marks land mid-cycle except the
+        cache's own status writebacks at close."""
+        with self._lock:
+            return self.dirty.take()
+
+    def session_view_delta(self, delta) -> ClusterInfo:
+        """session_view() by delta: refresh only the dirty jobs in the
+        persistent open cache (cache/dirty.py), then hand the session
+        shallow copies.  End state is bit-exact with session_view() — the
+        same membership filter and priority resolution run, just only for
+        jobs whose inputs could have moved since the last open."""
+        oc = self.open_cache
+        with self._lock:
+            ci = ClusterInfo(self.spec)
+            ci.nodes = {
+                name: n for name, n in self.nodes.items() if n.ready
+            }
+            ci.queues = dict(self.queues)
+            jobs = oc.jobs
+            pg_status = oc.pg_status
+            queues = self.queues
+            pcs_get = self.priority_classes.get
+            default_prio = self.default_priority
+            for uid in delta.jobs:
+                job = self.jobs.get(uid)
+                member = (
+                    job is not None
+                    and (job.pod_group is not None or job.pdb is not None)
+                )
+                if member and job.queue not in queues:
+                    logger.warning(
+                        "job %s queue %s not found, skipped", uid, job.queue
+                    )
+                    member = False
+                if not member:
+                    jobs.pop(uid, None)
+                    pg_status.pop(uid, None)
+                    continue
+                pg = job.pod_group
+                pc = (
+                    pcs_get(pg.priority_class)
+                    if pg is not None and pg.priority_class else None
+                )
+                job.priority = pc.value if pc is not None else default_prio
+                jobs[uid] = job
+                if pg is not None:
+                    pg_status[uid] = (pg.phase, pg.running, pg.failed,
+                                      pg.succeeded)
+                else:
+                    pg_status.pop(uid, None)
+            ci.jobs = dict(jobs)
+            return ci
+
+    def rebuild_open_cache(self, cluster: ClusterInfo, pg_status) -> None:
+        """Reseed the cross-cycle open cache after a FULL session open —
+        `cluster.jobs`/`pg_status` are the freshly derived structures the
+        session was just handed."""
+        oc = self.open_cache
+        oc.jobs = dict(cluster.jobs)
+        oc.pg_status = dict(pg_status)
+        oc.gate_dropped_rows = set()
+        oc.valid = True
+        # the full open cleared every session job's fit diagnostics
+        self.fit_state_jobs.clear()
 
     def session_view(self) -> ClusterInfo:
         """The exclusive (no-clone) session's ClusterInfo: the same

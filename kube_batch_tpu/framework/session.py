@@ -96,7 +96,8 @@ class FitFailure(Exception):
 
 class Session:
     def __init__(self, cache, cluster: ClusterInfo, tiers: List[Tier],
-                 exclusive: bool = False):
+                 exclusive: bool = False, open_reuse=None,
+                 dirty_jobs=frozenset()):
         self.uid = str(uuid.uuid4())
         self.cache = cache
         self.spec = cluster.spec
@@ -146,28 +147,45 @@ class Session:
         # live objects in the same pass — a cloned session starts clean
         # because clone() does this (job_info.go:295-329); the no-clone path
         # must, or stale fit errors replay forever (and grow unboundedly).
+        #
+        # `open_reuse` (cache/dirty.py OpenCache) is the delta form of this
+        # pass: the cache maintained the at-open statuses across cycles
+        # (session_view_delta refreshed the dirty jobs), and only jobs known
+        # to carry fit diagnostics — cache.fit_state_jobs, populated by
+        # note_fit_state at every write site — pay the clearing visit.
         self.pod_group_status_at_open: Dict[str, tuple] = {}
-        at_open = self.pod_group_status_at_open
-        for job in self.jobs.values():
-            if exclusive:
+        if exclusive and open_reuse is not None:
+            fit_jobs = cache.fit_state_jobs
+            for uid in (fit_jobs | set(dirty_jobs)) if fit_jobs or dirty_jobs else ():
+                job = self.jobs.get(uid)
+                if job is None:
+                    continue
                 if job.nodes_fit_delta:
                     job.nodes_fit_delta = {}
                 if job.nodes_fit_errors:
                     job.nodes_fit_errors = {}
                 if job.job_fit_errors:
                     job.job_fit_errors = ""
-            pg = job.pod_group
-            if pg is not None:
-                at_open[job.uid] = (pg.phase, pg.running, pg.failed,
-                                    pg.succeeded)
-        # per-session caches shared by the vectorized open gate / plugin
-        # hooks / close status pass (each used to rebuild the same job-row
-        # arrays and cluster-total Resource independently)
-        self._jobs_rows_cache: Optional[tuple] = None
-        # bumped at every session-jobs add/delete (drop_job / direct callers
-        # via note_jobs_mutation) so the rows cache can't alias a stale
-        # array when equal numbers of jobs are added and removed mid-session
-        self._jobs_version = 0
+            fit_jobs.clear()
+            self.pod_group_status_at_open = dict(open_reuse.pg_status)
+        else:
+            at_open = self.pod_group_status_at_open
+            for job in self.jobs.values():
+                if exclusive:
+                    if job.nodes_fit_delta:
+                        job.nodes_fit_delta = {}
+                    if job.nodes_fit_errors:
+                        job.nodes_fit_errors = {}
+                    if job.job_fit_errors:
+                        job.job_fit_errors = ""
+                pg = job.pod_group
+                if pg is not None:
+                    at_open[job.uid] = (pg.phase, pg.running, pg.failed,
+                                        pg.succeeded)
+        # set by open_session once the ColumnStore's session job-row arrays
+        # (j_sess & friends) are synced for this cycle — the gate, the close
+        # status pass, and the device snapshot all read them
+        self.rows_synced = False
         self._total_alloc_cache = None
         # job uids given an Unschedulable=True condition THIS session —
         # saves the close pass a per-job scan over conditions lists
@@ -184,34 +202,31 @@ class Session:
         self.host_discards = 0
 
     def drop_job(self, uid: str) -> None:
-        """Remove a job from the session (open-gate drops) and invalidate
-        the rows cache. Any other path mutating ssn.jobs must call
-        note_jobs_mutation()."""
+        """Remove a job from the session (open-gate drops).  The caller is
+        responsible for clearing the job's j_sess row when the session rows
+        are already synced (open_session's gate does)."""
         del self.jobs[uid]
-        self._jobs_version += 1
 
-    def note_jobs_mutation(self) -> None:
-        self._jobs_version += 1
-
-    def jobs_rows(self):
-        """(jobs_list, rows[int64], min_avail[int32]) over the CURRENT job
-        set, cached for the session — keyed on (len, mutation counter) so
-        equal add+delete churn can't silently reuse stale arrays. Columnar
-        sessions only."""
+    def session_rows(self):
+        """(rows[int], jobs_list) of the CURRENT session job set, straight
+        off the synced j_sess column — the shared basis for the vectorized
+        gate, the gang close sweep, and the columnar close status pass.
+        Columnar sessions only; requires rows_synced."""
         import numpy as np
 
-        key = (len(self.jobs), self._jobs_version)
-        cached = self._jobs_rows_cache
-        if cached is not None and cached[3] == key:
-            return cached[:3]
-        jobs_list = list(self.jobs.values())
-        m = len(jobs_list)
-        rows = np.fromiter((j._row for j in jobs_list), np.int64, count=m)
-        minav = np.fromiter(
-            (j.min_available for j in jobs_list), np.int32, count=m
-        )
-        self._jobs_rows_cache = (jobs_list, rows, minav, key)
-        return self._jobs_rows_cache[:3]
+        cols = self.columns
+        rows = np.flatnonzero(cols.j_sess)
+        job_by_row = cols.job_by_row
+        return rows, [job_by_row[r] for r in rows.tolist()]
+
+    def note_fit_state(self, job: JobInfo) -> None:
+        """Record that `job` now carries per-session fit diagnostics
+        (nodes_fit_delta / nodes_fit_errors / job_fit_errors) — the delta
+        session open clears exactly these jobs instead of probing all of
+        them.  Every write site of those fields must call this."""
+        fit_jobs = getattr(self.cache, "fit_state_jobs", None)
+        if fit_jobs is not None:
+            fit_jobs.add(job.uid)
 
     def total_allocatable(self):
         """Σ allocatable over the session's nodes (the drf/proportion
@@ -625,21 +640,82 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
     the gate provides the same isolation). Session-only state (Pipelined
     placements) is unwound at close. `isolated=True` forces the reference's
     deep-clone behavior — callers that want to inspect a what-if session
-    without touching the cache."""
+    without touching the cache.
+
+    Exclusive opens are INCREMENTAL when churn allows (cache/dirty.py):
+    the per-job open structures — membership view, resolved priorities,
+    at-open PodGroup statuses, fit-state clears, the column job-row arrays —
+    are deltas against the previous cycle keyed on the cache's dirty sets,
+    with the full rebuild as the bit-exact fallback for high churn,
+    queue/priority-class row-space changes, or a cold cache."""
     from kube_batch_tpu.framework.interface import get_plugin_builder
 
+    use_delta = False
+    delta = None
+    oc = None
     if isolated:
         cluster = cache.snapshot()
         ssn = Session(cache, cluster, tiers)
     else:
         cache.begin_exclusive_session()
         try:
-            cluster = cache.session_view()
+            oc = getattr(cache, "open_cache", None)
+            take = getattr(cache, "take_dirty", None)
+            delta = take() if take is not None else None
+            use_delta = (
+                delta is not None
+                and oc is not None
+                and getattr(cache, "columns", None) is not None
+                and getattr(cache, "delta_enabled", False)
+                and oc.valid
+                and not (delta.full or delta.queues_changed
+                         or delta.priority_classes_changed)
+                and len(delta.jobs) <= max(
+                    32, cache.delta_churn_threshold * len(oc.jobs)
+                )
+            )
+            if use_delta:
+                cache.last_open_path = "delta"
+                cache.last_churn = delta.churn_fraction(len(oc.jobs))
+                cluster = cache.session_view_delta(delta)
+            else:
+                if delta is not None:
+                    cache.last_open_path = "full"
+                    cache.last_churn = delta.churn_fraction(len(cache.jobs))
+                cluster = cache.session_view()
         except BaseException:
             cache.end_exclusive_session()
             raise
-        ssn = Session(cache, cluster, tiers, exclusive=True)
+        try:
+            ssn = Session(cache, cluster, tiers, exclusive=True,
+                          open_reuse=oc if use_delta else None,
+                          dirty_jobs=delta.jobs if use_delta else frozenset())
+            if not use_delta and oc is not None:
+                # reseed the cross-cycle open cache from this full rebuild —
+                # BEFORE the gate mutates ssn.jobs (same dict as cluster.jobs)
+                cache.rebuild_open_cache(cluster,
+                                         ssn.pod_group_status_at_open)
+        except BaseException:
+            # same contract as the guards around it: never leave the gate
+            # stuck, and never trust half-updated cross-cycle open state
+            if oc is not None:
+                oc.invalidate()
+                cache.dirty.mark_full()
+            cache.end_exclusive_session()
+            raise
     try:
+        cols = ssn.columns
+        if cols is not None:
+            # sync the column job-row arrays (j_sess membership, j_min,
+            # j_queue, j_prio, j_creation, j_sched) before plugin opens —
+            # proportion's vectorized open, the gang gate, the device
+            # snapshot, and the close status pass all read them
+            if use_delta:
+                cols.sync_session_rows(ssn, dirty_uids=delta.jobs,
+                                       restore_rows=oc.gate_dropped_rows)
+            else:
+                cols.sync_session_rows(ssn)
+            ssn.rows_synced = True
         for tier in tiers:
             for opt in tier.plugins:
                 plugin = get_plugin_builder(opt.name)(opt.arguments)
@@ -655,9 +731,9 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
         # gang is the only JobValid voter (its verdict IS the count compare,
         # gang.go:48-69) — only the normally-sparse invalid set walks the
         # full dispatch for its reason string.
-        cols = ssn.columns
         valid_voters = set(ssn._fns.get(JOB_VALID, {}).keys())
-        if cols is not None and valid_voters <= {"gang"} and ssn.jobs:
+        if cols is not None and ssn.rows_synced and valid_voters <= {"gang"} \
+                and ssn.jobs:
             if not valid_voters:
                 gate_jobs = []
             else:
@@ -665,14 +741,15 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
 
                 from kube_batch_tpu.api.columns import VALID_STATUSES
 
-                jobs_list, rows, minav = ssn.jobs_rows()
+                rows, jobs_list = ssn.session_rows()
                 valid_num = cols.j_counts[rows][:, VALID_STATUSES].sum(axis=1)
                 gate_jobs = [
                     (jobs_list[i].uid, jobs_list[i])
-                    for i in np.flatnonzero(valid_num < minav)
+                    for i in np.flatnonzero(valid_num < cols.j_min[rows])
                 ]
         else:
             gate_jobs = list(ssn.jobs.items())
+        dropped_rows = set()
         for uid, job in gate_jobs:
             reason = ssn.job_valid(job)
             if reason is not None:
@@ -689,9 +766,24 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
                 cache.record_job_status_event(job)
                 ssn.gate_dropped_jobs.append(job)
                 ssn.drop_job(uid)
+                if cols is not None and job._cols is cols and job._row >= 0:
+                    # the dropped job leaves the device snapshot too; its
+                    # row is remembered so the next delta open re-admits it
+                    # for the gate's re-vote
+                    cols.j_sess[job._row] = False
+                    dropped_rows.add(job._row)
+        if oc is not None:
+            oc.gate_dropped_rows = dropped_rows
     except BaseException:
         if ssn.exclusive:
-            cache.end_exclusive_session()  # never leave the gate stuck
+            # never leave the gate stuck; and a half-opened session may have
+            # consumed dirty marks without refreshing the open cache — force
+            # the next open to rebuild from scratch
+            invalidate = getattr(cache, "open_cache", None)
+            if invalidate is not None:
+                invalidate.invalidate()
+                cache.dirty.mark_full()
+            cache.end_exclusive_session()
         raise
     return ssn
 
@@ -777,7 +869,7 @@ def _close_status_columnar(ssn: Session) -> None:
     set (update_job_condition records the uids as it writes the conditions —
     transition_id == ssn.uid is exactly 'marked this session')."""
     cols = ssn.columns
-    jobs_list, rows, minav = ssn.jobs_rows()
+    rows, jobs_list = ssn.session_rows()
     counts = cols.j_counts[rows]
     running_l = counts[:, int(TaskStatus.RUNNING)].tolist()
     failed_l = counts[:, int(TaskStatus.FAILED)].tolist()
@@ -877,7 +969,7 @@ def close_session(ssn: Session) -> None:
                 plugin.name, "OnSessionClose",
                 (telemetry.perf_counter() - t0) * 1e6,
             )
-        if ssn.columns is not None and ssn.jobs:
+        if ssn.columns is not None and ssn.rows_synced and ssn.jobs:
             _close_status_columnar(ssn)
         else:
             qcounts: Dict[str, dict] = {}

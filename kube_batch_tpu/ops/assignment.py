@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from kube_batch_tpu.api.snapshot import DeviceSnapshot
+from kube_batch_tpu.utils import jitstats
 from kube_batch_tpu.ops import fairness, ordering
 from kube_batch_tpu.ops.ordering import segmented_prefix as _segmented_prefix
 from kube_batch_tpu.ops.feasibility import fits, static_predicates
@@ -466,3 +467,10 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         deserved=deserved,
         rounds_run=rounds_run,
     )
+
+
+# retrace accounting (utils/jitstats): the bench asserts these stay flat
+# across steady-state cycles — shape-bucketed snapshots must hit the jit
+# cache every cycle after warmup
+jitstats.register("allocate_solve", allocate_solve)
+jitstats.register("failure_histogram_solve", failure_histogram_solve)

@@ -77,16 +77,16 @@ class GangPlugin(Plugin):
     def on_session_close(self, ssn: fw.Session) -> None:
         """(gang.go:132-175) mark still-unready jobs Unschedulable."""
         cols = ssn.columns
-        if cols is not None and ssn.jobs:
+        if cols is not None and ssn.rows_synced and ssn.jobs:
             # one counts-matrix expression finds the (normally sparse)
             # unready set; only those jobs pay the condition rendering
             import numpy as np
 
             from kube_batch_tpu.api.columns import READY_STATUSES
 
-            jobs_list, rows, minav = ssn.jobs_rows()
+            rows, jobs_list = ssn.session_rows()
             counts = cols.j_counts[rows]
-            ready = counts[:, READY_STATUSES].sum(axis=1) >= minav
+            ready = counts[:, READY_STATUSES].sum(axis=1) >= cols.j_min[rows]
             has_tasks = counts.sum(axis=1) > 0
             candidates = [
                 jobs_list[i] for i in np.flatnonzero(~ready & has_tasks)
@@ -113,6 +113,7 @@ class GangPlugin(Plugin):
                 f"; {fit_errors[0]}" if fit_errors else ""
             )
             job.job_fit_errors = message  # read by RecordJobStatusEvent
+            ssn.note_fit_state(job)
             ssn.update_job_condition(
                 job,
                 PodGroupCondition(

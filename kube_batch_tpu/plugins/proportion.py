@@ -66,32 +66,29 @@ class ProportionPlugin(Plugin):
         spec = ssn.spec
         self.total = ssn.total_allocatable().clone()
         cols = ssn.columns
-        if cols is not None:
-            # columnar session: segment-sum the job ledger matrices by queue
-            # instead of per-job Resource arithmetic (proportion.go:67-99)
-            qindex: Dict[str, int] = {}
-            job_qidx = np.full(cols.jobs.cap, -1, np.int32)
-            for job in ssn.jobs.values():
-                if job._row < 0 or job.queue not in ssn.queues:
-                    continue
-                qi = qindex.get(job.queue)
-                if qi is None:
-                    qi = qindex[job.queue] = len(qindex)
-                job_qidx[job._row] = qi
-            nq = max(len(qindex), 1)
-            alloc_m = np.zeros((nq, spec.n))
-            request_m = np.zeros((nq, spec.n))
-            rows = np.flatnonzero(job_qidx >= 0)
-            vals = job_qidx[rows]
-            np.add.at(alloc_m, vals, cols.j_alloc[rows])
-            np.add.at(request_m, vals, cols.j_alloc[rows] + cols.j_pend[rows])
-            self._qalloc, self._jq_rows, self._jq_vals = alloc_m, rows, vals
+        if cols is not None and getattr(ssn, "rows_synced", False):
+            # columnar session: the open-time row sync already derived
+            # session membership and queue rows (j_sess/j_queue — delta
+            # against the previous cycle when churn allows), so queue attrs
+            # are one segment-sum over the job ledger matrices: no per-job
+            # Python loop at all (proportion.go:67-99)
+            rows = np.flatnonzero(cols.j_sess)
+            qrows = cols.j_queue[rows]
+            capQ = cols.queues.cap
+            alloc_m = np.zeros((capQ, spec.n))
+            request_m = np.zeros((capQ, spec.n))
+            np.add.at(alloc_m, qrows, cols.j_alloc[rows])
+            np.add.at(request_m, qrows, cols.j_alloc[rows] + cols.j_pend[rows])
+            self._qalloc, self._jq_rows, self._jq_vals = alloc_m, rows, qrows
             wrap = spec.wrap_vec
-            for qname, qi in qindex.items():
-                attr = _QueueAttr(ssn.queues[qname], spec)
+            for qi in np.unique(qrows).tolist():
+                qinfo = ssn.queues.get(cols.queue_names[qi])
+                if qinfo is None:
+                    continue  # queue row/dict skew — attr-less queues fail open
+                attr = _QueueAttr(qinfo, spec)
                 attr.allocated = wrap(alloc_m[qi])
                 attr.request = wrap(request_m[qi])
-                self.queue_attrs[qname] = attr
+                self.queue_attrs[qinfo.name] = attr
         else:
             # queue attrs from jobs present this session (proportion.go:67-99)
             for job in ssn.jobs.values():

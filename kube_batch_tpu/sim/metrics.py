@@ -13,6 +13,17 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 
+def nearest_rank(values: List[float], p: float) -> float:
+    """Nearest-rank percentile: ceil(p*n)-1.  `int(p*n)` sat one rank high
+    (p50 of a 2-sample read the max), overstating small-n tails — the ONE
+    shared definition (bench.py and testing/e2e.py call this too)."""
+    import math
+
+    xs = sorted(values)
+    n = len(xs)
+    return xs[min(n - 1, max(0, math.ceil(p * n) - 1))]
+
+
 def percentile_summary(values: List[float]) -> Optional[Dict]:
     """p50/p90/p99 + mean over a sample (nearest-rank, like e2e's density
     percentiles); None for an empty sample."""
@@ -20,20 +31,12 @@ def percentile_summary(values: List[float]) -> Optional[Dict]:
         return None
     xs = sorted(values)
     n = len(xs)
-
-    def pct(p: float) -> float:
-        # nearest-rank is ceil(p*n)-1; int(p*n) sat one rank high (p50 of a
-        # 2-sample read the max), overstating small-n tails
-        import math
-
-        return round(xs[min(n - 1, max(0, math.ceil(p * n) - 1))], 6)
-
     return {
         "n": n,
         "mean": round(sum(xs) / n, 6),
-        "p50": pct(0.50),
-        "p90": pct(0.90),
-        "p99": pct(0.99),
+        "p50": round(nearest_rank(xs, 0.50), 6),
+        "p90": round(nearest_rank(xs, 0.90), 6),
+        "p99": round(nearest_rank(xs, 0.99), 6),
         "max": round(xs[-1], 6),
     }
 
@@ -47,6 +50,12 @@ class LongitudinalMetrics:
         self.binds = 0
         self.fairness: List[Dict] = []            # per-cycle queue shares
         self.cycles = 0
+        # cross-cycle resident-snapshot bookkeeping: which open/snapshot
+        # path each cycle took ("delta" vs "full") and its churn fraction —
+        # the seed-deterministic evidence that the multi-cycle delta win
+        # holds without the TPU tunnel
+        self.snapshot_paths: Dict[str, int] = {}
+        self.churn: List[float] = []
 
     # ---- job lifecycle ---------------------------------------------------
     def note_arrival(self, job_uid: str, t: float) -> None:
@@ -64,14 +73,25 @@ class LongitudinalMetrics:
 
     # ---- per-cycle -------------------------------------------------------
     def note_cycle(self, t: float, queue_shares: Dict[str, Dict],
-                   pending_tasks: int, running_tasks: int) -> None:
+                   pending_tasks: int, running_tasks: int,
+                   snapshot_path: Optional[str] = None,
+                   churn: Optional[float] = None) -> None:
         self.cycles += 1
-        self.fairness.append({
+        rec = {
             "t": round(t, 6),
             "queues": queue_shares,
             "pending": pending_tasks,
             "running": running_tasks,
-        })
+        }
+        if snapshot_path is not None:
+            rec["snapshot_path"] = snapshot_path
+            self.snapshot_paths[snapshot_path] = (
+                self.snapshot_paths.get(snapshot_path, 0) + 1
+            )
+        if churn is not None:
+            rec["churn"] = round(churn, 6)
+            self.churn.append(churn)
+        self.fairness.append(rec)
 
     # ---- report ----------------------------------------------------------
     def report(self) -> Dict:
@@ -106,5 +126,9 @@ class LongitudinalMetrics:
             "fairness_mean_abs_drift": {
                 q: round(sum(v) / len(v), 6) for q, v in drift.items() if v
             },
+            # per-cycle open/snapshot path counts + churn-fraction summary
+            # (the raw per-cycle values ride the fairness series records)
+            "snapshot_paths": dict(self.snapshot_paths),
+            "churn_fraction": percentile_summary(self.churn),
             "fairness_series": self.fairness,
         }

@@ -380,8 +380,14 @@ class SimRunner:
             self.scheduler.run_once()  # flushes async binds at its end
             self._drain_kubelet(now)
             pending, running = self._task_counts()
-            self.metrics.note_cycle(now, self._queue_shares(),
-                                    pending, running)
+            self.metrics.note_cycle(
+                now, self._queue_shares(), pending, running,
+                snapshot_path=(
+                    f"{self.cache.last_open_path}"
+                    f"/{self.cache.columns.last_snapshot_path}"
+                ),
+                churn=self.cache.last_churn,
+            )
             cycles_run += 1
             submitted = len(self.metrics.arrivals)
             if (not self.heap and pending == 0

@@ -847,11 +847,9 @@ def run_density(master: str, n_pods: int = 3000, n_nodes: int = 100,
             return {"pods": 0, "nodes": n_nodes, "gang": gang}
 
         def pct(p):
-            # nearest-rank = ceil(p*n)-1 (same fix as sim/metrics.py)
-            import math
+            from kube_batch_tpu.sim.metrics import nearest_rank
 
-            n = len(lat)
-            return round(lat[min(n - 1, max(0, math.ceil(p * n) - 1))], 1)
+            return round(nearest_rank(lat, p), 1)
         return {
             "pods": n_pods, "nodes": n_nodes, "gang": gang,
             "startup_p50_ms": pct(0.50), "startup_p90_ms": pct(0.90),

@@ -1,0 +1,49 @@
+"""Jit compile/retrace accounting for the solver programs.
+
+The cycle-time budget assumes the compiled solves are cache hits after
+warmup: the snapshot axes are padded to capacity buckets precisely so a
+±10% pod-count wobble maps to the SAME shapes cycle after cycle.  A silent
+retrace (shape drift, a fresh lambda in a jit cache key, an axis growing
+mid-flight) costs hundreds of ms and hides inside p50s — so the bench and
+the tests read these counters instead of guessing.
+
+Every jitted entry point registers itself here; ``total_compiles()`` sums
+``_cache_size()`` (the per-function count of distinct traced/compiled
+specializations) across them.  A delta of zero between two points proves no
+retrace happened in the interval.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+_TRACKED: List[Tuple[str, object]] = []
+
+
+def register(name: str, fn) -> object:
+    """Track a jitted callable (idempotent per (name, fn)); returns fn so it
+    can wrap a definition site."""
+    for n, f in _TRACKED:
+        if n == name and f is fn:
+            return fn
+    _TRACKED.append((name, fn))
+    return fn
+
+
+def _size(fn) -> int:
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — older jax without the probe
+        return 0
+
+
+def compile_counts() -> Dict[str, int]:
+    """{name: compiled-specialization count} for every tracked function."""
+    out: Dict[str, int] = {}
+    for name, fn in _TRACKED:
+        out[name] = out.get(name, 0) + _size(fn)
+    return out
+
+
+def total_compiles() -> int:
+    return sum(_size(fn) for _, fn in _TRACKED)

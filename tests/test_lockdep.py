@@ -89,6 +89,62 @@ class TestOrderInversion:
                 pass
         assert state.violations == []
 
+    def test_transitive_three_lock_cycle_is_flagged(self):
+        # A→B, B→C recorded with no direct two-lock inversion anywhere;
+        # the closing C→A edge completes A→B→C→A and must report with the
+        # full chain (the pre-PR detector only caught direct A→B/B→A)
+        state = LockdepState()
+        a, b, c = _locks(state, "mod.cache:1", "mod.volume:2", "mod.server:3")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        kinds = [v.kind for v in state.violations]
+        assert kinds == ["order-inversion"]
+        v = state.violations[0]
+        assert "transitive" in v.description
+        for site in ("mod.cache:1", "mod.volume:2", "mod.server:3"):
+            assert site in v.description
+        # every chain edge carries its first-observed stack for diagnosis
+        assert v.stack.count("first observed at") == 2
+
+    def test_transitive_dag_without_cycle_is_clean(self):
+        # A→B, B→C, A→C is a DAG — consistent global order, no report
+        state = LockdepState()
+        a, b, c = _locks(state, "A", "B", "C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with a:
+            with c:
+                pass
+        assert state.violations == []
+
+    def test_transitive_cycle_reported_once(self):
+        state = LockdepState()
+        a, b, c = _locks(state, "A", "B", "C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        for _ in range(3):
+            with c:
+                with a:
+                    pass
+        # the closing edge is recorded on first sight; repeats are cache
+        # hits in the unlocked probe and must not re-report
+        assert len(state.violations) == 1
+
     def test_duplicate_inversions_not_double_reported(self):
         state = LockdepState()
         a, b = _locks(state, "A", "B")

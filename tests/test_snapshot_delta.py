@@ -1,0 +1,313 @@
+"""Delta-vs-full-rebuild equivalence over randomized churn.
+
+The cross-cycle machinery (cache/dirty.py, columns.sync_session_rows, the
+per-cycle device-resident cache) promises BIT-EXACT equivalence with the
+from-scratch path.  These tests churn a real SchedulerCache through the
+ordinary ingest surface — gang arrivals, completions, status flips, node
+crashes/rejoins, queue and priority-class changes — run real scheduling
+cycles, and after every cycle compare the delta-built device snapshot (and
+the session-open state) against a forced full rebuild.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PriorityClass,
+    Queue,
+)
+from kube_batch_tpu.api.snapshot import DeviceSnapshot
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.framework.interface import get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu.sim import kubelet as kl
+from kube_batch_tpu.testing.synthetic import GiB
+
+
+def _mk_cache(n_nodes=6, n_queues=2):
+    cache = SchedulerCache()
+    for q in range(n_queues):
+        cache.add_queue(Queue(name=f"q{q}", uid=f"uq{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cache.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 16000.0, "memory": 64 * GiB, "pods": 110.0},
+        ))
+    return cache
+
+
+class _Churner:
+    """Randomized but seed-deterministic cluster churn through the real
+    ingest handlers."""
+
+    def __init__(self, cache, rng, n_queues=2):
+        self.cache = cache
+        self.rng = rng
+        self.n_queues = n_queues
+        self.serial = 0
+        self.gangs = []  # job names with live pods
+
+    def add_gang(self, size=None):
+        self.serial += 1
+        g = f"g{self.serial}"
+        size = size or int(self.rng.integers(1, 4))
+        self.cache.add_pod_group(PodGroup(
+            name=g, namespace="churn", uid=f"pg-{g}", min_member=size,
+            queue=f"q{int(self.rng.integers(self.n_queues))}",
+            creation_index=self.serial,
+        ))
+        for k in range(size):
+            self.cache.add_pod(Pod(
+                name=f"{g}-{k}", namespace="churn", uid=f"pod-{g}-{k}",
+                requests={"cpu": float(self.rng.choice([250.0, 500.0, 1000.0])),
+                          "memory": 1 * GiB},
+                annotations={GROUP_NAME_ANNOTATION: g},
+                phase=PodPhase.PENDING,
+                creation_index=self.serial * 100 + k,
+            ))
+        self.gangs.append(g)
+
+    def complete_gang(self):
+        if not self.gangs:
+            return
+        g = self.gangs.pop(int(self.rng.integers(len(self.gangs))))
+        job_uid = f"churn/{g}"
+        job = self.cache.jobs.get(job_uid)
+        keys = sorted(job.tasks.keys()) if job is not None else []
+        for key in keys:
+            kl.delete_pod(self.cache, key)
+        self.cache.delete_pod_group(job_uid)
+
+    def flip_statuses(self):
+        # bound pods progress to Running/Succeeded like a kubelet would
+        pods = [p for p in self.cache.pods.values() if p.node_name]
+        if not pods:
+            return
+        pods.sort(key=lambda p: p.key())
+        for p in pods[: int(self.rng.integers(1, 3))]:
+            if p.phase == PodPhase.PENDING:
+                kl.set_running(self.cache, p.key(), p.node_name)
+            elif p.phase == PodPhase.RUNNING and self.rng.random() < 0.5:
+                kl.set_succeeded(self.cache, p.key())
+
+    def node_churn(self):
+        r = self.rng.random()
+        if r < 0.5:
+            self.cache.delete_node(f"n{int(self.rng.integers(3))}")
+        else:
+            i = int(self.rng.integers(3))
+            self.cache.add_node(Node(
+                name=f"n{i}",
+                allocatable={"cpu": 16000.0, "memory": 64 * GiB,
+                             "pods": 110.0},
+            ))
+
+    def step(self):
+        r = self.rng.random()
+        if r < 0.45:
+            self.add_gang()
+        elif r < 0.70:
+            self.complete_gang()
+        elif r < 0.90:
+            self.flip_statuses()
+        else:
+            self.node_churn()
+
+
+def _snapshot_arrays(snap: DeviceSnapshot) -> dict:
+    return {f: np.array(getattr(snap, f)) for f in snap._fields}
+
+
+def _assert_snaps_equal(delta: dict, full: dict, context: str):
+    for field, want in full.items():
+        got = delta[field]
+        assert got.shape == want.shape, f"{context}: {field} shape"
+        assert np.array_equal(got, want), (
+            f"{context}: field {field} diverged between delta and full "
+            f"rebuild (rows {np.flatnonzero(np.any(np.atleast_2d(got != want), axis=-1))[:8]})"
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_delta_device_snapshot_bit_exact_under_churn(seed):
+    """Over randomized churn sequences, the delta-built device snapshot is
+    bit-exact against a from-scratch row rescan every cycle (acceptance
+    criterion of the cross-cycle resident-snapshot PR)."""
+    rng = np.random.default_rng(seed)
+    cache = _mk_cache()
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, rng)
+    for _ in range(4):
+        churn.add_gang()
+    delta_cycles = 0
+    for cycle in range(14):
+        churn.step()
+        ssn = open_session(cache, conf.tiers)
+        cols = cache.columns
+        try:
+            snap, _meta = cols.device_snapshot(ssn)
+            got = _snapshot_arrays(snap)
+            path = cols.last_snapshot_path
+            delta_cycles += path == "delta"
+            # force the full rescan over the same session state and compare
+            cols.sync_session_rows(ssn)
+            snap_full, _ = cols.device_snapshot(ssn)
+            _assert_snaps_equal(
+                got, _snapshot_arrays(snap_full),
+                f"seed={seed} cycle={cycle} path={path}",
+            )
+            for name in conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+        cache.flush_binds()
+    assert cache.columns.check_consistency(cache) == []
+    # the delta path must actually engage (else this test proves nothing)
+    assert delta_cycles >= 5, f"delta path engaged only {delta_cycles}x"
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_delta_open_state_matches_full_view(seed):
+    """The delta session open hands out exactly the membership, priorities,
+    and at-open PodGroup statuses a full session_view would derive."""
+    rng = np.random.default_rng(seed)
+    cache = _mk_cache()
+    cache.add_priority_class(PriorityClass(name="high", value=50))
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, rng)
+    for _ in range(3):
+        churn.add_gang()
+    for cycle in range(10):
+        churn.step()
+        ssn = open_session(cache, conf.tiers)
+        try:
+            # expected: re-derive the full view against the SAME live state
+            # (session_view only reads; the exclusive gate is already held)
+            expected = cache.session_view()
+            assert set(ssn.jobs) | {j.uid for j in ssn.gate_dropped_jobs} \
+                == set(expected.jobs), f"cycle {cycle} membership"
+            for uid, job in expected.jobs.items():
+                assert job.priority == expected.jobs[uid].priority
+            expected_status = {
+                uid: (j.pod_group.phase, j.pod_group.running,
+                      j.pod_group.failed, j.pod_group.succeeded)
+                for uid, j in expected.jobs.items() if j.pod_group is not None
+            }
+            assert ssn.pod_group_status_at_open == expected_status, (
+                f"cycle {cycle} at-open status"
+            )
+            for name in conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+        cache.flush_binds()
+
+
+def test_per_cycle_device_cache_round_trips_bit_exact():
+    """The scatter-refreshed device-resident per-cycle columns fetch back
+    bit-identical to the host columns after every churn cycle."""
+    from kube_batch_tpu.api.resident import PER_CYCLE_FIELDS
+
+    rng = np.random.default_rng(5)
+    cache = _mk_cache()
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, rng)
+    for _ in range(3):
+        churn.add_gang()
+    cols = cache.columns
+    for cycle in range(8):
+        churn.step()
+        ssn = open_session(cache, conf.tiers)
+        try:
+            snap, _meta = cols.device_snapshot(ssn)
+            swapped = cols.per_cycle_resident(snap)
+            for field in PER_CYCLE_FIELDS:
+                host = np.asarray(getattr(snap, field))
+                dev = np.asarray(getattr(swapped, field))
+                assert np.array_equal(host, dev), (
+                    f"cycle {cycle}: device-resident {field} diverged"
+                )
+            for name in conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+        cache.flush_binds()
+    pcd = cols._per_cycle_dev
+    assert pcd is not None and pcd.scatter_updates > 0, (
+        "scatter-delta path never engaged"
+    )
+
+
+def test_full_fallback_on_row_space_changes():
+    """Queue and priority-class changes invalidate the delta path for one
+    open (row spaces / priority resolution are global inputs)."""
+    cache = _mk_cache()
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, np.random.default_rng(1))
+    churn.add_gang()
+
+    def one_open():
+        ssn = open_session(cache, conf.tiers)
+        close_session(ssn)
+        return cache.last_open_path
+
+    assert one_open() == "full"      # cold cache
+    churn.add_gang()
+    assert one_open() == "delta"     # low churn
+    cache.add_queue(Queue(name="q9", uid="uq9", weight=1))
+    assert one_open() == "full"      # queue row space moved
+    assert one_open() == "delta"
+    cache.add_priority_class(PriorityClass(name="p", value=9))
+    assert one_open() == "full"      # priority universe moved
+    assert one_open() == "delta"
+
+
+def test_delta_disabled_forces_full_path():
+    cache = _mk_cache()
+    cache.delta_enabled = False
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, np.random.default_rng(2))
+    churn.add_gang()
+    for _ in range(3):
+        ssn = open_session(cache, conf.tiers)
+        close_session(ssn)
+        assert cache.last_open_path == "full"
+        assert cache.columns.last_snapshot_path == "full"
+
+
+def test_stale_fit_state_cleared_across_delta_opens():
+    """A job that recorded fit errors in one cycle starts the next session
+    clean even when the open takes the delta path (note_fit_state feeds the
+    targeted clearing set)."""
+    cache = _mk_cache()
+    conf = load_scheduler_conf(None)
+    churn = _Churner(cache, np.random.default_rng(4))
+    churn.add_gang(size=2)
+    ssn = open_session(cache, conf.tiers)
+    job = next(iter(ssn.jobs.values()))
+    job.job_fit_errors = "synthetic"
+    from kube_batch_tpu.api.job_info import FitErrors
+
+    fe = FitErrors()
+    fe.set_histogram({"synthetic reason": 1}, 1)
+    job.nodes_fit_errors["t"] = fe
+    ssn.note_fit_state(job)
+    close_session(ssn)
+    ssn = open_session(cache, conf.tiers)
+    try:
+        assert cache.last_open_path == "delta"
+        refreshed = ssn.jobs.get(job.uid)
+        assert refreshed is None or refreshed.job_fit_errors == ""
+        assert refreshed is None or refreshed.nodes_fit_errors == {}
+    finally:
+        close_session(ssn)
