@@ -174,7 +174,8 @@ class AllocateAction(Action):
         result, self.last_solve_mode = dispatch_allocate_solve(
             snap, session_allocate_config(ssn), cols=cols
         )
-        # one blocking transfer for everything the host reads
+        # kbt: allow[KBT010] THE sanctioned choke point: one blocking
+        # transfer for everything the host replay reads
         assigned, pipelined, rounds_run = jax.device_get(
             (result.assigned, result.pipelined, result.rounds_run)
         )
@@ -231,6 +232,9 @@ class AllocateAction(Action):
             # replay; fit-error recording touches job diagnostic dicts the
             # replay never reads, so the reordering is invisible to it
             self._record_fit_errors(
+                # kbt: allow[KBT010] sanctioned post-replay readback: the
+                # histogram was dispatched before the replay precisely so
+                # this read overlaps host work instead of stalling
                 ssn, meta, np.asarray(fail_hist_dev), assigned, task_job,
                 pending,
             )

@@ -7,13 +7,23 @@ allocate→backfill discard signal, the fail-open PV nodeAffinity translation,
 PR 1's writer-executor race) was an instance of a mechanically detectable
 pattern. This package builds the checks once so the class stops recurring:
 
-- `engine` / `rules`: an AST lint engine (stdlib `ast`, no new deps) with
-  rules KBT001–KBT005, each grounded in a real past bug. Run it with
-  `python -m kube_batch_tpu.analysis` (add `--jsonl` for CI).
+- `engine` / `rules` / `flowrules` / `dataflow`: an AST lint engine
+  (stdlib `ast`, no new deps) with rules KBT001–KBT010, each grounded in
+  a real past bug. KBT001–005 are line-local; KBT006–010 are flow-aware —
+  the engine builds a per-module symbol table with resolved imports and
+  the rules run intra-procedural def-use tracking (aliasing, taint,
+  may-merge joins), the sized-for-us analog of `go vet`'s SSA passes.
+  Run with `python -m kube_batch_tpu.analysis` (add `--jsonl` for CI).
+- `jaxpr_audit`: tier B — the registered jitted entry points traced with
+  abstract shapes and their closed jaxprs linted for f64 upcasts, in-graph
+  transfers, host callbacks, and donation drift (KBT101–104). Run with
+  `--jaxpr` / `--jaxpr-only`, or both tiers via `scripts/check.sh`.
 - `lockdep`: a runtime lock-order validator in the spirit of the Linux
   kernel's lockdep — instrumented Lock/RLock factories record per-thread
   held-lock sets, build the acquisition-order graph, and flag A→B/B→A
-  inversions and blocking calls made while a lock is held.
+  inversions (transitive cycles included), blocking calls made while a
+  lock is held, and same-site nesting not declared via
+  utils.blocking.allow_nesting.
 - `pytest_plugin`: enables lockdep for the whole test suite and fails the
   run on violations (wired into tests/conftest.py, so tier-1 enforces it).
 

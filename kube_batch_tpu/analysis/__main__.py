@@ -1,6 +1,11 @@
-"""`python -m kube_batch_tpu.analysis` — run the kbt-check lint rules.
+"""`python -m kube_batch_tpu.analysis` — run the kbt-check lint tiers.
 
-Exit status: 0 clean, 1 findings, 2 usage error. `--jsonl` emits one JSON
+Tier A (default): the static AST/flow rules over the package tree.
+Tier B (``--jaxpr``): the jaxpr-level audit of the registered jitted entry
+points (analysis/jaxpr_audit.py) — added to the static run; ``--jaxpr-only``
+skips tier A.  ``--select``/``--jsonl`` apply to both tiers uniformly.
+
+Exit status: 0 clean, 1 findings, 2 usage error.  `--jsonl` emits one JSON
 object per finding on stdout for CI consumption; the human format is
 `path:line:col: RULE message` (clickable in most editors).
 """
@@ -32,29 +37,70 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--select", metavar="RULES",
-        help="comma-separated rule ids to run (default: all)",
+        help="comma-separated rule ids to run (default: all); KBT10x ids "
+             "select jaxpr-audit checks",
+    )
+    parser.add_argument(
+        "--jaxpr", action="store_true",
+        help="additionally run the jaxpr-level audit of the registered "
+             "jitted entry points (imports jax)",
+    )
+    parser.add_argument(
+        "--jaxpr-only", action="store_true",
+        help="run only the jaxpr audit tier",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog",
     )
     args = parser.parse_args(argv)
 
+    # the audit-rule ids live here, not in rules.py — keep the static tier
+    # importable without jax
+    from kube_batch_tpu.analysis.jaxpr_audit import AUDIT_RULES
+
     if args.list_rules:
         for rule in ALL_RULES:
             scope = ", ".join(rule.scope) if rule.scope else "package-wide"
             print(f"{rule.id}  {rule.title}  [{scope}]")
+        for rid, title in AUDIT_RULES.items():
+            print(f"{rid}  {title}  [jaxpr audit]")
         return 0
 
-    rules = None
+    static_rules = None
+    audit_select = None
     if args.select:
         ids = [r.strip() for r in args.select.split(",") if r.strip()]
-        unknown = [r for r in ids if r not in RULES_BY_ID]
+        unknown = [r for r in ids
+                   if r not in RULES_BY_ID and r not in AUDIT_RULES]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
-        rules = [RULES_BY_ID[r] for r in ids]
+        static_ids = [r for r in ids if r in RULES_BY_ID]
+        audit_ids = [r for r in ids if r in AUDIT_RULES]
+        # with an explicit selection, each tier runs exactly its selected
+        # rules: naming audit rules implies the audit tier, and a selection
+        # with NO audit ids skips the audit entirely even under --jaxpr —
+        # tracing six entry points only to discard every finding would
+        # both waste the cost and let CI believe the tier ran
+        audit_select = audit_ids
+        if audit_ids:
+            args.jaxpr = True
+            if not static_ids:
+                args.jaxpr_only = True
+        else:
+            args.jaxpr = False
+            args.jaxpr_only = False
+        if static_ids:
+            static_rules = [RULES_BY_ID[r] for r in static_ids]
 
-    findings = run_paths(args.paths, rules=rules)
+    findings = []
+    if not args.jaxpr_only:
+        findings.extend(run_paths(args.paths, rules=static_rules))
+    if args.jaxpr or args.jaxpr_only:
+        from kube_batch_tpu.analysis.jaxpr_audit import run_audit
+
+        findings.extend(run_audit(select=audit_select))
+
     for f in findings:
         if args.jsonl:
             print(json.dumps(f.to_dict(), sort_keys=True))

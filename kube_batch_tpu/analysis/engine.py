@@ -5,6 +5,14 @@ A rule sees one parsed module at a time plus its package-relative path
 rule objects run identically over the installed package and over the
 fixture snippets in tests.
 
+Since PR 4 the engine is flow-aware: for each module it builds ONE
+:class:`~kube_batch_tpu.analysis.dataflow.ModuleContext` — resolved
+imports, module symbol table, function index — and hands it to every rule
+through ``check_ctx``.  Line-local rules keep their ``check(tree, relpath)``
+signature (the base class adapts); flow rules (flowrules.py) override
+``check_ctx`` and additionally get intra-procedural def-use tracking from
+``dataflow.walk_function``.
+
 Suppression contract (see ANALYSIS.md): ``# kbt: allow[KBT001] reason``
 on the finding's line or the line directly above suppresses that rule
 there. The reason text is mandatory; an allow with no reason suppresses
@@ -117,6 +125,11 @@ class Rule:
     def check(self, tree: ast.Module, relpath: str) -> Iterable[Tuple[int, int, str]]:
         raise NotImplementedError
 
+    def check_ctx(self, ctx) -> Iterable[Tuple[int, int, str]]:
+        """Flow-aware entry point: receives the shared ModuleContext.  The
+        default adapts line-local rules; flow rules override this."""
+        return self.check(ctx.tree, ctx.relpath)
+
 
 def check_source(
     source: str,
@@ -137,6 +150,9 @@ def check_source(
     except SyntaxError as e:
         return [Finding("KBT000", display, e.lineno or 0, e.offset or 0,
                         f"syntax error: {e.msg}")]
+    from kube_batch_tpu.analysis.dataflow import ModuleContext
+
+    ctx = ModuleContext(tree, relpath)  # built once, shared by every rule
     sup = Suppressions.parse(source)
     findings: List[Finding] = []
     for line, rules_txt in sup.missing_reason:
@@ -148,7 +164,7 @@ def check_source(
     for rule in rules:
         if not rule.applies_to(relpath):
             continue
-        for line, col, message in rule.check(tree, relpath):
+        for line, col, message in rule.check_ctx(ctx):
             if sup.covers(rule.id, line):
                 continue
             findings.append(Finding(rule.id, display, line, col, message))

@@ -1,0 +1,326 @@
+"""Tier B: jaxpr-level audit of the jitted entry points.
+
+Tier A (the AST rules) sees source text; XLA sees the traced computation —
+and the gap between them is where the PR 3 hot path's silent bugs live: a
+float64 upcast that doubles every buffer, a `device_put` smuggled into the
+middle of a compiled program, a host callback stalling the pipeline, a
+donation that quietly stopped happening.  None of those fail a test on CPU;
+all of them cost the <1s/50k-pod target on a real accelerator.  This
+module is the JaxPruner-style answer (PAPERS.md): audit what actually gets
+compiled, not what the source looks like.
+
+Mechanism: a REGISTRY of the package's jitted entry points (ops/ solves,
+the resident scatter, the Pallas round head).  Each entry is traced with
+ABSTRACT inputs (jax.ShapeDtypeStruct — no device work, no compile) under
+``jax.experimental.enable_x64`` so dtype promotion is visible instead of
+silently canonicalized away, then the closed jaxpr is walked recursively
+(while/cond/scan/pjit sub-jaxprs included) and linted:
+
+- **KBT101 float64 upcast** — any f64 aval anywhere in the jaxpr when the
+  declared inputs are f32/i32.  Integer widening under the x64 probe is
+  canonicalization noise and ignored.
+- **KBT102 in-graph transfer** — a `device_put` targeting a concrete
+  device or performing a real copy (alias placements with device=None are
+  how jnp constants materialize and are benign).
+- **KBT103 host callback** — `pure_callback`/`io_callback`/`debug_callback`
+  inside a hot-path program: a host round-trip per invocation.
+- **KBT104 donation mismatch** — the wrapper's traced donate_argnums
+  differ from what the registry entry declares for the current backend
+  (e.g. someone drops donate_argnums from the resident scatter: CPU tests
+  stay green, every TPU cycle silently double-allocates).
+
+Suppression: registry entries carry ``allow={"KBT10x": "reason"}`` — the
+reason is mandatory, mirroring the `# kbt: allow` contract.
+
+Run via ``python -m kube_batch_tpu.analysis --jaxpr`` (adds this tier to
+the static run; ``--jaxpr-only`` skips tier A) or the tier-1
+self-enforcement test.  Tracing is abstract, so the whole audit is
+sub-second after the jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kube_batch_tpu.analysis.engine import Finding
+
+AUDIT_RULES = {
+    "KBT101": "float64 upcast in a traced entry point",
+    "KBT102": "in-graph device transfer in a traced entry point",
+    "KBT103": "host callback in a traced entry point",
+    "KBT104": "donation mismatch between wrapper and registry declaration",
+}
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "callback", "debug_callback"}
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """One jitted entry point the audit traces.
+
+    ``build`` returns ``(jitted_fn, args)`` with abstract (ShapeDtypeStruct)
+    array arguments — static arguments go in baked into ``args`` as real
+    values.  ``donate`` maps backend name → expected donate_argnums, with
+    ``"*"`` as the fallback (the resident scatter donates everywhere except
+    CPU).  ``allow`` suppresses one audit rule for this entry, reason
+    mandatory."""
+
+    name: str
+    build: Callable[[], Tuple[Callable, Tuple]]
+    donate: Dict[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=lambda: {"*": ()})
+    allow: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# abstract input builders
+# --------------------------------------------------------------------------
+
+# small-but-representative axis sizes: which primitives appear in the trace
+# does not depend on extents, and small shapes keep tracing fast.  W/Wt=1
+# matches a fresh ColumnStore; K/Kp=1 is the padded sparse-row floor.
+_T, _N, _J, _Q, _R, _W, _K = 16, 8, 4, 2, 3, 1, 1
+
+
+def _abstract_snapshot():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.api.snapshot import DeviceSnapshot
+
+    f32, i32, b, u32 = jnp.float32, jnp.int32, jnp.bool_, jnp.uint32
+    T, N, J, Q, R, W, K = _T, _N, _J, _Q, _R, _W, _K
+    return DeviceSnapshot(
+        task_req=S((T, R), f32), task_resreq=S((T, R), f32),
+        task_job=S((T,), i32), task_prio=S((T,), i32),
+        task_creation=S((T,), i32), task_status=S((T,), i32),
+        task_valid=S((T,), b), task_pending=S((T,), b),
+        task_best_effort=S((T,), b), task_sel_bits=S((T, W), u32),
+        task_sel_impossible=S((T,), b), task_tol_bits=S((T, W), u32),
+        task_node=S((T,), i32), task_critical=S((T,), b),
+        task_needs_host=S((T,), b), task_aff_idx=S((K,), i32),
+        task_aff_mask=S((K, N), b), task_pref_idx=S((K,), i32),
+        task_pref_node=S((K, N), f32), task_pref_pod=S((K, N), f32),
+        node_idle=S((N, R), f32), node_releasing=S((N, R), f32),
+        node_used=S((N, R), f32), node_alloc=S((N, R), f32),
+        node_valid=S((N,), b), node_sched=S((N,), b),
+        node_label_bits=S((N, W), u32), node_taint_bits=S((N, W), u32),
+        job_min_avail=S((J,), i32), job_ready=S((J,), i32),
+        job_queue=S((J,), i32), job_prio=S((J,), i32),
+        job_creation=S((J,), i32), job_valid=S((J,), b),
+        job_schedulable=S((J,), b), job_allocated=S((J, R), f32),
+        queue_weight=S((Q,), f32), queue_capability=S((Q, R), f32),
+        queue_alloc=S((Q, R), f32), queue_request=S((Q, R), f32),
+        queue_valid=S((Q,), b), total=S((R,), f32), quanta=S((R,), f32),
+    )
+
+
+def _build_allocate():
+    from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+
+    return allocate_solve, (_abstract_snapshot(), AllocateConfig())
+
+
+def _build_failure_histogram():
+    from kube_batch_tpu.ops.assignment import failure_histogram_solve
+
+    return failure_histogram_solve, (_abstract_snapshot(),)
+
+
+def _build_evict_reclaim():
+    from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
+
+    return evict_solve, (_abstract_snapshot(), EvictConfig(mode="reclaim"))
+
+
+def _build_evict_preempt():
+    from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
+
+    return evict_solve, (_abstract_snapshot(), EvictConfig(mode="preempt"))
+
+
+def _build_resident_scatter():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.api.resident import SCATTER_SLOTS, _scatter_fn
+
+    return _scatter_fn(), (
+        S((64, _R), jnp.float32),
+        S((SCATTER_SLOTS,), jnp.int32),
+        S((SCATTER_SLOTS, _R), jnp.float32),
+    )
+
+
+def _build_pallas_round_head():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.ops.pallas_kernels import NODE_TILE, TASK_TILE, masked_best_node
+
+    T, N = TASK_TILE, NODE_TILE  # one tile — grid multiples are guaranteed
+    return masked_best_node, (
+        S((T, N), jnp.float32), S((T, N), jnp.bool_), S((T, _R), jnp.float32),
+        S((N, _R), jnp.float32), S((N, _R), jnp.float32), S((T,), jnp.bool_),
+        S((_R,), jnp.float32), True,  # interpret=True: auditable off-TPU
+    )
+
+
+def _scatter_donation() -> Dict[str, Tuple[int, ...]]:
+    # the resident scatter donates the stale device buffer everywhere
+    # donation is supported; CPU skips it (api/resident.py's own gate)
+    return {"cpu": (), "*": (0,)}
+
+
+REGISTRY: Tuple[EntryPoint, ...] = (
+    EntryPoint("ops.assignment.allocate_solve", _build_allocate),
+    EntryPoint("ops.assignment.failure_histogram_solve",
+               _build_failure_histogram),
+    EntryPoint("ops.eviction.evict_solve[reclaim]", _build_evict_reclaim),
+    EntryPoint("ops.eviction.evict_solve[preempt]", _build_evict_preempt),
+    EntryPoint("api.resident.scatter", _build_resident_scatter,
+               donate=_scatter_donation()),
+    EntryPoint("ops.pallas_kernels.masked_best_node",
+               _build_pallas_round_head),
+)
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+# --------------------------------------------------------------------------
+
+
+def _iter_jaxprs(jaxpr) -> Iterable:
+    """The jaxpr and every sub-jaxpr reachable through eqn params
+    (pjit/while/cond/scan/pallas bodies)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for param in eqn.params.values():
+            vals = param if isinstance(param, (list, tuple)) else [param]
+            for sub in vals:
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from _iter_jaxprs(inner)
+                elif hasattr(sub, "eqns"):
+                    yield from _iter_jaxprs(sub)
+
+
+def _eqn_dtypes(eqn) -> Iterable[str]:
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        dtype = getattr(aval, "dtype", None)
+        if dtype is not None:
+            yield str(dtype)
+
+
+def _real_transfer(eqn) -> bool:
+    """True when a device_put eqn moves data for real: a concrete target
+    device/src, or copy semantics beyond the benign alias placement that
+    jnp constant materialization emits."""
+    devices = eqn.params.get("devices", [])
+    srcs = eqn.params.get("srcs", [])
+    if any(d is not None for d in devices) or any(s is not None for s in srcs):
+        return True
+    semantics = eqn.params.get("copy_semantics", [])
+    return any(getattr(s, "name", str(s)) not in ("ALIAS",) for s in semantics)
+
+
+def audit_entry(entry: EntryPoint) -> List[Finding]:
+    """Trace one entry point and lint its closed jaxpr.  Returns findings
+    (suppressed ones dropped; an allow with no reason is itself a KBT000,
+    mirroring the static tier's contract)."""
+    import jax
+    from jax.experimental import enable_x64
+
+    path = f"<jaxpr:{entry.name}>"
+    findings: List[Finding] = []
+    raw: List[Tuple[str, str]] = []  # (rule, message)
+
+    try:
+        fn, args = entry.build()
+        with enable_x64():
+            traced = fn.trace(*args)
+        closed = traced.jaxpr
+    except Exception as e:  # noqa: BLE001 — a broken entry must not read as clean
+        return [Finding("KBT000", path, 0, 0,
+                        f"entry point failed to trace: {type(e).__name__}: {e}")]
+
+    f64_prims: List[str] = []
+    transfers: List[str] = []
+    callbacks: List[str] = []
+    for jaxpr in _iter_jaxprs(closed.jaxpr):
+        for eqn in jaxpr.eqns:
+            prim = str(eqn.primitive)
+            if prim == "device_put":
+                if _real_transfer(eqn):
+                    transfers.append(prim)
+                continue
+            if prim in _CALLBACK_PRIMS:
+                callbacks.append(prim)
+                continue
+            if any(dt == "float64" for dt in _eqn_dtypes(eqn)):
+                f64_prims.append(prim)
+    if f64_prims:
+        uniq = sorted(set(f64_prims))
+        raw.append((
+            "KBT101",
+            f"float64 values produced by {', '.join(uniq)} "
+            f"({len(f64_prims)} eqn(s)) — the snapshot contract is f32; an "
+            "f64 upcast doubles buffer traffic and flips TPU matmuls to "
+            "the slow path",
+        ))
+    if transfers:
+        raw.append((
+            "KBT102",
+            f"{len(transfers)} in-graph device transfer(s) — a device_put "
+            "with a concrete placement inside a compiled program is a "
+            "mid-solve copy; inputs should arrive placed (resident cache)",
+        ))
+    if callbacks:
+        raw.append((
+            "KBT103",
+            f"host callback(s) {sorted(set(callbacks))} inside a compiled "
+            "hot-path program — one host round-trip per invocation",
+        ))
+
+    expected = entry.donate.get(
+        jax.default_backend(), entry.donate.get("*", ()))
+    actual = tuple(sorted(traced.donate_argnums or ()))
+    if tuple(sorted(expected)) != actual:
+        raw.append((
+            "KBT104",
+            f"wrapper donates argnums {actual}, registry declares "
+            f"{tuple(sorted(expected))} for backend "
+            f"'{jax.default_backend()}' — donation silently changed "
+            "(double-allocation on device, or a read of a buffer the "
+            "caller thinks it still owns)",
+        ))
+
+    for rule, message in raw:
+        reason = entry.allow.get(rule)
+        if reason is not None:
+            if not reason.strip():
+                findings.append(Finding(
+                    "KBT000", path, 0, 0,
+                    f"allow[{rule}] has no reason — suppression ignored",
+                ))
+            continue
+        findings.append(Finding(rule, path, 0, 0, message))
+    return findings
+
+
+def run_audit(
+    registry: Sequence[EntryPoint] = REGISTRY,
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Audit every registered entry point.  ``select`` restricts to a rule
+    subset (CLI --select parity with the static tier)."""
+    findings: List[Finding] = []
+    for entry in registry:
+        findings.extend(audit_entry(entry))
+    if select is not None:
+        wanted = set(select) | {"KBT000"}
+        findings = [f for f in findings if f.rule in wanted]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

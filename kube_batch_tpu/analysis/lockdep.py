@@ -27,10 +27,14 @@ metrics/) — stdlib and third-party locks are untouched. The pytest plugin
 (`kube_batch_tpu.analysis.pytest_plugin`) installs this for the whole
 suite and fails the run on violations.
 
-Deliberate scope limit (documented, not accidental): same-site nesting
-(two instances of one lock class) is skipped — the cache's per-object
-locks nest legitimately and we have no nesting annotations. It trades
-recall for zero false positives on the known-good suite.
+Same-site nesting (two instances of one lock class held at once) is a
+violation unless the region is wrapped in
+``utils.blocking.allow_nesting("reason")``: two instances of one class
+have no defined order between them, so undeclared nesting is an ordering
+claim nobody wrote down (PR 2 skipped this case wholesale; the annotation
+turns the skip into a validated declaration).  Sanctioned nesting records
+no self-edge — an instance-level order inside one class is the
+annotation's claim, not the graph's.
 """
 
 from __future__ import annotations
@@ -93,6 +97,9 @@ class LockdepState:
         # the transitive-cycle search)
         self._adj: Dict[str, set] = {}
         self.violations: List[Violation] = []
+        # sites whose undeclared same-site nesting already reported (one
+        # report per site, not one per occurrence)
+        self._nested_sites: set = set()
         self._local = threading.local()
 
     def _path(self, src: str, dst: str) -> Optional[List[str]]:
@@ -127,6 +134,29 @@ class LockdepState:
             if entry[1] == lock_id:
                 entry[2] += 1  # reentrant RLock acquire
                 return
+        # same-site nesting: a DIFFERENT instance of this lock class is
+        # already held.  Two instances of one class have no defined order,
+        # so the nesting is an ordering claim — valid only when declared
+        # via utils.blocking.allow_nesting("reason")
+        if (
+            any(e[0] == site for e in held)
+            and not _blocking.nesting_allowed()
+            and site not in self._nested_sites
+        ):
+            stack = _stack(skip=3)
+            with self._mu:
+                if site not in self._nested_sites:
+                    self._nested_sites.add(site)
+                    self.violations.append(Violation(
+                        "same-site-nesting",
+                        f"two instances of lock class {site} held by one "
+                        "thread without an allow_nesting declaration — "
+                        "per-object locks of one class have no defined "
+                        "order; wrap the region in utils.blocking."
+                        "allow_nesting(\"<order invariant>\") or impose a "
+                        "global order",
+                        stack,
+                    ))
         # membership probe OUTSIDE the bookkeeping lock and BEFORE paying
         # traceback formatting: steady state (every edge already recorded —
         # the cache bind loops re-acquire the same pairs constantly) is a
@@ -135,7 +165,10 @@ class LockdepState:
         candidates = [
             (hsite, site)
             for hsite, _hid, _d in held
-            if hsite != site  # same-site nesting skipped (module docstring)
+            # same-site pairs never enter the graph: a self-edge would be
+            # an instant cycle, and declared nesting (allow_nesting) is an
+            # instance-level claim, not a class-order edge
+            if hsite != site
             and (hsite, site) not in self.edges
         ]
         if candidates:

@@ -418,12 +418,14 @@ class HostSyncRule(Rule):
                            "value on device or annotate the sync point")
 
 
+from kube_batch_tpu.analysis.flowrules import FLOW_RULES  # noqa: E402
+
 ALL_RULES = (
     WallClockRule(),
     BlockingUnderLockRule(),
     ModuleStateRule(),
     FailOpenTranslateRule(),
     HostSyncRule(),
-)
+) + FLOW_RULES
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -27,6 +27,14 @@ tests/test_snapshot_delta.py checks the round-trip.
 Donation is skipped on the CPU backend (unsupported there; jax would warn
 every cycle).  The mesh-sharded solve path keeps full uploads — sharded
 scatter residency is a follow-on (ROADMAP).
+
+Donation audit (PR 4): every donating call site in this module rebinds the
+donated name to the call's result (``dev = _scatter_fn()(dev, ...)``) —
+the shape KBT006 (analysis/flowrules.py) verifies package-wide, so a
+post-donation read introduced later fails the tier-1 self-enforcement
+test.  The scatter itself is registered in the jaxpr audit
+(analysis/jaxpr_audit.py), which asserts its donation wiring per backend
+(KBT104) and that no f64/transfer/callback sneaks into the traced update.
 """
 
 from __future__ import annotations

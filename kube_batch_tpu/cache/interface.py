@@ -39,6 +39,27 @@ class StatusUpdater(Protocol):
 
 
 class VolumeBinder(Protocol):
+    """Scheduling-side volume seam (AllocateVolumes/BindVolumes) plus the
+    ingest surface the k8s watch feeds (pv/pvc/storageclass informer
+    analogs).  Structural: implementations do NOT subclass this, so the
+    declarations here are the contract, not inherited behavior.  A binder
+    that cannot ingest a kind (the standalone ledger has no PVC objects)
+    simply lacks the method — the translate layer's dispatcher logs the
+    drop loudly instead of failing open (KBT008)."""
+
     def allocate_volumes(self, task, hostname: str) -> None: ...
 
     def bind_volumes(self, task) -> None: ...
+
+    # -- ingest (fed by k8s/translate.apply_event) ----------------------
+    def add_pv(self, pv) -> None: ...
+
+    def delete_pv(self, name: str) -> None: ...
+
+    def add_pvc(self, pvc) -> None: ...
+
+    def delete_pvc(self, key: str) -> None: ...
+
+    def add_storage_class(self, name: str, provisioner: str) -> None: ...
+
+    def delete_storage_class(self, name: str) -> None: ...

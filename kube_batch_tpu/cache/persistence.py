@@ -85,9 +85,13 @@ def load_state(cache, path: str) -> bool:
     for p in state.get("pods", []):
         cache.add_pod(serialize.pod_from_dict(p))
     cache.pod_conditions.update(state.get("pod_conditions", {}))
-    add_pv = getattr(cache.volume_binder, "add_pv", None)
-    if add_pv is not None:
+    # capability = "carries a durable pv binding ledger", probed on the
+    # ledger itself — NOT on add_pv presence: the fake binder implements
+    # the full ingest surface as explicit no-ops (cache/interface.py), so
+    # a method probe would pass and then write into ledgers it lacks
+    binder = cache.volume_binder
+    if getattr(binder, "bound", None) is not None:
         for pv in state.get("pvs", []):
-            add_pv(PersistentVolume(**pv))
-        cache.volume_binder.bound.update(state.get("pv_bound", {}))
+            binder.add_pv(PersistentVolume(**pv))
+        binder.bound.update(state.get("pv_bound", {}))
     return True

@@ -457,6 +457,34 @@ def pvc_from_k8s(obj: dict) -> PersistentVolumeClaim:
 
 
 # watch "kind" → (translator, cache add, cache update, cache delete)
+# (binder type, method) pairs whose missing-ingest drop already logged —
+# one loud line per combination, not one per event storm
+_MISSING_INGEST_WARNED: set = set()
+
+
+def _volume_ingest(binder, method: str, *args) -> None:
+    """Dispatch one PV/PVC/StorageClass ingest event to the volume-binder
+    seam.  The surface is declared on cache/interface.VolumeBinder; a
+    binder lacking the method cannot ingest the event, and that is a REAL
+    drop (a standalone ledger fed --master PVC events loses bindings), so
+    it logs loudly once per (binder type, method) instead of silently
+    failing open — the round-5 PV bug shape, one layer up (KBT008)."""
+    # kbt: allow[KBT008] the one audited seam probe: a miss is logged below
+    # (observable drop), never silently swallowed
+    fn = getattr(binder, method, None)
+    if fn is None:
+        key = (type(binder).__name__, method)
+        if key not in _MISSING_INGEST_WARNED:
+            _MISSING_INGEST_WARNED.add(key)
+            logger.warning(
+                "volume binder %s has no %s(); dropping these ingest "
+                "events (volume topology decisions will not see them)",
+                type(binder).__name__, method,
+            )
+        return
+    fn(*args)
+
+
 def apply_event(cache, kind: str, event_type: str, obj: dict) -> None:
     """Dispatch one watch event into the cache — the informer handler seam
     (event_handlers.go). `kind` is the lowercase resource (pods, nodes,
@@ -504,31 +532,30 @@ def apply_event(cache, kind: str, event_type: str, obj: dict) -> None:
         else:
             cache.add_priority_class(priority_class_from_k8s(obj))
     elif kind == "persistentvolumes":
-        # PV ledger seam (cache.go:189-209); a binder without the ingest
-        # methods (the no-op fake) silently drops them, like the reference's
-        # fake volume binder
+        # PV ledger seam (cache.go:189-209), dispatched through
+        # _volume_ingest so a binder without the method drops LOUDLY
         binder = cache.volume_binder
         if deleted:
-            getattr(binder, "delete_pv", lambda _n: None)(
-                (obj.get("metadata") or {}).get("name", "")
+            _volume_ingest(
+                binder, "delete_pv", (obj.get("metadata") or {}).get("name", "")
             )
         else:
-            getattr(binder, "add_pv", lambda _pv: None)(pv_from_k8s(obj))
+            _volume_ingest(binder, "add_pv", pv_from_k8s(obj))
     elif kind == "persistentvolumeclaims":
         binder = cache.volume_binder
         pvc = pvc_from_k8s(obj)
         if deleted:
-            getattr(binder, "delete_pvc", lambda _k: None)(pvc.key())
+            _volume_ingest(binder, "delete_pvc", pvc.key())
         else:
-            getattr(binder, "add_pvc", lambda _p: None)(pvc)
+            _volume_ingest(binder, "add_pvc", pvc)
     elif kind == "storageclasses":
         binder = cache.volume_binder
         name = (obj.get("metadata") or {}).get("name", "")
         if deleted:
-            getattr(binder, "delete_storage_class", lambda _n: None)(name)
+            _volume_ingest(binder, "delete_storage_class", name)
         else:
-            getattr(binder, "add_storage_class", lambda _n, _p: None)(
-                name, obj.get("provisioner", "")
+            _volume_ingest(
+                binder, "add_storage_class", name, obj.get("provisioner", "")
             )
     else:
         logger.warning("unknown watch kind %r ignored", kind)

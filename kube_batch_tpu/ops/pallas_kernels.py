@@ -101,7 +101,10 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
     lval_c = lval[:, None]
     lhash_c = lhash[:, None]
     lbest_c = lbest[:, None]
-    lchose_c = jnp.where(lchose, 1.0, 0.0)[:, None]
+    # bool→f32 cast, not jnp.where(_, 1.0, 0.0): two weak Python floats
+    # promote to the DEFAULT float dtype — an f64 upcast the moment x64 is
+    # on (caught by the jaxpr audit, KBT101)
+    lchose_c = lchose.astype(jnp.float32)[:, None]
 
     # cross-tile merge through the revisited output blocks (the node-tile
     # grid axis iterates sequentially on TPU): strictly-better (val, hash)

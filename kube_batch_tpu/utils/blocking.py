@@ -1,11 +1,14 @@
-"""`allow_blocking` — the runtime analog of `# kbt: allow[...]` for the
-lockdep blocking-under-lock check (kube_batch_tpu/analysis/lockdep.py).
+"""`allow_blocking` / `allow_nesting` — the runtime analogs of
+`# kbt: allow[...]` for the lockdep checks (kube_batch_tpu/analysis/
+lockdep.py): the former fences a sound blocking region, the latter declares
+a deliberate same-site lock nesting (two instances of one lock class held
+at once — per-object locks acquired in a stable aggregate order).
 
 Lives in utils/ (stdlib-only, no analysis-package imports) because the
 RUNTIME core annotates with it — cache/volume.py fences its pv-writes
 submit — and pulling the AST lint engine into every scheduler process just
 to mark a sound blocking region would be backwards. The lockdep detector
-reads the same thread-local, so suppression works whether or not the
+reads the same thread-locals, so suppression works whether or not the
 detector is installed.
 """
 
@@ -14,25 +17,48 @@ from __future__ import annotations
 import contextlib
 import threading
 
-# allow_blocking() nesting depth, per thread
+# per-thread depth counters: separate switches, one per declaration kind —
+# a region sanctioned for same-site nesting is not thereby sanctioned to
+# block, and vice versa
 _blocking_ok = threading.local()
+_nesting_ok = threading.local()
 
 
 @contextlib.contextmanager
-def allow_blocking(reason: str):
-    """Suppress lockdep blocking-under-lock reports for the enclosed region.
-    `reason` is mandatory and should say why the block is sound (bounded,
-    ordering-fenced, one-time spawn...) — it is what a reviewer greps for,
-    exactly like the static `# kbt: allow[...]` annotations."""
+def _declared_region(local: threading.local, kind: str, reason: str):
+    """Shared depth-counted region: mandatory reason, reentrant, exception
+    safe.  `reason` is what a reviewer greps for, exactly like the static
+    `# kbt: allow[...]` annotations."""
     if not reason or not reason.strip():
-        raise ValueError("allow_blocking requires a non-empty reason")
-    depth = getattr(_blocking_ok, "depth", 0)
-    _blocking_ok.depth = depth + 1
+        raise ValueError(f"{kind} requires a non-empty reason")
+    depth = getattr(local, "depth", 0)
+    local.depth = depth + 1
     try:
         yield
     finally:
-        _blocking_ok.depth = depth
+        local.depth = depth
+
+
+def allow_blocking(reason: str):
+    """Suppress lockdep blocking-under-lock reports for the enclosed region.
+    The reason should say why the block is sound (bounded, ordering-fenced,
+    one-time spawn...)."""
+    return _declared_region(_blocking_ok, "allow_blocking", reason)
 
 
 def blocking_allowed() -> bool:
     return getattr(_blocking_ok, "depth", 0) > 0
+
+
+def allow_nesting(reason: str):
+    """Declare that same-site lock nesting inside this region is deliberate
+    — e.g. two per-object locks of one class acquired in a stable aggregate
+    order.  Without the declaration the lockdep detector reports same-site
+    nesting as an order violation (two instances of one class have no
+    defined order, so the nesting IS an undeclared ordering claim).  The
+    reason should name the order invariant that makes the nesting sound."""
+    return _declared_region(_nesting_ok, "allow_nesting", reason)
+
+
+def nesting_allowed() -> bool:
+    return getattr(_nesting_ok, "depth", 0) > 0

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# kbt-check, both tiers: the static AST/flow rules over the package tree
+# AND the jaxpr-level audit of the registered jitted entry points.
+# Exit 0 = clean, 1 = findings, 2 = usage error (same contract as the CLI).
+#
+# CI usage:  scripts/check.sh [--jsonl]
+# The jaxpr tier imports jax; pin it to CPU so the check never touches (or
+# hangs on) an accelerator tunnel — tracing is abstract, the backend only
+# matters for the donation table, and CPU is the declared-() baseline.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr "$@"

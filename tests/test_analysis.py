@@ -305,6 +305,606 @@ class TestKBT005:
 
 
 # ---------------------------------------------------------------------------
+# KBT006 — donated-buffer use after donation
+# ---------------------------------------------------------------------------
+
+
+class TestKBT006:
+    BAD = """
+    import jax
+
+    scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+    def refresh(dev, rows):
+        out = scatter(dev, rows)
+        total = dev.sum()
+        return out, total
+    """
+
+    def test_read_after_donation_triggers(self):
+        findings = findings_for(self.BAD, "api/x.py")
+        assert rule_ids(findings) == ["KBT006"]
+        assert "donated" in findings[0].message
+
+    def test_rebind_to_result_is_the_sanctioned_shape(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(dev, rows):
+            dev = scatter(dev, rows)
+            return dev.sum()
+        """
+        assert findings_for(src, "api/x.py") == []
+
+    def test_alias_of_donated_buffer_is_caught(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(dev, rows):
+            alias = dev
+            out = scatter(dev, rows)
+            return out, alias.sum()
+        """
+        assert rule_ids(findings_for(src, "api/x.py")) == ["KBT006"]
+
+    def test_reassignment_clears_the_taint(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(dev, rows, host):
+            scatter(dev, rows)
+            dev = host
+            return dev.sum()
+        """
+        assert findings_for(src, "api/x.py") == []
+
+    def test_factory_returned_donating_callable_is_tracked(self):
+        # the api/resident.py shape: a memoized factory returns the
+        # donating jitted scatter; calling `_fn()(dev, ...)` donates arg 0
+        src = """
+        import jax
+
+        _S = None
+
+        def _fn():
+            global _S
+            if _S is None:
+                _S = jax.jit(lambda d, r: d.at[r].set(0.0),
+                             donate_argnums=(0,))
+            return _S
+
+        def refresh(dev, rows):
+            out = _fn()(dev, rows)
+            return out, dev.sum()
+        """
+        assert rule_ids(findings_for(src, "api/x.py")) == ["KBT006"]
+
+    def test_conditional_donate_tuple_still_tracks(self):
+        # backend-conditional donation (the resident scatter's CPU gate)
+        # folds may-style: a position that CAN donate is tracked
+        src = """
+        import jax
+
+        donate = () if backend() == "cpu" else (0,)
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0),
+                          donate_argnums=donate)
+
+        def refresh(dev, rows):
+            out = scatter(dev, rows)
+            return out, dev.sum()
+        """
+        assert rule_ids(findings_for(src, "api/x.py")) == ["KBT006"]
+
+    def test_annotation_suppresses(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(dev, rows):
+            out = scatter(dev, rows)
+            # kbt: allow[KBT006] cpu-only path, donation is a no-op there
+            return out, dev.sum()
+        """
+        assert findings_for(src, "api/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT007 — jit retrace hazards
+# ---------------------------------------------------------------------------
+
+
+class TestKBT007:
+    def test_jit_wrapper_in_function_body_triggers(self):
+        src = """
+        import jax
+
+        def solve(snap):
+            fn = jax.jit(lambda s: s * 2)
+            return fn(snap)
+        """
+        findings = findings_for(src, "ops/x.py")
+        assert rule_ids(findings) == ["KBT007"]
+        assert "fresh compile cache" in findings[0].message
+
+    def test_memoized_wrapper_is_clean(self):
+        # the parallel/mesh.py _jit_cache pattern
+        src = """
+        import jax
+
+        _cache = {}
+
+        def solve(snap, key):
+            fn = _cache.get(key)
+            if fn is None:
+                fn = jax.jit(lambda s: s * 2)
+                _cache[key] = fn
+            return fn(snap)
+        """
+        assert findings_for(src, "parallel/x.py") == []
+
+    def test_global_memo_is_clean(self):
+        # the api/resident.py _scatter_fn pattern
+        src = """
+        import jax
+
+        _S = None
+
+        def _fn():
+            global _S
+            if _S is None:
+                _S = jax.jit(lambda d: d * 2)
+            return _S
+        """
+        assert findings_for(src, "api/x.py") == []
+
+    def test_lru_cached_builder_is_clean(self):
+        src = """
+        import jax
+        from functools import lru_cache
+
+        @lru_cache(maxsize=8)
+        def builder(key):
+            return jax.jit(lambda s: s * 2)
+        """
+        assert findings_for(src, "parallel/x.py") == []
+
+    def test_unhashable_static_literal_at_call_site_triggers(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("opts",))
+        def solve(snap, opts):
+            return snap
+
+        def run(snap):
+            return solve(snap, opts={"a": 1})
+        """
+        findings = findings_for(src, "ops/x.py")
+        assert rule_ids(findings) == ["KBT007"]
+        assert "unhashable" in findings[0].message
+
+    def test_shape_derived_static_arg_triggers(self):
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def solve(snap, n):
+            return snap
+
+        def run(snap, xs):
+            return solve(snap, n=len(xs))
+        """
+        findings = findings_for(src, "ops/x.py")
+        assert rule_ids(findings) == ["KBT007"]
+        assert "shape-derived" in findings[0].message
+
+    def test_namedtuple_static_arg_is_clean(self):
+        # the AllocateConfig shape: hashable, stable cache key
+        src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("config",))
+        def solve(snap, config):
+            return snap
+
+        def run(snap, config):
+            return solve(snap, config=config)
+        """
+        assert findings_for(src, "ops/x.py") == []
+
+    def test_jitted_closure_over_mutable_module_state_triggers(self):
+        src = """
+        import jax
+
+        # kbt: allow[KBT003] fixture registry
+        _weights = {}
+
+        @jax.jit
+        def solve(snap):
+            return snap * _weights["w"]
+        """
+        findings = findings_for(src, "ops/x.py")
+        assert rule_ids(findings) == ["KBT007"]
+        assert "baked in at trace time" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# KBT008 — fail-open seam probes in k8s/
+# ---------------------------------------------------------------------------
+
+
+class TestKBT008:
+    def test_defaulted_getattr_probe_triggers(self):
+        src = """
+        def apply(binder, obj):
+            getattr(binder, "add_pv", None)(obj)
+        """
+        findings = findings_for(src, "k8s/x.py")
+        assert rule_ids(findings) == ["KBT008"]
+        assert "'add_pv'" in findings[0].message
+
+    def test_lambda_default_probe_triggers(self):
+        src = """
+        def apply(binder, obj):
+            getattr(binder, "add_pv", lambda _o: None)(obj)
+        """
+        assert rule_ids(findings_for(src, "k8s/x.py")) == ["KBT008"]
+
+    def test_two_arg_getattr_is_fine(self):
+        # no default: a missing attribute raises — fail closed
+        src = """
+        def apply(binder, obj):
+            getattr(binder, "add_pv")(obj)
+        """
+        assert findings_for(src, "k8s/x.py") == []
+
+    def test_dispatch_table_get_probe_triggers(self):
+        src = """
+        def route(handlers, kind, obj):
+            handlers.get(kind)(obj)
+        """
+        assert rule_ids(findings_for(src, "k8s/x.py")) == ["KBT008"]
+
+    def test_out_of_scope_probe_unflagged(self):
+        src = """
+        def probe(cache):
+            return getattr(cache, "flush_binds", None)
+        """
+        assert findings_for(src, "framework/x.py") == []
+
+    def test_annotated_capability_probe_is_fine(self):
+        src = """
+        def reconcile(binder):
+            # kbt: allow[KBT008] capability probe: absence means no ledger
+            pvs = getattr(binder, "pvs", None)
+            return pvs
+        """
+        assert findings_for(src, "k8s/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT009 — telemetry clock outside metrics-feeding expressions
+# ---------------------------------------------------------------------------
+
+
+class TestKBT009:
+    def test_telemetry_value_in_control_flow_triggers(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def pace(self):
+            t0 = telemetry.perf_counter()
+            self.work()
+            if telemetry.perf_counter() - t0 > 1.0:
+                self.abort()
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert rule_ids(findings) == ["KBT009"]
+
+    def test_metrics_feeding_span_is_the_sanctioned_shape(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+        from kube_batch_tpu import metrics
+
+        def timed(self):
+            t0 = telemetry.perf_counter()
+            self.work()
+            metrics.observe_e2e_latency(
+                (telemetry.perf_counter() - t0) * 1e3
+            )
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_unused_binding_is_a_dead_wall_clock_read(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def f(self):
+            t0 = telemetry.perf_counter()
+            self.work()
+        """
+        findings = findings_for(src, "framework/x.py")
+        assert rule_ids(findings) == ["KBT009"]
+        assert "never read" in findings[0].message
+
+    def test_sink_accumulation_is_clean(self):
+        # the allocate action's _PhaseMarks shape: the value flows into an
+        # ms sink and the next-mark attribute store
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def mark(self, key):
+            now = telemetry.perf_counter()
+            self.sink[key] = self.sink.get(key, 0.0) + (now - self.t) * 1e3
+            self.t = now
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_read_after_branch_join_is_not_dead(self):
+        # review-found FP shape: the binding happens in one branch and the
+        # read after the join lands on the merge's union cell — the
+        # dead-read check must key on the bind SITE, not cell identity
+        src = """
+        from kube_batch_tpu.utils import telemetry
+        from kube_batch_tpu import metrics
+
+        def f(self, cond):
+            t0 = 0.0
+            if cond:
+                t0 = telemetry.perf_counter()
+            metrics.observe_e2e_latency(t0)
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_loop_carried_read_is_not_dead(self):
+        # review-found FP shape: the next iteration reads the previous
+        # iteration's binding (two-pass loop walk rebinds the cell)
+        src = """
+        from kube_batch_tpu.utils import telemetry
+        from kube_batch_tpu import metrics
+
+        def f(self, items):
+            prev = telemetry.perf_counter()
+            for item in items:
+                self.work(item)
+                metrics.observe_e2e_latency(prev)
+                prev = telemetry.perf_counter()
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_out_of_scope_unflagged(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def f():
+            t0 = telemetry.perf_counter()
+        """
+        assert findings_for(src, "testing/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# KBT010 — host-device sync on resident values in the action layer
+# ---------------------------------------------------------------------------
+
+
+class TestKBT010:
+    def test_asarray_on_solve_result_triggers(self):
+        src = """
+        import numpy as np
+        from kube_batch_tpu.ops.assignment import allocate_solve
+
+        def read(snap, config):
+            result = allocate_solve(snap, config)
+            return np.asarray(result)
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert rule_ids(findings) == ["KBT010"]
+
+    def test_attribute_of_result_is_still_the_result(self):
+        src = """
+        import numpy as np
+        from kube_batch_tpu.ops.eviction import evict_solve
+
+        def read(snap, config):
+            result = evict_solve(snap, config)
+            return np.asarray(result.claim_node)
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+
+    def test_device_get_is_always_a_choke_point(self):
+        src = """
+        import jax
+
+        def read(result):
+            return jax.device_get(result.assigned)
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+
+    def test_asarray_on_host_snapshot_is_fine(self):
+        # the flow-awareness KBT005 lacks: host-backed snap reads are free
+        src = """
+        import numpy as np
+
+        def read(snap):
+            return np.asarray(snap.task_job)
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_item_on_device_value_triggers(self):
+        src = """
+        from kube_batch_tpu.ops.assignment import failure_histogram_solve
+
+        def read(snap):
+            hist = failure_histogram_solve(snap)
+            return hist.item()
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+
+    def test_taint_survives_branch_merge(self):
+        src = """
+        import numpy as np
+        from kube_batch_tpu.ops.assignment import failure_histogram_solve
+
+        def read(snap, wanted):
+            hist = None
+            if wanted:
+                hist = failure_histogram_solve(snap)
+            return np.asarray(hist)
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+
+    def test_annotation_marks_the_sanctioned_readback(self):
+        src = """
+        import jax
+
+        def read(result):
+            # kbt: allow[KBT010] the cycle's one blocking readback
+            return jax.device_get(result.assigned)
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_out_of_scope_sync_unflagged(self):
+        src = """
+        import jax
+
+        def read(result):
+            return jax.device_get(result)
+        """
+        assert findings_for(src, "testing/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# dataflow: the def-use engine itself
+# ---------------------------------------------------------------------------
+
+
+class TestDataflow:
+    @staticmethod
+    def _run(src: str):
+        """Walk `f` in `src` with a tiny taint visitor: `taint(x)` taints
+        x's cell, every load of a tainted name is recorded."""
+        import ast as _ast
+
+        from kube_batch_tpu.analysis.dataflow import (
+            FlowVisitor,
+            walk_function,
+        )
+
+        tree = _ast.parse(textwrap.dedent(src))
+        func = next(n for n in _ast.walk(tree)
+                    if isinstance(n, _ast.FunctionDef) and n.name == "f")
+        hits = []
+
+        class V(FlowVisitor):
+            def on_call(self, ev, env):
+                call = ev.node
+                if (isinstance(call.func, _ast.Name)
+                        and call.func.id == "taint"):
+                    for a in call.args:
+                        if isinstance(a, _ast.Name) and a.id in env:
+                            env[a.id]["t"] = True
+
+            def on_load(self, ev, env):
+                if ev.cell is not None and ev.cell.get("t"):
+                    hits.append((ev.name, ev.node.lineno))
+
+        walk_function(func, V())
+        return hits
+
+    def test_alias_shares_the_cell(self):
+        hits = self._run("""
+        def f(a):
+            b = a
+            taint(a)
+            return b
+        """)
+        assert [h[0] for h in hits] == ["b"]
+
+    def test_reassignment_rebinds_to_a_fresh_cell(self):
+        hits = self._run("""
+        def f(a, c):
+            taint(a)
+            a = c
+            return a
+        """)
+        assert hits == []
+
+    def test_branch_taint_survives_the_join(self):
+        hits = self._run("""
+        def f(a, cond):
+            if cond:
+                taint(a)
+            return a
+        """)
+        assert [h[0] for h in hits] == ["a"]
+
+    def test_clean_rebind_in_one_branch_does_not_launder(self):
+        hits = self._run("""
+        def f(a, c, cond):
+            taint(a)
+            if cond:
+                a = c
+            return a
+        """)
+        assert [h[0] for h in hits] == ["a"]
+
+    def test_loop_bottom_taint_reaches_the_top(self):
+        hits = self._run("""
+        def f(a, xs):
+            for x in xs:
+                y = a + 1
+                taint(a)
+            return y
+        """)
+        assert ("a", 4) in hits  # second pass sees the taint
+
+    def test_tuple_unpack_from_call_taints_every_target(self):
+        hits = self._run("""
+        def f(a):
+            taint(a)
+            x, y = a
+            return x, y
+        """)
+        names = {h[0] for h in hits}
+        assert {"a", "x", "y"} <= names
+
+    def test_match_arm_bodies_are_walked(self):
+        # review-found soundness hole: unhandled statement types were
+        # silently skipped, blinding every flow rule inside match blocks
+        hits = self._run("""
+        def f(a, mode):
+            taint(a)
+            match mode:
+                case "x":
+                    return a
+                case _:
+                    return None
+        """)
+        assert [h[0] for h in hits] == ["a"]
+
+    def test_match_capture_binds_fresh_and_guard_is_a_test(self):
+        hits = self._run("""
+        def f(a, mode):
+            taint(a)
+            match mode:
+                case str() as a:
+                    return a
+        """)
+        # the capture rebinds `a` to a fresh cell inside the arm
+        assert hits == []
+
+
+# ---------------------------------------------------------------------------
 # engine: suppression contract
 # ---------------------------------------------------------------------------
 
@@ -365,6 +965,18 @@ class TestSelfEnforcement:
             # each rule documents the incident that motivated it
             assert rule.__doc__ and len(rule.__doc__.strip()) > 40
 
+    def test_all_ten_rules_are_registered(self):
+        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 11)]
+
+    def test_jaxpr_registry_has_zero_unsuppressed_findings(self):
+        # tier B self-enforcement: every registered jitted entry point
+        # traces clean (no f64 upcast, no in-graph transfer, no host
+        # callback, declared donation intact)
+        from kube_batch_tpu.analysis.jaxpr_audit import run_audit
+
+        findings = run_audit()
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
 
 # ---------------------------------------------------------------------------
 # CLI: exit codes + JSONL
@@ -402,3 +1014,36 @@ class TestCli:
         proc = self._run("no/such/dir")
         assert proc.returncode == 1
         assert "does not exist" in proc.stdout
+
+    def test_jaxpr_tier_clean_exits_zero(self):
+        proc = self._run("--jaxpr-only")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_jaxpr_select_parity(self):
+        # KBT10x ids route to the audit tier; --jsonl shapes match tier A
+        proc = self._run("--select", "KBT104", "--jsonl")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = self._run("--select", "KBT999")
+        assert proc.returncode == 2
+
+    def test_static_only_select_skips_the_audit_instead_of_gagging_it(
+            self, monkeypatch):
+        # review finding: `--jaxpr --select KBT001` used to trace every
+        # entry point and then discard all audit findings — CI would
+        # believe the tier ran while a donation regression passed.  A
+        # selection with no audit ids now skips the audit outright
+        from kube_batch_tpu.analysis import __main__ as cli
+        from kube_batch_tpu.analysis import jaxpr_audit
+
+        def boom(*a, **k):
+            raise AssertionError("audit must not run for a static-only select")
+
+        monkeypatch.setattr(jaxpr_audit, "run_audit", boom)
+        rc = cli.main(["--jaxpr", "--select", "KBT001",
+                       "kube_batch_tpu/analysis"])
+        assert rc == 0
+
+    def test_list_rules_includes_both_tiers(self):
+        proc = self._run("--list-rules")
+        assert proc.returncode == 0
+        assert "KBT010" in proc.stdout and "KBT101" in proc.stdout

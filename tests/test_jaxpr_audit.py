@@ -1,0 +1,154 @@
+"""Tier B jaxpr audit: the production registry must trace clean, and each
+check must catch its planted bug — a deliberate f64 upcast, an in-graph
+transfer, a host callback, and a donation mismatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_batch_tpu.analysis.jaxpr_audit import (
+    AUDIT_RULES,
+    REGISTRY,
+    EntryPoint,
+    audit_entry,
+    run_audit,
+)
+
+
+def _entry(name, build, **kw):
+    return EntryPoint(name=name, build=build, **kw)
+
+
+def _vec():
+    from jax import ShapeDtypeStruct as S
+
+    return S((8,), jnp.float32)
+
+
+class TestRegistryClean:
+    def test_production_registry_has_zero_findings(self):
+        findings = run_audit()
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+    def test_registry_covers_the_hot_path(self):
+        names = {e.name for e in REGISTRY}
+        assert any("allocate_solve" in n for n in names)
+        assert any("evict_solve" in n for n in names)
+        assert any("resident" in n for n in names)
+        assert any("pallas" in n for n in names)
+
+
+class TestPlantedBugs:
+    def test_planted_f64_upcast_is_detected(self):
+        # np.float64 scalar promotes the whole expression under x64 — the
+        # exact hazard class the pallas round head shipped (fixed this PR)
+        def build():
+            fn = jax.jit(lambda x: x * np.float64(2.0))
+            return fn, (_vec(),)
+
+        findings = audit_entry(_entry("planted.f64", build))
+        assert [f.rule for f in findings] == ["KBT101"]
+        assert "float64" in findings[0].message
+
+    def test_planted_astype_f64_is_detected(self):
+        def build():
+            fn = jax.jit(lambda x: x.astype(jnp.float64).sum())
+            return fn, (_vec(),)
+
+        findings = audit_entry(_entry("planted.astype", build))
+        assert [f.rule for f in findings] == ["KBT101"]
+
+    def test_planted_concrete_device_put_is_detected(self):
+        dev = jax.devices()[0]
+
+        def build():
+            fn = jax.jit(lambda x: jax.device_put(x, dev) + 1.0)
+            return fn, (_vec(),)
+
+        findings = audit_entry(_entry("planted.transfer", build))
+        assert [f.rule for f in findings] == ["KBT102"]
+
+    def test_planted_host_callback_is_detected(self):
+        def build():
+            def f(x):
+                y = jax.pure_callback(
+                    lambda v: np.asarray(v),
+                    jax.ShapeDtypeStruct((8,), jnp.float32), x,
+                )
+                return y + 1.0
+
+            return jax.jit(f), (_vec(),)
+
+        findings = audit_entry(_entry("planted.callback", build))
+        assert [f.rule for f in findings] == ["KBT103"]
+
+    def test_planted_donation_mismatch_is_detected(self):
+        # registry says "donates arg 0 on every backend"; the wrapper
+        # doesn't — the silent-regression shape KBT104 exists for
+        def build():
+            fn = jax.jit(lambda d, r: d.at[r].set(0.0))
+            return fn, (_vec(), jax.ShapeDtypeStruct((2,), jnp.int32))
+
+        findings = audit_entry(
+            _entry("planted.donation", build, donate={"*": (0,)}))
+        assert [f.rule for f in findings] == ["KBT104"]
+
+    def test_declared_donation_passes(self):
+        def build():
+            fn = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+            return fn, (_vec(), jax.ShapeDtypeStruct((2,), jnp.int32))
+
+        findings = audit_entry(
+            _entry("planted.donation_ok", build, donate={"*": (0,)}))
+        assert findings == []
+
+    def test_broken_entry_reports_instead_of_reading_clean(self):
+        def build():
+            raise RuntimeError("registry rot")
+
+        findings = audit_entry(_entry("planted.broken", build))
+        assert [f.rule for f in findings] == ["KBT000"]
+        assert "failed to trace" in findings[0].message
+
+
+class TestSuppression:
+    def _f64_entry(self, allow):
+        def build():
+            fn = jax.jit(lambda x: x * np.float64(2.0))
+            return fn, (_vec(),)
+
+        return _entry("planted.sup", build, allow=allow)
+
+    def test_allow_with_reason_suppresses(self):
+        findings = audit_entry(
+            self._f64_entry({"KBT101": "fixture: deliberate upcast"}))
+        assert findings == []
+
+    def test_allow_without_reason_is_itself_a_finding(self):
+        findings = audit_entry(self._f64_entry({"KBT101": "  "}))
+        assert [f.rule for f in findings] == ["KBT000"]
+
+    def test_select_filters_audit_rules(self):
+        entry = self._f64_entry({})
+        findings = run_audit(registry=[entry], select=["KBT102"])
+        assert findings == []
+        findings = run_audit(registry=[entry], select=["KBT101"])
+        assert [f.rule for f in findings] == ["KBT101"]
+
+
+class TestCatalog:
+    def test_audit_rules_documented(self):
+        assert set(AUDIT_RULES) == {"KBT101", "KBT102", "KBT103", "KBT104"}
+        for title in AUDIT_RULES.values():
+            assert title
+
+
+@pytest.mark.slow
+class TestTiming:
+    def test_full_audit_is_subsecond_after_warm_import(self):
+        import time
+
+        t0 = time.perf_counter()
+        run_audit()
+        assert time.perf_counter() - t0 < 10.0

@@ -567,6 +567,73 @@ class TestVolumeK8sMode:
             SELECTED_NODE_ANNOTATION] == "node-a"
 
 
+class TestVolumeIngestSeam:
+    """The _volume_ingest dispatcher (KBT008 dogfood, PR 4): a binder
+    lacking an ingest method drops the event LOUDLY — one warning per
+    (binder type, method), never a silent getattr miss — and a complete
+    binder receives every call."""
+
+    def test_missing_method_warns_once_and_does_not_raise(self, caplog):
+        import logging
+
+        from kube_batch_tpu.cache.volume import StandalonePVBinder
+        from kube_batch_tpu.k8s.translate import (
+            _MISSING_INGEST_WARNED,
+            apply_event,
+        )
+
+        # the standalone ledger has no PVC objects — --master PVC events
+        # reaching it are real drops and must be observable
+        cache = SchedulerCache(volume_binder=StandalonePVBinder())
+        assert not hasattr(cache.volume_binder, "add_pvc")
+        _MISSING_INGEST_WARNED.clear()
+        with caplog.at_level(logging.WARNING, logger="kube_batch_tpu"):
+            apply_event(cache, "persistentvolumeclaims", "ADDED",
+                        FIXTURES["pvc_unbound"])
+            apply_event(cache, "persistentvolumeclaims", "ADDED",
+                        FIXTURES["pvc_dynamic"])
+        drops = [r for r in caplog.records if "has no add_pvc" in r.message]
+        assert len(drops) == 1  # warn-once per (type, method), not per event
+        assert "dropping" in drops[0].message
+
+    def test_complete_binder_receives_the_dispatch(self, caplog):
+        import logging
+
+        from kube_batch_tpu.cache.volume import K8sPVLedger
+        from kube_batch_tpu.k8s.translate import apply_event
+
+        cache = SchedulerCache(volume_binder=K8sPVLedger())
+        with caplog.at_level(logging.WARNING, logger="kube_batch_tpu"):
+            apply_event(cache, "persistentvolumeclaims", "ADDED",
+                        FIXTURES["pvc_unbound"])
+            apply_event(cache, "storageclasses", "ADDED",
+                        FIXTURES["storageclass_local"])
+            apply_event(cache, "persistentvolumes", "ADDED",
+                        FIXTURES["pv_local"])
+        assert cache.volume_binder.claims
+        assert cache.volume_binder.storage_classes
+        assert cache.volume_binder.pvs
+        assert not [r for r in caplog.records if "has no " in r.message]
+
+    def test_fake_binder_is_a_complete_silent_seam(self, caplog):
+        import logging
+
+        from kube_batch_tpu.k8s.translate import apply_event
+
+        # the default fake implements the full ingest surface as explicit
+        # no-ops (cache/interface.py) — no warnings, nothing stored
+        cache = SchedulerCache()
+        with caplog.at_level(logging.WARNING, logger="kube_batch_tpu"):
+            apply_event(cache, "persistentvolumes", "ADDED",
+                        FIXTURES["pv_local"])
+            apply_event(cache, "persistentvolumeclaims", "DELETED",
+                        FIXTURES["pvc_unbound"])
+            apply_event(cache, "storageclasses", "DELETED",
+                        FIXTURES["storageclass_local"])
+        assert not [r for r in caplog.records if "has no " in r.message]
+        assert cache.volume_binder.pvs == {}
+
+
 class TestEventFuzz:
     def test_shuffled_duplicate_events_keep_cache_consistent(self):
         """Watch streams can deliver duplicates and orderings the happy path

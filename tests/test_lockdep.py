@@ -75,19 +75,81 @@ class TestOrderInversion:
         assert state.violations == []
         assert state.edges == {}
 
-    def test_same_site_two_instances_skipped_by_design(self):
-        # per-object locks of one class nest legitimately; without nesting
-        # annotations this is deliberately out of scope (module docstring)
+    def test_same_site_nesting_without_declaration_is_flagged(self):
+        # two instances of one lock class have no defined order — PR 2
+        # skipped this wholesale; since PR 4 undeclared nesting reports
         state = LockdepState()
         x1 = TrackedLock(state, "S")
         x2 = TrackedLock(state, "S")
         with x1:
             with x2:
                 pass
-        with x2:
+        assert [v.kind for v in state.violations] == ["same-site-nesting"]
+        assert "S" in state.violations[0].description
+        assert "allow_nesting" in state.violations[0].description
+        # no self-edge enters the order graph (it would be an instant cycle)
+        assert ("S", "S") not in state.edges
+
+    def test_same_site_nesting_reported_once_per_site(self):
+        state = LockdepState()
+        x1 = TrackedLock(state, "S")
+        x2 = TrackedLock(state, "S")
+        for _ in range(3):
             with x1:
-                pass
+                with x2:
+                    pass
+        assert len(state.violations) == 1
+
+    def test_allow_nesting_declares_the_order(self):
+        from kube_batch_tpu.utils.blocking import allow_nesting
+
+        state = LockdepState()
+        x1 = TrackedLock(state, "S")
+        x2 = TrackedLock(state, "S")
+        with allow_nesting("aggregate lock order: acquired sorted by uid"):
+            with x1:
+                with x2:
+                    pass
         assert state.violations == []
+
+    def test_allow_nesting_requires_a_reason(self):
+        import pytest
+
+        from kube_batch_tpu.utils.blocking import allow_nesting
+
+        with pytest.raises(ValueError):
+            with allow_nesting("  "):
+                pass
+
+    def test_allow_nesting_does_not_sanction_blocking(self):
+        # the two annotations are separate switches: a nesting-sanctioned
+        # region still reports blocking-under-lock
+        from kube_batch_tpu.utils.blocking import allow_nesting
+
+        state = LockdepState()
+        x1 = TrackedLock(state, "S")
+        x2 = TrackedLock(state, "S")
+        with allow_nesting("declared nesting for this test"):
+            with x1:
+                with x2:
+                    state.on_blocking_call("time.sleep(0.1)")
+        assert [v.kind for v in state.violations] == ["blocking-under-lock"]
+
+    def test_cross_site_order_still_checked_inside_allow_nesting(self):
+        # the annotation declares SAME-site nesting only; a cross-site
+        # inversion inside the region must still report
+        from kube_batch_tpu.utils.blocking import allow_nesting
+
+        state = LockdepState()
+        a, b = _locks(state, "A", "B")
+        with a:
+            with b:
+                pass
+        with allow_nesting("same-site declaration must not mask this"):
+            with b:
+                with a:
+                    pass
+        assert [v.kind for v in state.violations] == ["order-inversion"]
 
     def test_transitive_three_lock_cycle_is_flagged(self):
         # A→B, B→C recorded with no direct two-lock inversion anywhere;
