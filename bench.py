@@ -70,6 +70,9 @@ import numpy as np  # noqa: E402
 
 from kube_batch_tpu import actions as _actions  # noqa: E402,F401 — registers
 from kube_batch_tpu import plugins as _plugins  # noqa: E402,F401 — registers
+from kube_batch_tpu.api.resident import (  # noqa: E402
+    scatter_summary as _resident_scatter_summary,
+)
 from kube_batch_tpu.framework.conf import load_scheduler_conf  # noqa: E402
 from kube_batch_tpu.framework.session import close_session, open_session  # noqa: E402
 from kube_batch_tpu.framework.interface import get_action  # noqa: E402
@@ -215,20 +218,32 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
     def warm_failure_histogram():
         """The fit-error histogram only dispatches on cycles with unplaced
         pending tasks, which may first occur mid-steady-state — compile it
-        during warmup so the zero-retrace claim covers failure cycles too."""
+        during warmup so the zero-retrace claim covers failure cycles too.
+        Warms the variant the allocate dispatch would actually pick, so a
+        sharded run doesn't warm (and hold resident copies for) the wrong
+        path."""
         from kube_batch_tpu.actions.allocate import build_session_snapshot
         from kube_batch_tpu.api.columns import resident_snap
         from kube_batch_tpu.ops.assignment import failure_histogram_solve
         from kube_batch_tpu.framework.session import (
             close_session as _close, open_session as _open,
         )
+        from kube_batch_tpu.parallel.mesh import (
+            default_mesh, sharded_failure_histogram, should_shard,
+        )
 
         ssn = _open(cache, conf.tiers)
         try:
             snap, _ = build_session_snapshot(ssn)
-            failure_histogram_solve(
-                resident_snap(cache.columns, snap)
-            ).block_until_ready()
+            if should_shard(snap.node_alloc.shape[0]):
+                mesh = default_mesh()
+                sharded_failure_histogram(
+                    resident_snap(cache.columns, snap, mesh), mesh
+                ).block_until_ready()
+            else:
+                failure_histogram_solve(
+                    resident_snap(cache.columns, snap)
+                ).block_until_ready()
         finally:
             _close(ssn)
 
@@ -291,6 +306,13 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         "snapshot_paths": paths,
         "retraces_steady": sum(r["compiles"] for r in steady),
         "jit_compile_counts": jitstats.compile_counts(),
+        # which solve the cycles dispatched ("single" | "sharded") and the
+        # per-cycle device-resident cache's delta-vs-full bytes-moved
+        # evidence, per path (api/resident.py counters)
+        "solve_mode": get_action("allocate").last_solve_mode,
+        "resident_scatter": _resident_scatter_summary(
+            cache.columns.resident_counters()
+        ),
     }
 
 
@@ -307,7 +329,36 @@ def run_multicycle_pair(conf, n_tasks, n_nodes, cycles=8):
     return mc_delta, mc_full, reduction
 
 
+def sharded_multicycle(conf, n_tasks, n_nodes, cycles=6):
+    """The sharded steady-state section: the multicycle regime (persistent
+    cache, 2% churn, ±10% wobble) dispatched over the device mesh — reports
+    the per-shard delta-vs-full upload reduction and the retrace counters.
+    Requires ≥2 devices and a node axis past the shard gate."""
+    import jax
+
+    from kube_batch_tpu.parallel.mesh import SHARD_MIN_NODES
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "single-device backend"}
+    if n_nodes < SHARD_MIN_NODES:
+        return {"skipped": f"node axis below shard gate ({SHARD_MIN_NODES})"}
+    rep = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+    if rep.get("solve_mode") != "sharded":
+        rep["warning"] = "solve did not dispatch sharded"
+    return rep
+
+
 def main() -> None:
+    if os.environ.get("KB_BENCH_SHARDED_CHILD") == "1":
+        # forced-host-device child (CPU fallback's sharded evidence): a
+        # small sharded steady-state run, one JSON line on stdout
+        conf = load_scheduler_conf(None)
+        print(json.dumps(
+            {"multicycle_sharded": sharded_multicycle(conf, 2000, 600,
+                                                      cycles=6)}
+        ))
+        return
+
     start = time.perf_counter()
     # soft deadline for the optional sections: the headline number and the
     # TPU capture must land even if compiles run long — better a JSON line
@@ -363,6 +414,23 @@ def main() -> None:
             result["multicycle_open_snapshot_reduction"] = red
         except Exception as e:  # noqa: BLE001 — the JSON line must land
             result["multicycle_error"] = f"{type(e).__name__}: {e}"
+        # sharded steady-state evidence on a forced 4-device host mesh — a
+        # child process, because the device count must be fixed before the
+        # child's jax initializes (this process is already single-device)
+        try:
+            from kube_batch_tpu.envutil import hardened_cpu_env
+
+            env = hardened_cpu_env(n_devices=4)
+            env.update(KB_BENCH_CHILD="1", KB_BENCH_SHARDED_CHILD="1")
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=600,
+            )
+            line = out.stdout.strip().splitlines()[-1]
+            result["multicycle_sharded"] = json.loads(line)[
+                "multicycle_sharded"]
+        except Exception as e:  # noqa: BLE001
+            result["multicycle_sharded_error"] = f"{type(e).__name__}: {e}"
         # the go-loop denominators are CPU measurements — valid evidence
         # even on a wedged tunnel; the meaningful ratio is against the last
         # committed TPU capture's cycle, not this fallback run's
@@ -416,6 +484,15 @@ def main() -> None:
             result["multicycle"] = mc_d
             result["multicycle_full_rebuild"] = mc_f
             result["multicycle_open_snapshot_reduction"] = red
+
+    # ---- the SHARDED steady-state regime: same persistent-cache churn
+    # cycle over the device mesh — the per-shard scatter-delta residency's
+    # bytes-moved reduction and zero-retrace proof (this PR's acceptance)
+    if section("multicycle_sharded", margin_s=150):
+        with guarded("multicycle_sharded"):
+            result["multicycle_sharded"] = sharded_multicycle(
+                conf, N_TASKS, N_NODES
+            )
 
     # ---- ≥10×-vs-Go-loop target (BASELINE.md): time the faithful
     # sequential re-creation of the reference's allocate loop over the same
@@ -589,7 +666,7 @@ def _emit(result: dict, tpu_capture_note: bool) -> None:
         capture.pop("sections_missing", None)
         missing = [
             s for s in ("go_loop_ms", "pallas_roundhead", "pipeline5_ms",
-                        "het30_ms", "multicycle")
+                        "het30_ms", "multicycle", "multicycle_sharded")
             if s not in capture
         ]
         # the matrix is complete only when every build_cases() config has a

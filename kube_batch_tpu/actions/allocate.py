@@ -473,11 +473,19 @@ class AllocateAction(Action):
             jc[:, PENDING_I] -= n_alloc_applied + n_pipe_applied
             jc[:, BINDING_I] += n_alloc_applied
             jc[:, PIPELINED_I] += n_pipe_applied
+            # count choke point: the delta close-session pass visits exactly
+            # the rows this vectorized update moved
+            cols.j_touched[(n_alloc_applied + n_pipe_applied) > 0] = True
             cols.n_idle -= node_alloc_sum
             np.maximum(cols.n_idle, 0.0, out=cols.n_idle)
             cols.n_used += node_alloc_sum + node_pipe_sum
             cols.n_rel -= node_pipe_sum
             np.maximum(cols.n_rel, 0.0, out=cols.n_rel)
+            # ledger choke point: the f32 snapshot twins refresh these rows
+            cols.note_node_ledger_rows(
+                np.any(node_alloc_sum != 0.0, axis=1)
+                | np.any(node_pipe_sum != 0.0, axis=1)
+            )
             ssn.fire_columnar_allocations(cols, job_total_sum)
             _mark("replay_columns")
 

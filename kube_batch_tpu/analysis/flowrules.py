@@ -548,7 +548,8 @@ class TelemetryMisuseRule(Rule):
 # KBT010 — host-device sync on resident values in the action layer
 # --------------------------------------------------------------------------
 
-#: calls whose results live on device (the PR 3 resident/solve surface)
+#: calls whose results live on device (the PR 3 resident/solve surface,
+#: extended for the PR 5 sharded scatters + enqueue gate dispatch shapes)
 _DEVICE_SOURCES = {
     "kube_batch_tpu.ops.assignment.allocate_solve",
     "kube_batch_tpu.ops.assignment.failure_histogram_solve",
@@ -557,10 +558,14 @@ _DEVICE_SOURCES = {
     "kube_batch_tpu.parallel.mesh.sharded_failure_histogram",
     "kube_batch_tpu.parallel.mesh.sharded_evict_solve",
     "kube_batch_tpu.api.columns.resident_snap",
+    "kube_batch_tpu.ops.admission.enqueue_gate_solve",
     "jax.device_put",
 }
-#: local-name fallbacks for intra-module dispatch helpers
+#: local-name fallbacks for intra-module dispatch helpers: direct calls
+#: (`..._solve(...)`) and the jitted-fn factory form the resident scatters
+#: use (`_scatter_fn()(dev, ...)`, `_mesh_shard_scatter_fn(mesh)(dev, ...)`)
 _DEVICE_SOURCE_SUFFIXES = ("_solve", "solve_dispatch")
+_DEVICE_FACTORY_SUFFIXES = ("_scatter_fn", "_gate_fn")
 
 
 class ResidentSyncRule(Rule):
@@ -589,6 +594,12 @@ class ResidentSyncRule(Rule):
         f = call.func
         if isinstance(f, ast.Name):
             return f.id.endswith(_DEVICE_SOURCE_SUFFIXES) or f.id == "resident_snap"
+        # the factory form: `_scatter_fn()(dev, ...)` / `_mesh_shard_
+        # scatter_fn(mesh)(dev, ...)` — the inner call returns a jitted
+        # device fn, so the outer call's result is device-resident
+        if (isinstance(f, ast.Call) and isinstance(f.func, ast.Name)
+                and f.func.id.endswith(_DEVICE_FACTORY_SUFFIXES)):
+            return True
         return False
 
     @staticmethod

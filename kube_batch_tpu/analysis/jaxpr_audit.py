@@ -153,6 +153,18 @@ def _build_resident_scatter():
     )
 
 
+def _build_enqueue_gate():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.ops.admission import enqueue_gate_fn
+
+    return enqueue_gate_fn(), (
+        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
+        S((_R,), jnp.float32), S((_R,), jnp.float32),
+    )
+
+
 def _build_pallas_round_head():
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
@@ -181,9 +193,107 @@ REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.eviction.evict_solve[preempt]", _build_evict_preempt),
     EntryPoint("api.resident.scatter", _build_resident_scatter,
                donate=_scatter_donation()),
+    EntryPoint("ops.admission.enqueue_gate", _build_enqueue_gate),
     EntryPoint("ops.pallas_kernels.masked_best_node",
                _build_pallas_round_head),
 )
+
+
+# --------------------------------------------------------------------------
+# the mesh-sharded solve variants (ROADMAP follow-on): traced whenever the
+# backend exposes ≥2 devices — on CPU a forced host-platform device count
+# (XLA_FLAGS=--xla_force_host_platform_device_count=N; tier-1's conftest
+# forces 8) stands in for a multi-device CI mesh, so KBT101-104 cover the
+# sharded entry points without real hardware.  Single-device runs skip them
+# (the registry is empty there, never silently "clean" — the CLI exit code
+# reflects only what was actually traced).
+# --------------------------------------------------------------------------
+
+
+def _build_sharded_allocate(mesh):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import allocate_solve_fn
+
+    return allocate_solve_fn(mesh, AllocateConfig()), (_abstract_snapshot(),)
+
+
+def _build_sharded_histogram(mesh):
+    from kube_batch_tpu.parallel.mesh import failure_histogram_fn
+
+    return failure_histogram_fn(mesh), (_abstract_snapshot(),)
+
+
+def _build_sharded_evict(mesh, mode):
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.parallel.mesh import evict_solve_fn
+
+    return evict_solve_fn(mesh, EvictConfig(mode=mode)), (
+        _abstract_snapshot(),)
+
+
+def _build_shard_scatter(mesh):
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.api.resident import (
+        SHARD_SCATTER_SLOTS,
+        _mesh_shard_scatter_fn,
+    )
+
+    d = int(mesh.devices.size)
+    return _mesh_shard_scatter_fn(mesh), (
+        S((_N, _R), jnp.float32),
+        S((d, SHARD_SCATTER_SLOTS), jnp.int32),
+        S((d, SHARD_SCATTER_SLOTS, _R), jnp.float32),
+    )
+
+
+def _build_repl_scatter(mesh):
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.api.resident import SCATTER_SLOTS, _mesh_repl_scatter_fn
+
+    return _mesh_repl_scatter_fn(mesh), (
+        S((_T,), jnp.int32),
+        S((SCATTER_SLOTS,), jnp.int32),
+        S((SCATTER_SLOTS,), jnp.int32),
+    )
+
+
+def sharded_registry() -> Tuple[EntryPoint, ...]:
+    """Entry points for the mesh-sharded solve path — empty on single-device
+    backends (no mesh to shard over)."""
+    import functools
+
+    import jax
+
+    if len(jax.devices()) < 2:
+        return ()
+    from kube_batch_tpu.parallel.mesh import make_mesh
+
+    # _N (8) must divide the mesh for the per-shard scatter's local indexing
+    n_dev = len(jax.devices())
+    while n_dev > 1 and _N % n_dev:
+        n_dev -= 1
+    mesh = make_mesh(n_dev)
+    p = functools.partial
+    return (
+        EntryPoint("parallel.mesh.sharded_allocate_solve",
+                   p(_build_sharded_allocate, mesh)),
+        EntryPoint("parallel.mesh.sharded_failure_histogram",
+                   p(_build_sharded_histogram, mesh)),
+        EntryPoint("parallel.mesh.sharded_evict_solve[reclaim]",
+                   p(_build_sharded_evict, mesh, "reclaim")),
+        EntryPoint("parallel.mesh.sharded_evict_solve[preempt]",
+                   p(_build_sharded_evict, mesh, "preempt")),
+        EntryPoint("api.resident.scatter_sharded",
+                   p(_build_shard_scatter, mesh),
+                   donate=_scatter_donation()),
+        EntryPoint("api.resident.scatter_repl",
+                   p(_build_repl_scatter, mesh),
+                   donate=_scatter_donation()),
+    )
 
 
 # --------------------------------------------------------------------------
@@ -311,11 +421,15 @@ def audit_entry(entry: EntryPoint) -> List[Finding]:
 
 
 def run_audit(
-    registry: Sequence[EntryPoint] = REGISTRY,
+    registry: Optional[Sequence[EntryPoint]] = None,
     select: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
-    """Audit every registered entry point.  ``select`` restricts to a rule
-    subset (CLI --select parity with the static tier)."""
+    """Audit every registered entry point — the single-device REGISTRY plus,
+    on multi-device backends, the mesh-sharded variants.  ``select``
+    restricts to a rule subset (CLI --select parity with the static
+    tier)."""
+    if registry is None:
+        registry = tuple(REGISTRY) + sharded_registry()
     findings: List[Finding] = []
     for entry in registry:
         findings.extend(audit_entry(entry))

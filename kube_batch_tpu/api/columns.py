@@ -67,19 +67,26 @@ VALID_STATUSES = READY_STATUSES + (
     int(TaskStatus.PENDING), int(TaskStatus.PIPELINED),
 )
 
+# PodGroup phase ↔ int code for the j_phase column (−1 = no phase yet)
+PHASE_CODE: Dict[PodGroupPhase, int] = {
+    p: i for i, p in enumerate(PodGroupPhase)
+}
+CODE_PHASE: List[PodGroupPhase] = list(PodGroupPhase)
+N_PHASES = len(CODE_PHASE)
+
 
 def resident_snap(cols, snap, mesh=None):
     """The call-site shape for the device-resident snapshot cache: swap in
     cached device arrays when a ColumnStore backs the session, pass the
     snapshot through untouched otherwise.  Static ingest features ride the
     version-keyed cache (resident_features); the per-cycle columns ride the
-    scatter-delta cache (api/resident.py) on single-device dispatches."""
+    scatter-delta cache (api/resident.py) — single-device scatters when
+    `mesh` is None, per-shard NamedSharding-placed scatters on the
+    mesh-sharded solve path."""
     if cols is None:
         return snap
     snap = cols.resident_features(snap, mesh=mesh)
-    if mesh is None:
-        snap = cols.per_cycle_resident(snap)
-    return snap
+    return cols.per_cycle_resident(snap, mesh=mesh)
 
 
 def _grow(arr: np.ndarray, cap: int) -> np.ndarray:
@@ -164,6 +171,22 @@ class ColumnStore:
         self.j_creation = np.zeros(capJ, np.int32)
         self.j_sess = np.zeros(capJ, bool)
         self.j_sched = np.zeros(capJ, bool)
+        # PodGroup metadata rows, maintained by the same session row sync
+        # (delta across cycles) — the enqueue admission gate and the delta
+        # close-session status pass read these instead of walking objects
+        self.j_has_pg = np.zeros(capJ, bool)
+        self.j_shadow = np.zeros(capJ, bool)
+        self.j_pdb = np.zeros(capJ, bool)
+        self.j_phase = np.full(capJ, -1, np.int8)   # PHASE_CODE, -1 = none
+        self.j_has_conds = np.zeros(capJ, bool)
+        self.j_has_minres = np.zeros(capJ, bool)
+        self.j_minres = np.zeros((capJ, R), np.float32)
+        # rows whose close-pass inputs may have moved since the last status
+        # pass: every j_counts choke point (api/job_info.py), the columnar
+        # replay's vectorized count update, the session row sync, and
+        # mid-cycle phase/condition writes stamp it; close_session visits
+        # exactly these rows (plus the standing need-record set) and clears
+        self.j_touched = np.zeros(capJ, bool)
 
         # ---- node axis --------------------------------------------------
         self.nodes = _Axis()
@@ -173,6 +196,15 @@ class ColumnStore:
         self.n_used = np.zeros((capN, R), np.float64)
         self.n_alloc = np.zeros((capN, R), np.float64)
         self.n_cap = np.zeros((capN, R), np.float64)
+        # persistent float32 twins of the ledger matrices, refreshed only at
+        # rows the dirty choke points touched (NodeInfo's task algebra, the
+        # columnar replay, bind/free/set_node) — the device snapshot reads
+        # these instead of paying four full [capN, R] casts every cycle
+        self.n_idle32 = np.zeros((capN, R), np.float32)
+        self.n_rel32 = np.zeros((capN, R), np.float32)
+        self.n_used32 = np.zeros((capN, R), np.float32)
+        self.n_alloc32 = np.zeros((capN, R), np.float32)
+        self._node_ledger_dirty = np.ones(capN, bool)
         self.n_valid = np.zeros(capN, bool)   # Ready
         self.n_sched = np.zeros(capN, bool)   # not Unschedulable
         self.n_label_bits = np.zeros((capN, 1), np.uint32)
@@ -210,10 +242,14 @@ class ColumnStore:
         self.task_feature_version = 0
         self.node_feature_version = 0
         self._dev_cache: Dict = {}
-        # per-cycle device-resident cache (api/resident.py): the truly
-        # per-cycle snapshot columns stay alive on device between cycles and
-        # are refreshed by scatter deltas instead of full uploads
-        self._per_cycle_dev = None
+        # per-cycle device-resident caches (api/resident.py), keyed by mesh
+        # (None = the single-device scatter cache): the truly per-cycle
+        # snapshot columns stay alive on device between cycles — sharded
+        # NamedSharding placements on the mesh path — and are refreshed by
+        # scatter deltas instead of full uploads.  A mesh CHANGE drops the
+        # old mesh's cache wholesale (the reshard/mesh-change fallback: the
+        # fresh cache full-uploads once, then deltas resume).
+        self._per_cycle_dev: Dict = {}
         # which path the most recent session row-sync took ("delta"|"full")
         # — surfaced in the bench JSON and the sim's longitudinal report
         self.last_snapshot_path = "full"
@@ -386,6 +422,7 @@ class ColumnStore:
         self.job_by_row[row] = job
         job._row = row
         job._cols = self
+        self.j_touched[row] = True
 
     def free_job(self, job) -> None:
         row = getattr(job, "_row", -1)
@@ -397,6 +434,14 @@ class ColumnStore:
         # delta row-sync only rewrites rows of dirty jobs)
         self.j_sess[row] = False
         self.j_sched[row] = False
+        self.j_has_pg[row] = False
+        self.j_shadow[row] = False
+        self.j_pdb[row] = False
+        self.j_phase[row] = -1
+        self.j_has_conds[row] = False
+        self.j_has_minres[row] = False
+        self.j_minres[row] = 0.0
+        self.j_touched[row] = True
         # give the job back private buffers (copies of its final state)
         job.allocated.vec = self.j_alloc[row].copy()
         job.total_request.vec = self.j_total[row].copy()
@@ -411,8 +456,13 @@ class ColumnStore:
     def _grow_jobs(self) -> None:
         cap = self.jobs.grown_cap()
         for name in ("j_alloc", "j_total", "j_pend", "j_counts", "j_min",
-                     "j_queue", "j_prio", "j_creation", "j_sess", "j_sched"):
+                     "j_queue", "j_prio", "j_creation", "j_sess", "j_sched",
+                     "j_has_pg", "j_shadow", "j_pdb",
+                     "j_has_conds", "j_has_minres", "j_minres", "j_touched"):
             setattr(self, name, _grow(getattr(self, name), cap))
+        j_phase = np.full(cap, -1, np.int8)
+        j_phase[: self.j_phase.shape[0]] = self.j_phase
+        self.j_phase = j_phase
         self.job_by_row.extend([None] * (cap - self.jobs.cap))
         self.jobs.on_grown(cap)
         # rebind every bound job's ledger views onto the new buffers
@@ -446,6 +496,7 @@ class ColumnStore:
         node.allocatable.vec = self.n_alloc[row]
         node.capability.vec = self.n_cap[row]
         self.node_feature_version += 1  # fresh n_alloc / bit rows on this row
+        self._node_ledger_dirty[row] = True
         self.sync_node_meta(node)
         # resident tasks bound before their node rows resolve to -1;
         # repoint them now that the name has a row
@@ -466,6 +517,7 @@ class ColumnStore:
         node.capability.vec = self.n_cap[row].copy()
         for arr in (self.n_idle, self.n_rel, self.n_used, self.n_alloc, self.n_cap):
             arr[row] = 0.0
+        self._node_ledger_dirty[row] = True
         self.n_valid[row] = False
         self.n_sched[row] = False
         self.n_label_bits[row] = 0
@@ -482,8 +534,12 @@ class ColumnStore:
     def _grow_nodes(self) -> None:
         cap = self.nodes.grown_cap()
         for name in ("n_idle", "n_rel", "n_used", "n_alloc", "n_cap",
-                     "n_valid", "n_sched", "n_label_bits", "n_taint_bits"):
+                     "n_valid", "n_sched", "n_label_bits", "n_taint_bits",
+                     "n_idle32", "n_rel32", "n_used32", "n_alloc32"):
             setattr(self, name, _grow(getattr(self, name), cap))
+        dirty = np.ones(cap, bool)
+        dirty[: self._node_ledger_dirty.shape[0]] = self._node_ledger_dirty
+        self._node_ledger_dirty = dirty
         self.node_by_row.extend([None] * (cap - self.nodes.cap))
         self.node_names.extend([""] * (cap - self.nodes.cap))
         self.nodes.on_grown(cap)
@@ -633,6 +689,7 @@ class ColumnStore:
         row = job._row
         if row < 0 or job._cols is not self:
             return  # foreign/unbound job (isolated-session object)
+        self.j_touched[row] = True  # re-synced ⇒ the close pass must visit
         qi = queue_rows_get(job.queue, -1)
         if qi < 0:
             self.j_sess[row] = False
@@ -644,6 +701,37 @@ class ColumnStore:
         self.j_creation[row] = job.creation_index
         pg = job.pod_group
         self.j_sched[row] = pg is None or pg.phase != PodGroupPhase.PENDING
+        # PodGroup metadata for the enqueue gate + delta close status pass
+        self.j_has_pg[row] = pg is not None
+        self.j_pdb[row] = job.pdb is not None
+        if pg is None:
+            self.j_shadow[row] = False
+            self.j_phase[row] = -1
+            self.j_has_conds[row] = False
+            self.j_has_minres[row] = False
+            self.j_minres[row] = 0.0
+            return
+        self.j_shadow[row] = pg.shadow
+        self.j_phase[row] = (
+            PHASE_CODE[pg.phase] if pg.phase is not None else -1
+        )
+        self.j_has_conds[row] = bool(pg.conditions)
+        mr = pg.min_resources
+        # `is None`, NOT truthiness: an EMPTY min_resources dict takes the
+        # walk's budgeted branch (zero request — always fits, but still
+        # subject to JobEnqueueable), only a missing one promotes
+        # unconditionally (enqueue.go:102-105)
+        if mr is not None:
+            self.j_has_minres[row] = True
+            vec = np.zeros(self.R, np.float32)
+            spec = self.spec
+            for name, v in mr.items():
+                if name in spec:
+                    vec[spec.index(name)] = float(v)
+            self.j_minres[row] = vec
+        else:
+            self.j_has_minres[row] = False
+            self.j_minres[row] = 0.0
 
     def sync_session_rows(self, ssn, dirty_uids=None, restore_rows=()) -> None:
         """Fill the session-scoped job-row arrays (j_sess membership, j_min,
@@ -752,7 +840,9 @@ class ColumnStore:
         "task_sel_bits": ("t_sel_bits", "task"),
         "task_sel_impossible": ("t_sel_impossible", "task"),
         "task_tol_bits": ("t_tol_bits", "task"),
-        "node_alloc": ("n_alloc", "node"),
+        # n_alloc32: the dirty-row-refreshed f32 twin (node_ledgers32) — the
+        # device snapshot build always refreshes it before any dispatch
+        "node_alloc": ("n_alloc32", "node"),
         "node_label_bits": ("n_label_bits", "node"),
         "node_taint_bits": ("n_taint_bits", "node"),
     }
@@ -760,21 +850,73 @@ class ColumnStore:
     def bump_node_features(self) -> None:
         self.node_feature_version += 1
 
-    def per_cycle_resident(self, snap):
+    # ---- node-ledger dirty rows (the f32 cast choke point) -----------
+    def note_node_ledger(self, row: int) -> None:
+        """Mark one node row's ledgers (idle/releasing/used/allocatable)
+        changed — every write path calls this (NodeInfo's task algebra and
+        set_node, bind/free, the columnar replay's matrix updates), so the
+        per-cycle float32 refresh pays exactly the touched rows instead of
+        four full-matrix casts."""
+        self._node_ledger_dirty[row] = True
+
+    def note_node_ledger_rows(self, rows) -> None:
+        self._node_ledger_dirty[rows] = True
+
+    def node_ledgers32(self):
+        """(idle32, rel32, used32, alloc32) — the persistent float32 ledger
+        twins, refreshed at exactly the dirty rows."""
+        dirty = self._node_ledger_dirty
+        if dirty.any():
+            rows = np.flatnonzero(dirty)
+            self.n_idle32[rows] = self.n_idle[rows]
+            self.n_rel32[rows] = self.n_rel[rows]
+            self.n_used32[rows] = self.n_used[rows]
+            self.n_alloc32[rows] = self.n_alloc[rows]
+            dirty[:] = False
+        return self.n_idle32, self.n_rel32, self.n_used32, self.n_alloc32
+
+    def per_cycle_resident(self, snap, mesh=None):
         """Swap the per-cycle snapshot columns for their device-resident
-        copies, refreshed by scatter deltas (api/resident.py).  Shares the
-        KB_DEVICE_CACHE kill switch with the static feature cache."""
+        copies, refreshed by scatter deltas (api/resident.py) — sharded
+        placements when `mesh` is given.  Shares the KB_DEVICE_CACHE kill
+        switch with the static feature cache."""
         import os
 
         if os.environ.get("KB_DEVICE_CACHE", "").strip().lower() in (
             "0", "false", "off", "no"
         ):
             return snap
-        if self._per_cycle_dev is None:
-            from kube_batch_tpu.api.resident import PerCycleDeviceCache
+        cache = self._per_cycle_dev.get(mesh)
+        if cache is None:
+            from kube_batch_tpu.api.resident import (
+                PerCycleDeviceCache,
+                ShardedPerCycleDeviceCache,
+            )
 
-            self._per_cycle_dev = PerCycleDeviceCache()
-        return self._per_cycle_dev.swap(snap)
+            cache = (
+                PerCycleDeviceCache() if mesh is None
+                else ShardedPerCycleDeviceCache(mesh)
+            )
+            # keep at most ONE resident cache — the dispatch path that just
+            # ran.  A mesh change (reshard / device-set change) drops the
+            # old mesh's residency so stale placements never feed a solve;
+            # a path flip (node axis crossing the shard gate, KB_SHARD
+            # toggles) likewise frees the abandoned path's device copies
+            # instead of holding a dead full set of per-cycle columns for
+            # the process lifetime.  Either way the fresh cache
+            # full-uploads once and deltas resume.
+            for stale in [k for k in self._per_cycle_dev if k is not mesh]:
+                del self._per_cycle_dev[stale]
+            self._per_cycle_dev[mesh] = cache
+        return cache.swap(snap)
+
+    def resident_counters(self) -> Dict[str, Dict[str, int]]:
+        """Per-path scatter-delta counters ("single" / "sharded") for the
+        bench artifact and the sim's longitudinal report."""
+        out: Dict[str, Dict[str, int]] = {}
+        for key, cache in self._per_cycle_dev.items():
+            out["single" if key is None else "sharded"] = cache.counters()
+        return out
 
     def resident_features(self, snap, mesh=None):
         """`snap` with the ingest-static feature arrays swapped for cached
@@ -907,6 +1049,10 @@ class ColumnStore:
             task_pref_pod = minmax_scale_rows(task_pref_pod)
 
         node_valid = self.n_valid
+        # node ledgers: persistent f32 twins refreshed at the dirty rows
+        # only (the per-cycle full-matrix casts this replaces were the last
+        # O(nodes) host cost of the snapshot build)
+        idle32, rel32, used32, alloc32 = self.node_ledgers32()
         # session-level node exclusions (pressure gates): fold into
         # node_sched so the device predicate is exact
         node_sched = self.n_sched
@@ -940,10 +1086,10 @@ class ColumnStore:
             task_pref_idx=task_pref_idx,
             task_pref_node=task_pref_node,
             task_pref_pod=task_pref_pod,
-            node_idle=self.n_idle.astype(np.float32),
-            node_releasing=self.n_rel.astype(np.float32),
-            node_used=self.n_used.astype(np.float32),
-            node_alloc=self.n_alloc.astype(np.float32),
+            node_idle=idle32,
+            node_releasing=rel32,
+            node_used=used32,
+            node_alloc=alloc32,
             node_valid=node_valid,
             node_sched=node_sched,
             node_label_bits=self.n_label_bits,
@@ -1060,6 +1206,24 @@ class ColumnStore:
         for name, q in cache.queues.items():
             if self.queue_rows.get(name) is None:
                 errs.append(f"queue {name} unbound")
+        # the f32 ledger twins must track the f64 ledgers exactly once the
+        # dirty rows are flushed — a missed note_node_ledger choke point
+        # (a new ledger write path) shows up here
+        self.node_ledgers32()
+        for label, f32, f64 in (
+            ("idle32", self.n_idle32, self.n_idle),
+            ("rel32", self.n_rel32, self.n_rel),
+            ("used32", self.n_used32, self.n_used),
+            ("alloc32", self.n_alloc32, self.n_alloc),
+        ):
+            if not np.array_equal(f32, f64.astype(np.float32)):
+                rows = np.flatnonzero(
+                    np.any(f32 != f64.astype(np.float32), axis=1)
+                )[:8]
+                errs.append(
+                    f"node ledger twin {label} stale at rows {rows.tolist()}"
+                    " (missed note_node_ledger choke point)"
+                )
         return errs
 
 

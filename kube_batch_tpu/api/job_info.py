@@ -114,6 +114,7 @@ class JobInfo:
         self.task_status_index[task.status][task.key()] = task
         if self._cols is not None:
             self._cols.j_counts[self._row, int(task.status)] += 1
+            self._cols.j_touched[self._row] = True
 
     def _index_remove(self, task: TaskInfo) -> None:
         bucket = self.task_status_index.get(task.status)
@@ -123,6 +124,7 @@ class JobInfo:
                 del self.task_status_index[task.status]
             if popped is not None and self._cols is not None:
                 self._cols.j_counts[self._row, int(task.status)] -= 1
+                self._cols.j_touched[self._row] = True
 
     def add_task(self, task: TaskInfo) -> None:
         key = task.key()
@@ -204,6 +206,7 @@ class JobInfo:
                 counts = self._cols.j_counts[self._row]
                 counts[int(src_status)] -= len(tasks)
                 counts[int(status)] += len(tasks)
+                self._cols.j_touched[self._row] = True
             flipped = len(tasks) if is_allocated(src_status) != new_alloc else 0
             pend_src = src_status == TaskStatus.PENDING
             new_pend = status == TaskStatus.PENDING
@@ -226,6 +229,8 @@ class JobInfo:
             counts = (
                 self._cols.j_counts[self._row] if self._cols is not None else None
             )
+            if self._cols is not None:
+                self._cols.j_touched[self._row] = True
             for task in tasks:
                 key = task._key
                 was_pend = task.status == TaskStatus.PENDING

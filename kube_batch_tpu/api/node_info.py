@@ -135,6 +135,7 @@ class NodeInfo:
             # allocatable change invalidates the device-resident n_alloc
             if not np.array_equal(self.allocatable.vec, alloc.vec):
                 self._cols.bump_node_features()
+            self._note_ledger()
             self.allocatable.vec[:] = alloc.vec
             self.capability.vec[:] = cap.vec
             self.idle.vec[:] = idle_v
@@ -179,15 +180,25 @@ class NodeInfo:
                         self.allocatable, self.capability):
                 res.vec[:] = 0.0
             self._cols.bump_node_features()
+            self._note_ledger()
         self._set_state()
         if self._cols is not None:
             self._cols.sync_node_meta(self)
+
+    def _note_ledger(self) -> None:
+        """Dirty-row choke point: every (Idle, Used, Releasing, Allocatable)
+        write funnels one mark to the ColumnStore so the device snapshot's
+        float32 twins refresh exactly the touched rows
+        (columns.node_ledgers32)."""
+        if self._cols is not None:
+            self._cols.note_node_ledger(self._row)
 
     def add_task(self, task: TaskInfo) -> None:
         key = task.key()
         graft_assert(key not in self.tasks, f"duplicate task {key} on node {self.name}")
         status = task.status
         if self.node is not None:
+            self._note_ledger()
             r = task.resreq
             if status == TaskStatus.RELEASING:
                 self.releasing.add_(r)
@@ -211,6 +222,7 @@ class NodeInfo:
         if existing is not None:
             status = self._acct.pop(key, existing.status)
             if self.node is not None:
+                self._note_ledger()
                 r = existing.resreq
                 if status == TaskStatus.RELEASING:
                     self.releasing.sub_(r)
@@ -247,6 +259,7 @@ class NodeInfo:
                 tasks[key] = task
                 acct[key] = task.status
         if self.node is not None:
+            self._note_ledger()
             self.idle.sub_(alloc_sum)
             self.used.add_(alloc_sum)
             self.used.add_(pipe_sum)

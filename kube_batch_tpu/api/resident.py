@@ -25,16 +25,32 @@ upload by construction — the scatter writes exactly the host rows — and
 tests/test_snapshot_delta.py checks the round-trip.
 
 Donation is skipped on the CPU backend (unsupported there; jax would warn
-every cycle).  The mesh-sharded solve path keeps full uploads — sharded
-scatter residency is a follow-on (ROADMAP).
+every cycle).
+
+Mesh-sharded residency (:class:`ShardedPerCycleDeviceCache`): the sharded
+solve keeps the same columns alive as ``NamedSharding``-placed buffers —
+node-axis columns sharded over the mesh, everything else replicated — and
+refreshes them with PER-SHARD fixed-width donated scatter deltas.  The
+changed rows are partitioned by owning shard on the host and shipped as
+``[n_shards, slots]`` LOCAL indices + values whose leading axis carries the
+mesh sharding, so the jitted update (a vmapped per-shard scatter with
+explicit ``in_shardings``/``out_shardings``) routes each delta slice
+straight to its owning chip — no gather, no reshard, no cross-chip traffic.
+Fallbacks to a full (sharded) re-upload: cold cache, axis growth, a delta
+wider than the per-shard slot budget (high churn), or a mesh change (the
+ColumnStore drops the old mesh's cache wholesale — see
+``per_cycle_resident``; the shape buckets are divisible by any
+power-of-two mesh axis, and jax itself rejects an indivisible placement
+before any solve could run).
 
 Donation audit (PR 4): every donating call site in this module rebinds the
 donated name to the call's result (``dev = _scatter_fn()(dev, ...)``) —
 the shape KBT006 (analysis/flowrules.py) verifies package-wide, so a
 post-donation read introduced later fails the tier-1 self-enforcement
-test.  The scatter itself is registered in the jaxpr audit
-(analysis/jaxpr_audit.py), which asserts its donation wiring per backend
-(KBT104) and that no f64/transfer/callback sneaks into the traced update.
+test.  The scatters (single-device AND per-mesh) are registered in the
+jaxpr audit (analysis/jaxpr_audit.py), which asserts their donation wiring
+per backend (KBT104) and that no f64/transfer/callback sneaks into the
+traced update.
 """
 
 from __future__ import annotations
@@ -58,10 +74,36 @@ PER_CYCLE_FIELDS: Tuple[str, ...] = (
     "total",
 )
 
-#: fixed scatter width — one compiled scatter per (field shape, dtype);
-#: deltas wider than this take the full-upload path (at which point the
-#: transfer is no longer the bottleneck anyway)
-SCATTER_SLOTS = 4096
+#: the subset whose leading axis is the node axis — sharded over the mesh
+#: on the sharded solve path (parallel/mesh.snapshot_shardings); everything
+#: else replicates
+NODE_AXIS_FIELDS = frozenset((
+    "node_idle", "node_releasing", "node_used", "node_valid", "node_sched",
+))
+
+#: fixed scatter width buckets — a delta ships at the smallest bucket that
+#: holds it, so tiny steady-state deltas don't pay the worst-case payload;
+#: every bucket is pre-warmed at full-upload time, so the bounded set of
+#: specializations per (field shape, dtype) never retraces mid-steady-state.
+#: Deltas wider than the largest bucket take the full-upload path (at which
+#: point the transfer is no longer the bottleneck anyway).
+SCATTER_SLOT_BUCKETS: Tuple[int, ...] = (64, 512, 4096)
+SCATTER_SLOTS = SCATTER_SLOT_BUCKETS[-1]
+
+#: per-shard slot-width buckets of the mesh scatter: the [n_shards, slots]
+#: delta is sharded on its leading axis, so each chip receives exactly its
+#: own slice
+SHARD_SCATTER_SLOT_BUCKETS: Tuple[int, ...] = (16, 128, 1024)
+SHARD_SCATTER_SLOTS = SHARD_SCATTER_SLOT_BUCKETS[-1]
+
+
+def _slot_bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest slot bucket holding an n-row delta (caller guarantees
+    n ≤ buckets[-1])."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
 
 
 _SCATTER = None
@@ -89,6 +131,90 @@ def _scatter_fn():
     return _SCATTER
 
 
+# per-(mesh, sharded?) jitted scatters — memoized so steady-state sharded
+# cycles reuse one compiled specialization per (field shape, dtype), same
+# contract as the single-device _scatter_fn
+_MESH_SCATTER: dict = {}
+
+
+def _mesh_repl_scatter_fn(mesh):
+    """The replicated-placement scatter for `mesh`: same update as the
+    single-device one, with explicit replicated in/out shardings so the
+    result stays a committed mesh array the sharded solve accepts as-is."""
+    fn = _MESH_SCATTER.get((mesh, "repl"))
+    if fn is None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+
+        def scatter(dev, rows, vals):
+            return dev.at[rows].set(vals, mode="drop")
+
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        fn = jitstats.register(
+            "resident_scatter_repl",
+            jax.jit(scatter, donate_argnums=donate,
+                    in_shardings=(repl, repl, repl), out_shardings=repl),
+        )
+        _MESH_SCATTER[(mesh, "repl")] = fn
+    return fn
+
+
+def _mesh_shard_scatter_fn(mesh):
+    """The per-shard scatter for node-axis columns: `dev` is [N, ...]
+    sharded over the node axis, `rows`/`vals` are [n_shards, slots(, ...)]
+    sharded on their LEADING axis with shard-LOCAL row indices — the vmap
+    over the shard axis makes each chip scatter only its own delta slice
+    (out-of-range padding rows drop), and the explicit shardings keep GSPMD
+    from inserting any gather/reshard around the update."""
+    fn = _MESH_SCATTER.get((mesh, "shard"))
+    if fn is None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kube_batch_tpu.parallel.mesh import NODE_AXIS
+
+        shard = NamedSharding(mesh, P(NODE_AXIS))
+
+        def scatter_sharded(dev, rows, vals):
+            n_shards = rows.shape[0]
+            dev3 = dev.reshape(
+                (n_shards, dev.shape[0] // n_shards) + dev.shape[1:]
+            )
+            out = jax.vmap(
+                lambda d, r, v: d.at[r].set(v, mode="drop")
+            )(dev3, rows, vals)
+            return out.reshape(dev.shape)
+
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        fn = jitstats.register(
+            "resident_scatter_sharded",
+            jax.jit(scatter_sharded, donate_argnums=donate,
+                    in_shardings=(shard, shard, shard), out_shardings=shard),
+        )
+        _MESH_SCATTER[(mesh, "shard")] = fn
+    return fn
+
+
+def scatter_summary(per_path_counters: Dict[str, Dict[str, int]]
+                    ) -> Dict[str, Dict]:
+    """Per-path counter summary with the delta-vs-full bytes-moved
+    reduction — the ONE derivation behind the bench artifact and the sim's
+    longitudinal report (`ColumnStore.resident_counters()` feeds it)."""
+    out: Dict[str, Dict] = {}
+    for path, c in per_path_counters.items():
+        moved = c["bytes_full"] + c["bytes_scatter"]
+        rec = dict(c)
+        rec["bytes_moved"] = moved
+        if c["bytes_if_full"]:
+            rec["upload_reduction"] = round(
+                1.0 - moved / c["bytes_if_full"], 3
+            )
+        out[path] = rec
+    return out
+
+
 class PerCycleDeviceCache:
     def __init__(self) -> None:
         self._mirror: Dict[str, np.ndarray] = {}
@@ -102,10 +228,35 @@ class PerCycleDeviceCache:
         self.full_uploads = 0
         self.scatter_updates = 0
         self.clean_hits = 0
+        # bytes actually shipped host→device vs what full per-cycle uploads
+        # would have shipped — the bench's delta-vs-full reduction evidence
+        self.bytes_full = 0
+        self.bytes_scatter = 0
+        self.bytes_if_full = 0
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "full_uploads": self.full_uploads,
+            "scatter_updates": self.scatter_updates,
+            "clean_hits": self.clean_hits,
+            "bytes_full": self.bytes_full,
+            "bytes_scatter": self.bytes_scatter,
+            "bytes_if_full": self.bytes_if_full,
+        }
+
+    @staticmethod
+    def _payload_bytes(slots: int, host: np.ndarray) -> int:
+        """Scatter payload size for a `slots`-wide delta of `host`'s row
+        shape (int32 index + one value row per slot)."""
+        row = host.dtype.itemsize * int(
+            np.prod(host.shape[1:], dtype=np.int64)
+        )
+        return slots * (4 + row)
 
     def _refresh(self, field: str, host: np.ndarray):
         import jax
 
+        self.bytes_if_full += host.nbytes
         mirror = self._mirror.get(field)
         if (
             mirror is None
@@ -113,14 +264,22 @@ class PerCycleDeviceCache:
             or mirror.dtype != host.dtype
         ):
             self.full_uploads += 1
+            self.bytes_full += host.nbytes
             dev = jax.device_put(host)
-            # pre-warm the scatter specialization for this (shape, dtype)
-            # NOW — an all-out-of-range index vector writes nothing, so the
-            # values are untouched, but the first real delta in a later
-            # steady-state cycle becomes a cache hit instead of a retrace
-            rows = np.full(SCATTER_SLOTS, host.shape[0], np.int32)
-            vals = np.zeros((SCATTER_SLOTS,) + host.shape[1:], host.dtype)
-            dev = _scatter_fn()(dev, rows, vals)
+            # pre-warm EVERY slot-bucket specialization for this (shape,
+            # dtype) NOW — an all-out-of-range index vector writes nothing,
+            # so the values are untouched, but any real delta width in a
+            # later steady-state cycle becomes a cache hit, never a
+            # retrace.  TWO passes: the first bucket's first call sees the
+            # device_put-placed buffer, while real deltas always see a
+            # scatter OUTPUT buffer — whose layout can key a fresh
+            # specialization; the second pass compiles every bucket against
+            # the output-typed buffer too
+            for _ in range(2):
+                for slots in SCATTER_SLOT_BUCKETS:
+                    rows = np.full(slots, host.shape[0], np.int32)
+                    vals = np.zeros((slots,) + host.shape[1:], host.dtype)
+                    dev = _scatter_fn()(dev, rows, vals)
             self._mirror[field] = host.copy()
             self._dev[field] = dev
             return dev
@@ -131,23 +290,33 @@ class PerCycleDeviceCache:
         if changed.size == 0:
             self.clean_hits += 1
             return self._dev[field]
-        if changed.size > SCATTER_SLOTS:
+        slots = _slot_bucket(changed.size, SCATTER_SLOT_BUCKETS)
+        if (
+            changed.size > SCATTER_SLOTS
+            # a tiny column: shipping the whole thing is cheaper than the
+            # smallest fixed-width scatter payload
+            or self._payload_bytes(slots, host) >= host.nbytes
+        ):
+            # specializations are already warm — no prewarm on this path
             self.full_uploads += 1
+            self.bytes_full += host.nbytes
             dev = jax.device_put(host)
             self._mirror[field] = host.copy()
             self._dev[field] = dev
             return dev
         n = host.shape[0]
         # pad with an out-of-range row index — mode="drop" discards the
-        # padding writes, so the scatter shape never depends on delta size
-        rows = np.full(SCATTER_SLOTS, n, np.int32)
+        # padding writes, so the scatter shape depends only on the (pre-
+        # warmed) slot bucket, never on the exact delta size
+        rows = np.full(slots, n, np.int32)
         rows[: changed.size] = changed
-        vals = np.zeros((SCATTER_SLOTS,) + host.shape[1:], host.dtype)
+        vals = np.zeros((slots,) + host.shape[1:], host.dtype)
         vals[: changed.size] = host[changed]
         dev = _scatter_fn()(self._dev[field], rows, vals)
         mirror[changed] = host[changed]
         self._dev[field] = dev
         self.scatter_updates += 1
+        self.bytes_scatter += rows.nbytes + vals.nbytes
         return dev
 
     def swap(self, snap):
@@ -166,3 +335,119 @@ class PerCycleDeviceCache:
         out = snap._replace(**updates)
         self._last_in, self._last_out = snap, out
         return out
+
+
+class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
+    """Per-cycle residency for the mesh-sharded solve path (module
+    docstring): node-axis columns live sharded over `mesh`, everything else
+    replicated across it, refreshed by per-shard donated scatter deltas."""
+
+    def __init__(self, mesh) -> None:
+        super().__init__()
+        self.mesh = mesh
+        self.n_shards = int(mesh.devices.size)
+
+    def _sharding(self, field: str):
+        from kube_batch_tpu.parallel.mesh import snapshot_shardings
+
+        return getattr(snapshot_shardings(self.mesh), field)
+
+    def _full_upload(self, field: str, host: np.ndarray,
+                     prewarm: bool = True):
+        """Sharded full upload; on cold/shape-change uploads (`prewarm`)
+        every scatter slot bucket is pre-compiled so later deltas never
+        retrace.  A node axis the mesh cannot divide would make per-shard
+        indexing undefined — but jax itself rejects such a placement
+        (NamedSharding divisibility), so the sharded solve path never
+        reaches here with one; the shape buckets (snapshot.bucket) are
+        divisible by any power-of-two mesh."""
+        import jax
+
+        self.full_uploads += 1
+        self.bytes_full += host.nbytes
+        dev = jax.device_put(host, self._sharding(field))
+        if not prewarm:
+            self._mirror[field] = host.copy()
+            self._dev[field] = dev
+            return dev
+        # two prewarm passes — see PerCycleDeviceCache._refresh: real deltas
+        # see scatter-OUTPUT buffers, whose (sharded) layout can key a fresh
+        # specialization vs the device_put-placed first input
+        if field in NODE_AXIS_FIELDS:
+            s = host.shape[0] // self.n_shards
+            for _ in range(2):
+                for slots in SHARD_SCATTER_SLOT_BUCKETS:
+                    rows = np.full((self.n_shards, slots), s, np.int32)
+                    vals = np.zeros(
+                        (self.n_shards, slots) + host.shape[1:], host.dtype
+                    )
+                    dev = _mesh_shard_scatter_fn(self.mesh)(dev, rows, vals)
+        else:
+            for _ in range(2):
+                for slots in SCATTER_SLOT_BUCKETS:
+                    rows = np.full(slots, host.shape[0], np.int32)
+                    vals = np.zeros((slots,) + host.shape[1:], host.dtype)
+                    dev = _mesh_repl_scatter_fn(self.mesh)(dev, rows, vals)
+        self._mirror[field] = host.copy()
+        self._dev[field] = dev
+        return dev
+
+    def _refresh(self, field: str, host: np.ndarray):
+        self.bytes_if_full += host.nbytes
+        sharded_axis = field in NODE_AXIS_FIELDS
+        mirror = self._mirror.get(field)
+        if (
+            mirror is None
+            or mirror.shape != host.shape
+            or mirror.dtype != host.dtype
+        ):
+            return self._full_upload(field, host)
+        if host.ndim == 1:
+            changed = np.flatnonzero(mirror != host)
+        else:
+            changed = np.flatnonzero(np.any(mirror != host, axis=1))
+        if changed.size == 0:
+            self.clean_hits += 1
+            return self._dev[field]
+        if sharded_axis:
+            s = host.shape[0] // self.n_shards
+            shard_ids = changed // s  # ascending: flatnonzero sorts rows
+            counts = np.bincount(shard_ids, minlength=self.n_shards)
+            if int(counts.max()) > SHARD_SCATTER_SLOTS:
+                return self._full_upload(field, host, prewarm=False)
+            slots = _slot_bucket(
+                int(counts.max()), SHARD_SCATTER_SLOT_BUCKETS
+            )
+            if self._payload_bytes(slots, host) * self.n_shards >= host.nbytes:
+                # tiny sharded column: the whole upload is cheaper than the
+                # smallest per-shard scatter payload
+                return self._full_upload(field, host, prewarm=False)
+            rows = np.full((self.n_shards, slots), s, np.int32)
+            offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            pos = np.arange(changed.size) - np.repeat(offs, counts)
+            rows[shard_ids, pos] = (changed % s).astype(np.int32)
+            vals = np.zeros(
+                (self.n_shards, slots) + host.shape[1:], host.dtype
+            )
+            vals[shard_ids, pos] = host[changed]
+            dev = _mesh_shard_scatter_fn(self.mesh)(
+                self._dev[field], rows, vals
+            )
+        else:
+            if changed.size > SCATTER_SLOTS:
+                return self._full_upload(field, host, prewarm=False)
+            slots = _slot_bucket(changed.size, SCATTER_SLOT_BUCKETS)
+            if self._payload_bytes(slots, host) >= host.nbytes:
+                return self._full_upload(field, host, prewarm=False)
+            rows = np.full(slots, host.shape[0], np.int32)
+            rows[: changed.size] = changed
+            vals = np.zeros((slots,) + host.shape[1:], host.dtype)
+            vals[: changed.size] = host[changed]
+            dev = _mesh_repl_scatter_fn(self.mesh)(
+                self._dev[field], rows, vals
+            )
+        mirror[changed] = host[changed]
+        self._dev[field] = dev
+        self.scatter_updates += 1
+        self.bytes_scatter += rows.nbytes + vals.nbytes
+        return dev

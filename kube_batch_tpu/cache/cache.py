@@ -927,11 +927,14 @@ class SchedulerCache:
                 job.pending_request.vec[:] = 0.0
                 if job._cols is not None:
                     job._cols.j_counts[job._row] = 0
+                    job._cols.j_touched[job._row] = True
                 job.nodes_fit_delta = {}
                 job.nodes_fit_errors = {}
             for node in self.nodes.values():
                 node.tasks.clear()
                 node._acct.clear()
+                if node._cols is not None:
+                    node._cols.note_node_ledger(node._row)
                 node.idle.vec[:] = node.allocatable.vec
                 node.used.vec[:] = 0.0
                 node.releasing.vec[:] = 0.0

@@ -313,6 +313,18 @@ class Session:
             if opt.name in fns and self._enabled(kind, opt)
         }
 
+    def ordered_enabled_plugins(self, kind: str) -> List[str]:
+        """Enabled voter names of `kind` in tiered dispatch order (the
+        _iter_fns iteration order) — the enqueue column gate derives its
+        vectorized ordering keys in exactly this significance order."""
+        fns = self._fns.get(kind, {})
+        return [
+            opt.name
+            for tier in self.tiers
+            for opt in tier.plugins
+            if opt.name in fns and self._enabled(kind, opt)
+        ]
+
     # ---- tiered dispatch ------------------------------------------------
     def _order(self, kind: str, l, r, l_info: Tuple, r_info: Tuple) -> bool:
         """First non-zero verdict wins; fallback CreationTimestamp-then-UID
@@ -503,6 +515,12 @@ class Session:
             and condition.transition_id == self.uid
         ):
             self.unschedulable_marked.add(job.uid)
+        cols = self.columns
+        if cols is not None and job._cols is cols and job._row >= 0:
+            # conditions feed the close pass's need-record set and its
+            # touched-row visit — the delta close must see mid-cycle writes
+            cols.j_has_conds[job._row] = True
+            cols.j_touched[job._row] = True
         for i, c in enumerate(job.pod_group.conditions):
             if c.type == condition.type:
                 job.pod_group.conditions[i] = condition
@@ -863,13 +881,57 @@ def _close_status_columnar(ssn: Session) -> None:
     paid only by jobs whose status changed or that have something to report.
     End state equals the per-job loop's.
 
+    DELTA form (this PR): the j_counts choke points already know which jobs
+    moved — every count write (JobInfo's index choke points, the columnar
+    replay's vectorized update), every session row re-sync (dirty jobs at
+    open; ALL rows on a full-rebuild open), and every mid-cycle phase/
+    condition write stamps ``cols.j_touched``.  A row NOT stamped since the
+    last close provably has identical derivation inputs (counts, phase,
+    min_member, unschedulable marks), so its phase/count writes would be
+    no-ops and its at-open compare would read unchanged — the per-job visit
+    therefore covers only touched rows plus the standing need-record set
+    (stuck tasks, Pending/Unknown phases, condition-bearing jobs, PDB jobs
+    with Pending tasks), and the per-queue phase counts come off the
+    j_phase column as one bincount over every session row.
+    ``KB_DELTA_CLOSE=0`` forces the full visit (the bit-exact oracle the
+    equivalence tests compare against).
+
     The count columns are pulled into plain Python lists once (numpy scalar
-    indexing inside a 12.5k-job loop costs more than the loop body) and the
+    indexing inside the visit loop costs more than the loop body) and the
     per-job conditions scan is replaced by the session's unschedulable-mark
     set (update_job_condition records the uids as it writes the conditions —
     transition_id == ssn.uid is exactly 'marked this session')."""
+    import os
+
+    import numpy as np
+
+    from kube_batch_tpu.api.columns import CODE_PHASE, N_PHASES, PHASE_CODE
+
     cols = ssn.columns
-    rows, jobs_list = ssn.session_rows()
+    rows_all = np.flatnonzero(cols.j_sess)
+    jc = cols.j_counts
+    PEND_I, ALLOC_I = int(TaskStatus.PENDING), int(TaskStatus.ALLOCATED)
+    pend_code = PHASE_CODE[PodGroupPhase.PENDING]
+    unk_code = PHASE_CODE[PodGroupPhase.UNKNOWN]
+    delta_close = os.environ.get("KB_DELTA_CLOSE", "").strip().lower() not in (
+        "0", "false", "off", "no"
+    )
+    if delta_close and rows_all.size:
+        phase_codes = cols.j_phase[rows_all]
+        stuck_rows = (jc[rows_all, PEND_I] + jc[rows_all, ALLOC_I]) > 0
+        visit = (
+            cols.j_touched[rows_all]
+            | stuck_rows
+            | (phase_codes == pend_code)
+            | (phase_codes == unk_code)
+            | cols.j_has_conds[rows_all]
+            | (~cols.j_has_pg[rows_all] & cols.j_pdb[rows_all]
+               & (jc[rows_all, PEND_I] > 0))
+        )
+        rows = rows_all[visit]
+    else:
+        rows = rows_all
+    jobs_list = [cols.job_by_row[r] for r in rows.tolist()]
     counts = cols.j_counts[rows]
     running_l = counts[:, int(TaskStatus.RUNNING)].tolist()
     failed_l = counts[:, int(TaskStatus.FAILED)].tolist()
@@ -900,9 +962,8 @@ def _close_status_columnar(ssn: Session) -> None:
     record_event = ssn.cache.record_job_status_event
     updates = []
     append = updates.append
-    # per-queue podgroup-phase counts (QueueStatus writeback) accumulate in
-    # the same pass — phases are right here; a second walk would cost more
-    qcounts: Dict[str, dict] = {}
+    rows_l = rows.tolist()
+    j_phase = cols.j_phase
     for i, job in enumerate(jobs_list):
         pg = job.pod_group
         if pg is None:
@@ -927,17 +988,37 @@ def _close_status_columnar(ssn: Session) -> None:
         else:
             phase = pg.phase
         pg.phase, pg.running, pg.failed, pg.succeeded = phase, r, f, s
-        qc = qcounts.get(job.queue)
-        if qc is None:
-            qc = qcounts[job.queue] = queue_phase_counts()
-        qc[phase.value.lower()] += 1
+        j_phase[rows_l[i]] = PHASE_CODE[phase]
         changed = prev_get(job.uid) != (phase, r, f, s)
         need_record = bool(stuck_l[i]) or phase is PENDING or phase is UNKNOWN
         if changed or need_record or pg.conditions:
             append((job, changed, need_record))
+    # per-queue podgroup-phase counts (QueueStatus writeback): one bincount
+    # over EVERY session row's j_phase — visited rows were just rewritten,
+    # unvisited rows' phases provably could not move this cycle
+    qcounts: Dict[str, dict] = {}
+    if rows_all.size:
+        qmask = cols.j_has_pg[rows_all] & ~cols.j_shadow[rows_all]
+        sel = rows_all[qmask]
+        pcodes = cols.j_phase[sel]
+        ok = pcodes >= 0
+        sel, pcodes = sel[ok], pcodes[ok]
+        if sel.size:
+            pairs = cols.j_queue[sel].astype(np.int64) * N_PHASES + pcodes
+            bc = np.bincount(
+                pairs, minlength=cols.queues.cap * N_PHASES
+            ).reshape(cols.queues.cap, N_PHASES)
+            for qi in np.flatnonzero(bc.any(axis=1)).tolist():
+                qc = queue_phase_counts()
+                for code in range(N_PHASES):
+                    qc[CODE_PHASE[code].value.lower()] = int(bc[qi, code])
+                qcounts[cols.queue_names[qi]] = qc
     ssn.cache.update_job_statuses_bulk(updates)
     _count_gate_dropped(ssn, qcounts)
     ssn.cache.update_queue_statuses(qcounts)
+    # consumed: ingest that lands after this point (deferred mutations,
+    # residue reverts) re-stamps rows for the next cycle's visit
+    cols.j_touched[:] = False
 
 
 def _count_gate_dropped(ssn: Session, qcounts: Dict[str, dict]) -> None:

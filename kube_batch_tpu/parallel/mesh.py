@@ -18,6 +18,7 @@ annotate, let XLA insert collectives)."""
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Optional
 
@@ -51,7 +52,12 @@ def should_shard(n_nodes_padded: int) -> bool:
     """The production actions' auto-selection gate: a mesh exists and the
     node axis is big enough that sharding beats one chip (the reference's
     16-worker fan-out is always on, scheduler_helper.go:34-64; here the
-    analog turns on with the hardware)."""
+    analog turns on with the hardware).  KB_SHARD=0 forces the single-chip
+    path (the sharded-vs-single equivalence tests' knob)."""
+    if os.environ.get("KB_SHARD", "").strip().lower() in (
+        "0", "false", "off", "no"
+    ):
+        return False
     return n_nodes_padded >= SHARD_MIN_NODES and default_mesh() is not None
 
 
@@ -125,11 +131,10 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
 _jit_cache: dict = {}
 
 
-def sharded_allocate_solve(
-    snap: DeviceSnapshot, config: AllocateConfig, mesh: Mesh
-) -> AllocateResult:
-    """The allocate solve jitted over the mesh. Node-axis inputs/outputs are
-    sharded; the assignment vector comes back replicated."""
+def allocate_solve_fn(mesh: Mesh, config: AllocateConfig):
+    """The memoized jitted allocate solve for (mesh, config) — the dispatch
+    below calls it; the jaxpr audit (analysis/jaxpr_audit.py) traces it
+    abstractly so KBT101-104 cover the sharded variant in tier-1."""
     key = (mesh, config)
     fn = _jit_cache.get(key)
     if fn is None:
@@ -152,6 +157,15 @@ def sharded_allocate_solve(
             out_shardings=out_shardings,
         )
         _jit_cache[key] = fn
+    return fn
+
+
+def sharded_allocate_solve(
+    snap: DeviceSnapshot, config: AllocateConfig, mesh: Mesh
+) -> AllocateResult:
+    """The allocate solve jitted over the mesh. Node-axis inputs/outputs are
+    sharded; the assignment vector comes back replicated."""
+    fn = allocate_solve_fn(mesh, config)
     with mesh:
         return fn(snap)
 
@@ -160,10 +174,9 @@ def _solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
     return allocate_solve(snap, config)
 
 
-def sharded_failure_histogram(snap: DeviceSnapshot, mesh: Mesh):
-    """The lazy fit-error histogram over the mesh: [T, N]-scale predicate
-    masks shard along the node axis, the per-reason node counts all-reduce
-    into the replicated [T, N_REASONS] result."""
+def failure_histogram_fn(mesh: Mesh):
+    """Memoized jitted sharded fit-error histogram for `mesh` (dispatch +
+    jaxpr-audit entry point)."""
     from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
     key = (mesh, "fail_hist")
@@ -175,16 +188,21 @@ def sharded_failure_histogram(snap: DeviceSnapshot, mesh: Mesh):
             out_shardings=NamedSharding(mesh, P()),
         )
         _jit_cache[key] = fn
+    return fn
+
+
+def sharded_failure_histogram(snap: DeviceSnapshot, mesh: Mesh):
+    """The lazy fit-error histogram over the mesh: [T, N]-scale predicate
+    masks shard along the node axis, the per-reason node counts all-reduce
+    into the replicated [T, N_REASONS] result."""
+    fn = failure_histogram_fn(mesh)
     with mesh:
         return fn(snap)
 
 
-def sharded_evict_solve(
-    snap: DeviceSnapshot, config: EvictConfig, mesh: Mesh
-) -> EvictResult:
-    """The eviction solve (preempt/reclaim) jitted over the mesh: node-axis
-    inputs shard exactly like the allocate solve's; every EvictResult field
-    is task-axis, so outputs replicate."""
+def evict_solve_fn(mesh: Mesh, config: EvictConfig):
+    """Memoized jitted sharded eviction solve for (mesh, config) (dispatch
+    + jaxpr-audit entry point)."""
     key = (mesh, config, "evict")
     fn = _jit_cache.get(key)
     if fn is None:
@@ -199,6 +217,16 @@ def sharded_evict_solve(
             out_shardings=out_shardings,
         )
         _jit_cache[key] = fn
+    return fn
+
+
+def sharded_evict_solve(
+    snap: DeviceSnapshot, config: EvictConfig, mesh: Mesh
+) -> EvictResult:
+    """The eviction solve (preempt/reclaim) jitted over the mesh: node-axis
+    inputs shard exactly like the allocate solve's; every EvictResult field
+    is task-axis, so outputs replicate."""
+    fn = evict_solve_fn(mesh, config)
     with mesh:
         return fn(snap)
 

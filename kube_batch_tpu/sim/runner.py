@@ -442,10 +442,17 @@ class SimRunner:
     def _finalize(self, cycles_run: int) -> Dict:
         report = self.metrics.report()
         cfg = self.cfg
+        # per-cycle device-resident scatter counters (api/resident.py), per
+        # solve path — the longitudinal twin of the bench's delta-vs-full
+        # bytes-moved evidence
+        from kube_batch_tpu.api.resident import scatter_summary
+
+        scatter = scatter_summary(self.cache.columns.resident_counters())
         report.update({
             "unit": "virtual_seconds",
             "seed": cfg.seed,
             "cycles_run": cycles_run,
+            "resident_scatter": scatter,
             "config": {
                 "n_nodes": cfg.n_nodes,
                 "queues": list(map(list, cfg.queues)),

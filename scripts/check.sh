@@ -7,6 +7,12 @@
 # The jaxpr tier imports jax; pin it to CPU so the check never touches (or
 # hangs on) an accelerator tunnel — tracing is abstract, the backend only
 # matters for the donation table, and CPU is the declared-() baseline.
+# A forced host-platform device count gives the audit a virtual mesh so the
+# SHARDED solve variants trace too (KBT101-104 over the sharded path,
+# without a multi-device CI mesh); an explicit count in XLA_FLAGS wins.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="--xla_force_host_platform_device_count=4${XLA_FLAGS:+ $XLA_FLAGS}"
+fi
 exec env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr "$@"

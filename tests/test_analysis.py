@@ -782,6 +782,30 @@ class TestKBT010:
         """
         assert findings_for(src, "testing/x.py") == []
 
+    def test_enqueue_gate_solve_is_a_device_source(self):
+        # PR 5 dispatch shape: the jitted enqueue admission scan
+        src = """
+        import numpy as np
+        from kube_batch_tpu.ops.admission import enqueue_gate_solve
+
+        def gate(minr, cand, idle, quanta):
+            admitted = enqueue_gate_solve(minr, cand, idle, quanta)
+            return np.asarray(admitted)
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+
+    def test_scatter_factory_result_is_a_device_source(self):
+        # PR 5 dispatch shape: the per-mesh resident scatter factory form
+        # (`_mesh_shard_scatter_fn(mesh)(dev, rows, vals)`)
+        src = """
+        import numpy as np
+
+        def refresh(mesh, dev, rows, vals):
+            dev = _mesh_shard_scatter_fn(mesh)(dev, rows, vals)
+            return np.asarray(dev)
+        """
+        assert rule_ids(findings_for(src, "api/resident.py")) == ["KBT010"]
+
 
 # ---------------------------------------------------------------------------
 # dataflow: the def-use engine itself

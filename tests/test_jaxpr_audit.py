@@ -37,6 +37,24 @@ class TestRegistryClean:
         assert any("evict_solve" in n for n in names)
         assert any("resident" in n for n in names)
         assert any("pallas" in n for n in names)
+        assert any("enqueue_gate" in n for n in names)
+
+    def test_sharded_variants_traced_on_the_virtual_mesh(self):
+        """The conftest's forced 8-device CPU mesh stands in for multi-chip
+        hardware: the sharded solve variants and both mesh scatters must be
+        registered and trace clean (KBT101-104 over the sharded path)."""
+        from kube_batch_tpu.analysis.jaxpr_audit import sharded_registry
+
+        assert len(jax.devices()) >= 2
+        sharded = sharded_registry()
+        names = {e.name for e in sharded}
+        assert any("sharded_allocate_solve" in n for n in names)
+        assert any("sharded_failure_histogram" in n for n in names)
+        assert any("sharded_evict_solve" in n for n in names)
+        assert any("scatter_sharded" in n for n in names)
+        assert any("scatter_repl" in n for n in names)
+        findings = run_audit(registry=sharded)
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
 
 
 class TestPlantedBugs:

@@ -220,6 +220,12 @@ def test_per_cycle_device_cache_round_trips_bit_exact():
 
     rng = np.random.default_rng(5)
     cache = _mk_cache()
+    # realistic axis capacities: with micro columns the cache rightly
+    # prefers whole-column re-uploads (cheaper than the smallest fixed
+    # scatter payload) and the delta path under test would never engage.
+    # Node axis stays below SHARD_MIN_NODES so the actions keep the
+    # single-device dispatch this test exercises
+    cache.columns.reserve(n_tasks=2048, n_nodes=128, n_jobs=512)
     conf = load_scheduler_conf(None)
     churn = _Churner(cache, rng)
     for _ in range(3):
@@ -242,7 +248,7 @@ def test_per_cycle_device_cache_round_trips_bit_exact():
         finally:
             close_session(ssn)
         cache.flush_binds()
-    pcd = cols._per_cycle_dev
+    pcd = cols._per_cycle_dev.get(None)
     assert pcd is not None and pcd.scatter_updates > 0, (
         "scatter-delta path never engaged"
     )
@@ -283,6 +289,54 @@ def test_delta_disabled_forces_full_path():
         close_session(ssn)
         assert cache.last_open_path == "full"
         assert cache.columns.last_snapshot_path == "full"
+
+
+def test_close_session_delta_matches_full_rebuild(monkeypatch):
+    """The delta close-status pass (visit only touched/need-record rows,
+    qcounts off the j_phase column) must leave byte-identical end state to
+    the forced full visit (KB_DELTA_CLOSE=0): PodGroup phases/counts,
+    recorded events, and QueueStatus writes, over randomized churn."""
+
+    def run(delta_close: bool, seed=13, cycles=10):
+        if delta_close:
+            monkeypatch.delenv("KB_DELTA_CLOSE", raising=False)
+        else:
+            monkeypatch.setenv("KB_DELTA_CLOSE", "0")
+        rng = np.random.default_rng(seed)
+        cache = _mk_cache()
+        conf = load_scheduler_conf(None)
+        churn = _Churner(cache, rng)
+        for _ in range(4):
+            churn.add_gang()
+        states = []
+        for _ in range(cycles):
+            churn.step()
+            ssn = open_session(cache, conf.tiers)
+            try:
+                for name in conf.actions:
+                    get_action(name).execute(ssn)
+            finally:
+                close_session(ssn)
+            cache.flush_binds()
+            states.append({
+                uid: (j.pod_group.phase, j.pod_group.running,
+                      j.pod_group.failed, j.pod_group.succeeded)
+                for uid, j in sorted(cache.jobs.items())
+                if j.pod_group is not None
+            })
+            states.append(
+                {q: dict(c) for q, c in
+                 sorted(cache._queue_status_written.items())}
+            )
+        events = list(cache.events)
+        assert cache.columns.check_consistency(cache) == []
+        cache.stop()
+        return states, events
+
+    delta_states, delta_events = run(True)
+    full_states, full_events = run(False)
+    assert delta_states == full_states
+    assert delta_events == full_events
 
 
 def test_stale_fit_state_cleared_across_delta_opens():
