@@ -44,6 +44,7 @@ class _ImportMap(ast.NodeVisitor):
         self.time_names: Set[str] = set()
         self.datetime_names: Set[str] = set()  # module or datetime class
         self.numpy_names: Set[str] = set()
+        self.urllib_names: Set[str] = set()  # urllib / urllib.request module
         # from-imports of individual wall-clock / blocking callables:
         # local name → original attribute name
         self.from_time: Dict[str, str] = {}
@@ -58,6 +59,8 @@ class _ImportMap(ast.NodeVisitor):
                 self.datetime_names.add(bound)
             elif alias.name == "numpy":
                 self.numpy_names.add(bound)
+            elif alias.name in ("urllib", "urllib.request"):
+                self.urllib_names.add(bound)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         if node.module == "time":
@@ -418,6 +421,79 @@ class HostSyncRule(Rule):
                            "value on device or annotate the sync point")
 
 
+# --------------------------------------------------------------------------
+# KBT011 — raw transport / ad-hoc retry loop outside k8s/transport.py
+# --------------------------------------------------------------------------
+
+
+class RawTransportRule(Rule):
+    """Historical bug: the watch loop hand-rolled a jitterless 1→30s
+    doubling backoff while `ApiTransport.request()` had no retry policy at
+    all — every apiserver caller invented its own (or no) failure handling.
+    The robustness PR centralized classification, capped decorrelated-jitter
+    backoff, per-endpoint-class budgets, and the circuit breaker in
+    k8s/transport.py; this rule keeps it that way: a raw
+    `urllib.request.urlopen` or an ad-hoc `time.sleep` retry loop anywhere
+    else in k8s//cmd/ bypasses the classified policy (and the breaker's
+    fail-fast), so every apiserver call is forced through the transport."""
+
+    id = "KBT011"
+    title = "raw urllib / ad-hoc sleep retry loop outside the transport"
+    scope = ("k8s/", "cmd/")
+
+    @staticmethod
+    def _exempt(relpath: str) -> bool:
+        # the transport module IS the sanctioned home of urlopen + backoff
+        return relpath.endswith("k8s/transport.py") or relpath == "transport.py"
+
+    def check(self, tree: ast.Module, relpath: str):
+        if self._exempt(relpath):
+            return
+        imports = _ImportMap()
+        imports.visit(tree)
+        # lexical spans of loop bodies (retry loops hide sleeps in them)
+        loop_spans: List[Tuple[int, int]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While)):
+                lines = [
+                    getattr(n, "end_lineno", None) or n.lineno
+                    for n in _walk_skipping_defs(node.body)
+                    if hasattr(n, "lineno")
+                ]
+                if lines:
+                    loop_spans.append((node.body[0].lineno, max(lines)))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_urlopen = False
+            is_sleep = False
+            if isinstance(func, ast.Attribute):
+                base = _leftmost_name(func)
+                if func.attr == "urlopen" and base in imports.urllib_names:
+                    is_urlopen = True
+                elif func.attr == "sleep" and base in imports.time_names:
+                    is_sleep = True
+            elif isinstance(func, ast.Name):
+                if func.id in imports.from_urllib and func.id == "urlopen":
+                    is_urlopen = True
+                elif imports.from_time.get(func.id) == "sleep":
+                    is_sleep = True
+            if is_urlopen:
+                yield (node.lineno, node.col_offset,
+                       "raw `urlopen()` outside k8s/transport.py bypasses "
+                       "the classified retry policy and the circuit "
+                       "breaker; route the call through ApiTransport")
+            elif is_sleep and any(
+                lo <= node.lineno <= hi for lo, hi in loop_spans
+            ):
+                yield (node.lineno, node.col_offset,
+                       "ad-hoc sleep inside a loop looks like a hand-rolled "
+                       "retry/backoff; use the transport's RetryPolicy "
+                       "(decorrelated jitter, budgets) or annotate why this "
+                       "pacing is not a retry")
+
+
 from kube_batch_tpu.analysis.flowrules import FLOW_RULES  # noqa: E402
 
 ALL_RULES = (
@@ -426,6 +502,7 @@ ALL_RULES = (
     ModuleStateRule(),
     FailOpenTranslateRule(),
     HostSyncRule(),
+    RawTransportRule(),
 ) + FLOW_RULES
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -918,6 +918,39 @@ class ColumnStore:
             out["single" if key is None else "sharded"] = cache.counters()
         return out
 
+    def drop_resident(self) -> None:
+        """Cold-start the per-cycle device residency: the next solve
+        dispatch pays a full upload + prewarm. The warm-standby path calls
+        this only when revalidation FAILS."""
+        self._per_cycle_dev.clear()
+
+    def revalidate_resident(self, cache) -> Dict:
+        """Warm-standby revalidation (leader failover): decide whether the
+        surviving per-cycle device caches may keep serving after the host
+        model was rebuilt from the pod store.
+
+        KEEP when every resident cache has synced at least one snapshot
+        (version token > 0) and the rebuilt store passes
+        ``check_consistency`` — the mirrors then describe a state the next
+        swap's vectorized diff can reconcile with ordinary scatter deltas,
+        so the compiled executables and resident buffers survive and
+        failover pays no recompile/re-upload. DROP (cold start) on any
+        consistency error or an unsynced cache — a mirror of unknown
+        provenance must not feed a solve."""
+        errors = [str(e) for e in self.check_consistency(cache)]
+        tokens = {
+            ("single" if key is None else "sharded"): rc.version
+            for key, rc in self._per_cycle_dev.items()
+        }
+        ok = not errors and all(v > 0 for v in tokens.values())
+        if not ok and self._per_cycle_dev:
+            self.drop_resident()
+        return {
+            "mode": "warm" if ok else "cold",
+            "resident_tokens": tokens,
+            "errors": errors,
+        }
+
     def resident_features(self, snap, mesh=None):
         """`snap` with the ingest-static feature arrays swapped for cached
         DEVICE-RESIDENT copies, re-uploaded only when the column's axis

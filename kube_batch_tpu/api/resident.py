@@ -224,6 +224,12 @@ class PerCycleDeviceCache:
         # guaranteed all-clean diff over every field, skipped by identity
         self._last_in = None
         self._last_out = None
+        # monotonic swap version — the warm-standby revalidation's token:
+        # a cache that has synced at least one snapshot (version > 0) and
+        # passes the store's consistency check after a failover rebuild is
+        # kept (buffers + compiled specializations survive; the next swap's
+        # mirror diff absorbs any residual divergence as ordinary deltas)
+        self.version = 0
         # diagnostics for the bench / tests
         self.full_uploads = 0
         self.scatter_updates = 0
@@ -236,6 +242,7 @@ class PerCycleDeviceCache:
 
     def counters(self) -> Dict[str, int]:
         return {
+            "version": self.version,
             "full_uploads": self.full_uploads,
             "scatter_updates": self.scatter_updates,
             "clean_hits": self.clean_hits,
@@ -328,6 +335,7 @@ class PerCycleDeviceCache:
         returns the memoized result without re-diffing."""
         if snap is self._last_in:
             return self._last_out
+        self.version += 1
         updates = {
             field: self._refresh(field, np.asarray(getattr(snap, field)))
             for field in PER_CYCLE_FIELDS

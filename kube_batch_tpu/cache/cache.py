@@ -37,6 +37,7 @@ from kube_batch_tpu.cache.fake import (
     FakeStatusUpdater,
     FakeVolumeBinder,
 )
+from kube_batch_tpu.k8s.transport import CircuitOpenError
 from kube_batch_tpu.utils.assertions import graft_assert
 
 logger = logging.getLogger("kube_batch_tpu")
@@ -180,8 +181,20 @@ class SchedulerCache:
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.default_priority: int = 0
-        # failed bind/evict tasks awaiting resync (cache.go:559-581)
-        self.err_tasks: List[TaskInfo] = []
+        # failed bind/evict tasks awaiting resync (cache.go:559-581) — a
+        # bounded backoff queue with poison quarantine (cache/resync.py)
+        # instead of the seed's flat retry-every-tick list
+        from kube_batch_tpu.cache.resync import ResyncQueue
+
+        self.resync = ResyncQueue(
+            backoff_cap=int(_os.environ.get("KB_RESYNC_BACKOFF_CAP", "8")),
+            poison_after=int(_os.environ.get("KB_RESYNC_POISON", "5")),
+            max_entries=int(_os.environ.get("KB_RESYNC_MAX", "4096")),
+        )
+        # degraded-cycle signal: while True (set by the scheduler when the
+        # cycle's soft time budget elapsed) or while the writeback breaker
+        # is open, close-time status flushes shed to the async pool / skip
+        self.shed_status_writes = False
         # pod store: the standalone source of truth the resync loop re-GETs
         # from (the apiserver analog)
         self.pods: Dict[str, Pod] = {}
@@ -397,6 +410,9 @@ class SchedulerCache:
             stored = self.pods.get(pod.key())
             if stored is not None and stored.node_name and not pod.node_name:
                 pod.node_name = stored.node_name
+            # an external change to a QUARANTINED pod releases it back into
+            # the ordinary flow — the rebuild below IS its fresh resync
+            self.resync.release(pod.key())
             # the add below would immediately recreate a placeholder the
             # delete retired — keep it alive across an update, or every
             # status event for such a pod flushes the node feature cache
@@ -412,9 +428,16 @@ class SchedulerCache:
                 return
             self._delete_pod_locked(pod)
 
-    def _delete_pod_locked(self, pod: Pod, retire_placeholder: bool = True) -> None:
+    def _delete_pod_locked(self, pod: Pod, retire_placeholder: bool = True,
+                           forget_resync: bool = True) -> None:
         self.pods.pop(pod.key(), None)
         self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
+        if forget_resync:
+            # external change/delete: all repair bookkeeping (incl. the
+            # quarantine) starts over. The resync pass's OWN delete+add
+            # rebuild passes False — it must not erase the very attempt
+            # history whose backoff it implements.
+            self.resync.forget(pod.key())
         self.dirty.note_pod(pod.key())
         self.dirty.note_job(job_id_for_pod(pod))
         release = getattr(self.volume_binder, "release_task", None)
@@ -677,6 +700,13 @@ class SchedulerCache:
                 # Binding subresource analog)
                 pod.node_name = hostname
                 self.events.append(("Scheduled", task.key(), hostname))
+                if self.resync.has_history():
+                    with self._lock:
+                        self.resync.note_success(task.key())
+        except CircuitOpenError:
+            # egress failing fast — park without charging the poison budget
+            logger.warning("bind of %s parked: egress breaker open", task.key())
+            self.resync_task(task, reason="breaker-open")
         except Exception as e:  # noqa: BLE001 — repair path mirrors resyncTask
             logger.error("bind of %s to %s failed: %s", task.key(), hostname, e)
             self.resync_task(task)
@@ -811,18 +841,43 @@ class SchedulerCache:
                     for pod, hostname in pairs:
                         pod.node_name = hostname
                     self.events.append_scheduled_batch(staged)
+                    if self.resync.has_history():
+                        with self._lock:
+                            for pod, _h in pairs:
+                                self.resync.note_success(pod.key())
+                    return
+                except CircuitOpenError:
+                    # egress failing fast: park the WHOLE batch for resync
+                    # without a per-pod call (or a per-pod log line) — the
+                    # degraded cycle keeps solving, decisions wait it out
+                    logger.warning(
+                        "binder breaker open; parking %d binds for resync",
+                        len(pairs))
+                    for task, hostname, pod in staged:
+                        if pod is not None:
+                            self.resync_task(task, reason="breaker-open")
                     return
                 except Exception:  # noqa: BLE001 — retry per-task below
                     logger.exception("bind_many failed; retrying per task")
+            breaker_parked = 0
             for task, hostname, pod in staged:
                 try:
                     if pod is not None:
                         self.binder.bind(pod, hostname)
                         pod.node_name = hostname  # binding ack (see above)
                         self.events.append(("Scheduled", task._key, hostname))
+                        if self.resync.has_history():
+                            with self._lock:
+                                self.resync.note_success(task._key)
+                except CircuitOpenError:
+                    breaker_parked += 1
+                    self.resync_task(task, reason="breaker-open")
                 except Exception as e:  # noqa: BLE001 — resyncTask repair path
                     logger.error("bind of %s to %s failed: %s", task._key, hostname, e)
                     self.resync_task(task)
+            if breaker_parked:
+                logger.warning("binder breaker open; parked %d binds for "
+                               "resync", breaker_parked)
 
         from concurrent.futures import ThreadPoolExecutor
 
@@ -860,6 +915,10 @@ class SchedulerCache:
             if pod is not None:
                 self.evictor.evict(pod)
                 self.events.append(("Evict", task.key(), reason))
+        except CircuitOpenError:
+            logger.warning("evict of %s parked: egress breaker open",
+                           task.key())
+            self.resync_task(task, reason="breaker-open")
         except Exception as e:  # noqa: BLE001
             logger.error("evict of %s failed: %s", task.key(), e)
             self.resync_task(task)
@@ -880,24 +939,63 @@ class SchedulerCache:
     # ------------------------------------------------------------------
     # repair: resync (cache.go:559-581, event_handlers.go:96-122)
     # ------------------------------------------------------------------
-    def resync_task(self, task: TaskInfo) -> None:
+    @property
+    def err_tasks(self) -> List[TaskInfo]:
+        """The pending repair backlog (read-only view; the queue itself
+        lives at ``self.resync``). Kept for the seed's observers/tests."""
         with self._lock:
-            self.err_tasks.append(task)
+            return self.resync.pending_tasks()
+
+    def resync_task(self, task: TaskInfo, reason: str = "error") -> None:
+        """Park a failed bind/evict decision for repair (cache.go:447-487).
+        ``reason="breaker-open"`` marks a decision the egress breaker
+        refused locally — it backs off but never counts toward the poison
+        budget (the server never saw it)."""
+        from kube_batch_tpu import metrics
+
+        with self._lock:
+            counted = self.resync.park(task, reason)
+            depth, quarantined = len(self.resync), len(self.resync.quarantined)
+        if counted:  # a quarantined key's park is a no-op — don't count it
+            metrics.register_resync_parked(reason)
+        metrics.set_resync_depth(depth, quarantined)
+
+    def _resync_one_locked(self, task: TaskInfo) -> None:
+        """Re-sync one errored task from the pod store: gone → forget;
+        present → rebuild (delete + add)."""
+        pod = self.pods.get(task.key())
+        if pod is None:
+            self.resync.forget(task.key())
+            return
+        self._delete_pod_locked(pod, forget_resync=False)
+        self.pods[pod.key()] = pod
+        self._add_task(TaskInfo(pod, self.spec), pod)
 
     def process_resync_tasks(self) -> None:
-        """Re-sync each errored task from the pod store: gone → delete;
-        present → rebuild (delete + add)."""
+        """One repair pass over the backoff queue: due tasks rebuild from
+        the pod store (and re-place next cycle); tasks that exhausted their
+        poison budget are shelved with a PodScheduled condition instead of
+        retrying forever."""
+        from kube_batch_tpu import metrics
+
+        poisoned: List[TaskInfo] = []
         with self._lock:
             if self._session_active:
                 return  # a cycle owns the cache; retry next repair tick
-            tasks, self.err_tasks = self.err_tasks, []
-            for task in tasks:
-                pod = self.pods.get(task.key())
-                if pod is None:
-                    continue
-                self._delete_pod_locked(pod)
-                self.pods[pod.key()] = pod
-                self._add_task(TaskInfo(pod, self.spec), pod)
+            self.resync.apply(self._resync_one_locked, poisoned.append)
+            depth, quarantined = len(self.resync), len(self.resync.quarantined)
+        for task in poisoned:
+            logger.error(
+                "task %s failed %d bind/evict repairs; quarantined until an "
+                "external change to its pod", task.key(),
+                self.resync.poison_after,
+            )
+            self.task_unschedulable(
+                task,
+                f"bind/evict failed {self.resync.poison_after} times; "
+                "quarantined pending an external pod change",
+            )
+        metrics.set_resync_depth(depth, quarantined)
 
     def rebuild_from_pod_store(self) -> None:
         """Re-list recovery (the informer re-list + WaitForCacheSync analog,
@@ -950,6 +1048,35 @@ class SchedulerCache:
                 self._maybe_collect_job(job)
         logger.warning("cache rebuilt from the pod store (%d pods, %d jobs)",
                        len(self.pods), len(self.jobs))
+
+    def failover_recover(self) -> Dict:
+        """Warm-standby takeover (leader failover): rebuild the host model
+        from the pod store (the re-list a fresh leader performs anyway),
+        then revalidate the surviving per-cycle device caches
+        (columns.revalidate_resident — version token + check_consistency).
+        On success the compiled executables and resident buffers are KEPT:
+        the next cycle's mirror diffs absorb any divergence as ordinary
+        scatter deltas, so failover pays no recompile/re-upload. Only a
+        failed revalidation cold-starts the residency.
+
+        Also flushes the repair queue's quarantine: the new leader's
+        rebuilt state supersedes the old leader's failure history."""
+        from kube_batch_tpu import metrics
+
+        self.rebuild_from_pod_store()
+        with self._lock:
+            report = self.columns.revalidate_resident(self)
+            # the rebuild re-derived every task from the store — stale
+            # failure history must not shelve tasks the new leader never
+            # saw fail
+            self.resync.reset_history()
+        metrics.register_leader_failover(report["mode"])
+        logger.warning(
+            "leader failover recovery: %s (resident tokens %s%s)",
+            report["mode"], report["resident_tokens"],
+            f"; errors: {report['errors']}" if report["errors"] else "",
+        )
+        return report
 
     def process_cleanup_jobs(self) -> None:
         """processCleanupJob analog (cache.go:533-557): sweep-collect jobs
@@ -1066,7 +1193,12 @@ class SchedulerCache:
                 # must re-read this job's status/schedulability
                 self.dirty.note_job(job.uid)
         if write:
-            self.status_updater.update_pod_group(pg)
+            if self._status_degraded():
+                from kube_batch_tpu import metrics
+
+                metrics.register_status_writes_shed(1)
+            else:
+                self.status_updater.update_pod_group(pg)
         # events accompany every status pass, rate-limited or not, once per
         # job per close (UpdateJobStatus → RecordJobStatusEvent,
         # cache.go:722-736); task_unschedulable dedups the conditions
@@ -1111,7 +1243,22 @@ class SchedulerCache:
                 next_write[job.uid] = now + jitter[i]
                 to_write.append(pg)
         updater = self.status_updater
-        if len(to_write) > 16 and getattr(updater, "parallel_safe", False):
+        parallel_safe = getattr(updater, "parallel_safe", False)
+        if to_write and self._status_degraded():
+            # degraded cycle (soft budget elapsed / writeback breaker open):
+            # shed the flush — async pool for parallel-safe updaters, skip
+            # otherwise. Status writes are re-derived every close, so the
+            # next healthy cycle converges; what matters now is that the
+            # scheduling loop keeps ticking instead of stalling in egress.
+            from kube_batch_tpu import metrics
+
+            metrics.register_status_writes_shed(len(to_write))
+            logger.warning("degraded cycle: shedding %d status writes%s",
+                           len(to_write),
+                           " to the async pool" if parallel_safe else "")
+            if parallel_safe:
+                self._update_pod_groups_pooled(to_write, wait=False)
+        elif len(to_write) > 16 and parallel_safe:
             self._update_pod_groups_pooled(to_write)
         else:
             for pg in to_write:
@@ -1127,6 +1274,13 @@ class SchedulerCache:
         written. Updaters without the seam (older fakes) are skipped."""
         write = getattr(self.status_updater, "update_queue_status", None)
         if write is None:
+            return
+        if self._status_degraded():
+            # deltas-only writeback: an unwritten count stays "dirty" in
+            # _queue_status_written and lands on the next healthy close
+            from kube_batch_tpu import metrics
+
+            metrics.register_status_writes_shed(len(counts))
             return
         # queues previously written but absent from this cycle's counts
         # (their podgroups all left) zero out rather than going stale
@@ -1144,11 +1298,22 @@ class SchedulerCache:
             except Exception as e:  # noqa: BLE001 — next close re-derives
                 logger.error("queue status write %s failed: %s", name, e)
 
-    def _update_pod_groups_pooled(self, pgs) -> None:
+    def _status_degraded(self) -> bool:
+        """Should close-time status flushes shed? True while the scheduler
+        flagged a blown cycle budget, or while the updater reports its
+        writeback path failing fast (K8sBackend.degraded → breaker open)."""
+        if self.shed_status_writes:
+            return True
+        probe = getattr(self.status_updater, "degraded", None)
+        return bool(probe()) if probe is not None else False
+
+    def _update_pod_groups_pooled(self, pgs, wait: bool = True) -> None:
         """16-worker status writeback (the jobUpdater's ParallelizeUntil,
         job_updater.go:18,51-53). Per-object failures log and continue —
         the next cycle re-derives and re-writes (convergence by re-running,
-        the reference ignores UpdatePodGroup errors the same way)."""
+        the reference ignores UpdatePodGroup errors the same way).
+        ``wait=False`` is the degraded cycle's shed: the writes drain on
+        the pool behind the ticking loop (stop() still reaps them)."""
         from concurrent.futures import ThreadPoolExecutor
 
         if self._status_pool is None:
@@ -1164,7 +1329,11 @@ class SchedulerCache:
                 logger.error("podgroup status write %s/%s failed: %s",
                              pg.namespace, pg.name, e)
 
-        list(self._status_pool.map(write, pgs))
+        if wait:
+            list(self._status_pool.map(write, pgs))
+        else:
+            for pg in pgs:
+                self._status_pool.submit(write, pg)
 
     # ------------------------------------------------------------------
     # snapshot (cache.go:584-654)

@@ -7,11 +7,13 @@ standalone analog uses an atomically-renamed lease file in the
 lock-object-namespace directory with the same timing constants and the same
 crash-on-loss contract.
 
-Wall-clock caveat: lease validity and renewal compare time.time() stamps
-across processes (the reference similarly trusts apiserver timestamps). An
-NTP step larger than renew_deadline can cause a spurious crash-on-loss or a
-brief dual-leader window; deploy with slewing (chrony/ntpd -x), not stepping,
-on the contending hosts."""
+Clock discipline: the lease RECORD carries wall-clock stamps (time.time())
+because other processes compare against them — that half keeps the
+reference's caveat (an NTP step larger than lease_duration can open a brief
+dual-leader window; deploy with slewing, not stepping). The local
+renew-DEADLINE bookkeeping, though, is process-private and now runs on
+time.monotonic(): a wall-clock step can no longer fake a missed renewal and
+spuriously crash a healthy leader."""
 
 from __future__ import annotations
 
@@ -59,6 +61,13 @@ class LeaderElector:
         self._stop = threading.Event()
         self._renew_thread: Optional[threading.Thread] = None
 
+    def reset(self) -> None:
+        """Re-arm a released elector so the warm-standby loop can contend
+        again in the SAME process (release() set _stop to reap the renew
+        thread; a fresh run() needs a clear event and no stale thread)."""
+        self._stop = threading.Event()
+        self._renew_thread = None
+
     # -- lease record ---------------------------------------------------
     def _read(self) -> Optional[dict]:
         try:
@@ -95,6 +104,8 @@ class LeaderElector:
                         continue
                 except OSError:
                     pass
+                # kbt: allow[KBT011] file-lock claim contention on local
+                # disk, not an apiserver call — no transport policy applies
                 time.sleep(0.05 * (attempt + 1))
         if fd is None:
             return False
@@ -134,14 +145,16 @@ class LeaderElector:
         failure = []
 
         def renew_loop():
-            last_renew = time.time()
+            # deadline bookkeeping is process-private → monotonic (module
+            # docstring); only the lease record itself stays wall-clock
+            last_renew = time.monotonic()
             while not self._stop.is_set():
                 self._stop.wait(self.retry_period)
                 if self._stop.is_set():
                     return
                 if self._try_acquire_or_renew():
-                    last_renew = time.time()
-                elif time.time() - last_renew > self.renew_deadline:
+                    last_renew = time.monotonic()
+                elif time.monotonic() - last_renew > self.renew_deadline:
                     failure.append(True)
                     if on_stopped_leading is not None:
                         on_stopped_leading()
@@ -275,7 +288,11 @@ class K8sLeaseElector(LeaderElector):
         import urllib.error
 
         try:
-            return self.transport.get_json(self._path, timeout=10)
+            # retry=False: the elector's retry_period loop IS the retry
+            # policy — in-call retries would stretch a renew attempt past
+            # the renew deadline (and the _RENEW_JOIN_TIMEOUT math)
+            return self.transport.get_json(self._path, timeout=10,
+                                           retry=False)
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
@@ -311,7 +328,7 @@ class K8sLeaseElector(LeaderElector):
                                      "namespace": self.namespace},
                         "spec": spec_new,
                     },
-                    timeout=10,
+                    timeout=10, retry=False,
                 )
                 return True
             spec = obj.get("spec") or {}
@@ -337,7 +354,8 @@ class K8sLeaseElector(LeaderElector):
                     spec.get("leaseTransitions") or 0
                 ) + 1
             obj["spec"] = spec_new
-            self.transport.request("PUT", self._path, obj, timeout=10)
+            self.transport.request("PUT", self._path, obj, timeout=10,
+                                   retry=False)
             return True
         except urllib.error.HTTPError as e:
             if e.code == 409:
@@ -380,7 +398,8 @@ class K8sLeaseElector(LeaderElector):
                     return
                 spec["holderIdentity"] = ""
                 obj["spec"] = spec
-                self.transport.request("PUT", self._path, obj, timeout=10)
+                self.transport.request("PUT", self._path, obj, timeout=10,
+                                       retry=False)
                 return
             except urllib.error.HTTPError as e:
                 if e.code == 409:

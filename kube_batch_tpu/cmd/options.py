@@ -22,6 +22,12 @@ class ServerOption:
     default_queue: str = "default"
     enable_leader_election: bool = False
     lock_object_namespace: str = ""
+    # warm-standby failover (BEYOND the reference's crash-on-loss): on a
+    # lost lease the process demotes to standby IN PLACE — keeping the
+    # compiled solve executables and device-resident buffers — and
+    # re-contends; on re-acquire the cache rebuilds from the pod store and
+    # revalidates the resident snapshot instead of cold-starting
+    leader_warm_standby: bool = False
     listen_address: str = ":8080"
     enable_priority_class: bool = True
     kube_api_qps: float = 50.0
@@ -81,6 +87,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="enable active/passive HA via a lease lock")
     parser.add_argument("--lock-object-namespace", default=d.lock_object_namespace,
                         help="namespace (directory) holding the leader lease")
+    parser.add_argument("--leader-warm-standby", action="store_true",
+                        default=d.leader_warm_standby,
+                        help="on lost leadership, demote to standby in-place "
+                             "(keep compiled solves + device-resident "
+                             "buffers) and re-contend instead of crashing")
     parser.add_argument("--listen-address", default=d.listen_address,
                         help="host:port for /metrics and the admin API")
     parser.add_argument("--priority-class", dest="priority_class", default=d.enable_priority_class,
@@ -116,6 +127,7 @@ def parse(argv: Optional[List[str]] = None) -> ServerOption:
         default_queue=ns.default_queue,
         enable_leader_election=ns.leader_elect,
         lock_object_namespace=ns.lock_object_namespace,
+        leader_warm_standby=ns.leader_warm_standby,
         listen_address=ns.listen_address,
         enable_priority_class=ns.priority_class,
         kube_api_qps=ns.kube_api_qps,

@@ -36,7 +36,7 @@ from kube_batch_tpu.api import serialize
 from kube_batch_tpu.api.pod import PersistentVolume, PodDisruptionBudget
 from kube_batch_tpu.api.types import PodGroupPhase, queue_phase_counts
 from kube_batch_tpu.cache.cache import SchedulerCache
-from kube_batch_tpu.cmd.leader_election import LeaderElector
+from kube_batch_tpu.cmd.leader_election import LeaderElector, LostLeadership
 from kube_batch_tpu.cmd.options import ServerOption
 from kube_batch_tpu.scheduler import Scheduler
 from kube_batch_tpu.version import version_string
@@ -193,6 +193,8 @@ def make_handler(cache: SchedulerCache):
                             f = f.f_back
                         stacks[tuple(key)] += 1
                     n_samples += 1
+                    # kbt: allow[KBT011] profiler sampling cadence — a
+                    # fixed-interval sampler, not a retry/backoff loop
                     _time.sleep(interval)
                 out = [
                     f"samples: {n_samples} over {seconds:.1f}s "
@@ -340,6 +342,13 @@ class RateLimitedStatusUpdater(RateLimitedBackend):
     def parallel_safe(self):
         return getattr(self._backend, "parallel_safe", False)
 
+    def degraded(self):
+        """Forward the writeback-breaker probe: without this passthrough
+        the cache's degraded-cycle shedding would never see the wrapped
+        K8sBackend's open breaker."""
+        probe = getattr(self._backend, "degraded", None)
+        return bool(probe()) if probe is not None else False
+
     def update_pod_group(self, pg):
         self._take()
         return self._backend.update_pod_group(pg)
@@ -351,6 +360,40 @@ class RateLimitedStatusUpdater(RateLimitedBackend):
     def update_queue_status(self, name, counts):
         self._take()
         return self._backend.update_queue_status(name, counts)
+
+
+def run_warm_standby(elector, sched: Scheduler, cache: SchedulerCache,
+                     max_takeovers: Optional[int] = None) -> None:
+    """Leadership loop with in-place warm standby (BEYOND the reference's
+    crash-on-loss): a lost lease stops the scheduling loop but NOT the
+    process — the jit-compiled solve executables and the device-resident
+    snapshot stay alive — and the elector re-contends. On every
+    (re-)acquire the cache recovers through ``failover_recover``: rebuild
+    from the pod store (the watch keeps feeding it while standby), then
+    revalidate-or-drop the resident device cache, so a failover normally
+    pays NO recompile and NO full re-upload.
+
+    ``max_takeovers`` bounds the loop for tests; production runs forever
+    (a supervisor can still kill the process for a hard restart)."""
+    takeovers = 0
+
+    def lead():
+        # recovery runs AFTER the lease is won (elector.run invokes this
+        # only as leader) and before the first cycle of the new reign
+        if takeovers > 1:
+            cache.failover_recover()
+        sched.run_forever()
+
+    while max_takeovers is None or takeovers < max_takeovers:
+        takeovers += 1
+        try:
+            elector.run(lead, on_stopped_leading=sched.stop)
+            return  # clean stop (sched.stop() by other means)
+        except LostLeadership:
+            logger.warning(
+                "leadership lost; demoting to warm standby (resident cache "
+                "kept) and re-contending")
+            elector.reset()
 
 
 def run(opt: ServerOption) -> None:
@@ -386,7 +429,7 @@ def run(opt: ServerOption) -> None:
         # same shared token bucket as every other egress write
         volume_binder = K8sPVLedger(
             transport=getattr(backend, "transport", None)
-            or ApiTransport(opt.master, **auth),
+            or ApiTransport(opt.master, role="pv", **auth),
             bucket=bucket,
         )
     else:
@@ -452,15 +495,18 @@ def run(opt: ServerOption) -> None:
                 from kube_batch_tpu.k8s.transport import ApiTransport
 
                 elector = K8sLeaseElector(
-                    ApiTransport(opt.master, **auth),
+                    ApiTransport(opt.master, role="lease", **auth),
                     namespace=opt.lock_object_namespace,
                 )
             else:
                 elector = LeaderElector(opt.lock_object_namespace)
-            # on lease loss the elector stops the loop so run() can raise —
-            # the crash-on-loss contract (server.go:145); a supervisor restarts
-            # the process as a standby
-            elector.run(sched.run_forever, on_stopped_leading=sched.stop)
+            if opt.leader_warm_standby:
+                run_warm_standby(elector, sched, cache)
+            else:
+                # on lease loss the elector stops the loop so run() can
+                # raise — the crash-on-loss contract (server.go:145); a
+                # supervisor restarts the process as a standby
+                elector.run(sched.run_forever, on_stopped_leading=sched.stop)
         else:
             sched.run_forever()
     finally:

@@ -31,23 +31,42 @@ class K8sBackend:
     ):
         self.transport = ApiTransport(
             api_server, token=token, token_file=token_file,
-            ca_file=ca_file, insecure=insecure,
+            ca_file=ca_file, insecure=insecure, role="writeback",
         )
 
     # ---- Binder seam ---------------------------------------------------
     def bind(self, pod, hostname: str) -> None:
-        """POST the Binding subresource (the defaultBinder, cache.go:115-126)."""
-        self.transport.request(
-            "POST",
-            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
-            {
-                "apiVersion": "v1",
-                "kind": "Binding",
-                "metadata": {"name": pod.name, "namespace": pod.namespace,
-                             "uid": pod.uid},
-                "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
-            },
-        )
+        """POST the Binding subresource (the defaultBinder, cache.go:115-126).
+
+        A 409 Conflict is idempotent success: the pod is already bound —
+        almost always by our OWN earlier request that timed out client-side
+        but landed server-side (the retrying transport makes this window
+        routine). Raising would loop the task through resync for a bind
+        that already happened; mirrors the evict 404 handling below."""
+        try:
+            self.transport.request(
+                "POST",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": pod.name, "namespace": pod.namespace,
+                                 "uid": pod.uid},
+                    "target": {"apiVersion": "v1", "kind": "Node",
+                               "name": hostname},
+                },
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                logger.info("bind of %s/%s: already bound (409) — treating "
+                            "as success", pod.namespace, pod.name)
+                return
+            raise
+
+    def degraded(self) -> bool:
+        """True while the transport's writeback breaker is failing fast —
+        the cache's degraded-cycle checks (status shedding) read this."""
+        return self.transport.degraded()
 
     # ---- Evictor seam --------------------------------------------------
     def evict(self, pod) -> None:

@@ -58,7 +58,7 @@ class WatchAdapter:
         self.cache = cache
         self.transport = ApiTransport(
             api_server, token=token, token_file=token_file,
-            ca_file=ca_file, insecure=insecure,
+            ca_file=ca_file, insecure=insecure, role="watch",
         )
         self.resources = tuple(resources)
         # injectable for tests: kind → iterable of (event_type, object);
@@ -180,7 +180,10 @@ class WatchAdapter:
                 apply_event(self.cache, kind, etype, obj)
             on_seeded()
             return
-        backoff = 1.0
+        # reconnect delays come from the transport's shared RetryPolicy
+        # (decorrelated jitter, capped) — the watch's old private 1→30s
+        # doubling marched every resource's reconnect in lockstep
+        backoff = self.transport.retry.backoff_state()
         rv: Optional[str] = None
         seeded = False
         while not self._stop.is_set():
@@ -210,13 +213,13 @@ class WatchAdapter:
                             break
                         raise RuntimeError(f"watch error for {kind}: {obj}")
                     apply_event(self.cache, kind, etype, obj)
-                backoff = 1.0
+                backoff.reset()
             except Exception as e:  # noqa: BLE001 — reconnect with backoff
-                logger.warning("watch %s failed (%s); reconnecting in %.0fs",
-                               kind, e, backoff)
-                if self._stop.wait(backoff):
+                delay = backoff.next()
+                logger.warning("watch %s failed (%s); reconnecting in %.1fs",
+                               kind, e, delay)
+                if self._stop.wait(delay):
                     return
-                backoff = min(backoff * 2, 30.0)
 
     # ---- lifecycle ----------------------------------------------------
     def replay(self, events: Iterable[Tuple[str, str, dict]]) -> None:

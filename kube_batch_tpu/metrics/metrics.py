@@ -157,6 +157,49 @@ HOST_FALLBACK_TASKS = Counter(
     f"{_SUBSYSTEM}_host_fallback_tasks_total",
     "Tasks placed by the O(nodes) host fallback scan",
 )
+# fault-hardening counters (robustness PR): classified transport retries,
+# per-host circuit-breaker state, degraded-cycle parking/shedding, failover
+TRANSPORT_RETRIES = Counter(
+    f"{_SUBSYSTEM}_transport_retries_total",
+    "Apiserver transport retries by endpoint class and error kind",
+    ("endpoint_class", "kind"),
+)
+BREAKER_TRANSITIONS = Counter(
+    f"{_SUBSYSTEM}_circuit_breaker_transitions_total",
+    "Circuit breaker state transitions",
+    ("host", "state"),
+)
+BREAKER_OPEN = Counter(
+    f"{_SUBSYSTEM}_circuit_breaker_open",
+    "1 while the named host's circuit breaker is open",
+    ("host",),
+)
+RESYNC_PARKED = Counter(
+    f"{_SUBSYSTEM}_resync_parked_total",
+    "Failed bind/evict decisions parked in the resync queue, by reason",
+    ("reason",),
+)
+RESYNC_DEPTH = Counter(
+    f"{_SUBSYSTEM}_resync_queue_depth",
+    "Tasks currently awaiting resync repair",
+)
+RESYNC_QUARANTINED = Counter(
+    f"{_SUBSYSTEM}_resync_quarantined",
+    "Tasks shelved after exhausting their resync budget",
+)
+STATUS_WRITES_SHED = Counter(
+    f"{_SUBSYSTEM}_status_writes_shed_total",
+    "Status writebacks skipped or made async by a degraded cycle",
+)
+CYCLE_BUDGET_EXCEEDED = Counter(
+    f"{_SUBSYSTEM}_cycle_budget_exceeded_total",
+    "Cycles whose soft time budget elapsed before close",
+)
+LEADER_FAILOVER = Counter(
+    f"{_SUBSYSTEM}_leader_failover_total",
+    "Leadership takeovers, by resident-cache outcome (warm|cold)",
+    ("mode",),
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -171,6 +214,15 @@ METRICS = [
     JOB_RETRY_COUNTS,
     SLOW_REPLAY_JOBS,
     HOST_FALLBACK_TASKS,
+    TRANSPORT_RETRIES,
+    BREAKER_TRANSITIONS,
+    BREAKER_OPEN,
+    RESYNC_PARKED,
+    RESYNC_DEPTH,
+    RESYNC_QUARANTINED,
+    STATUS_WRITES_SHED,
+    CYCLE_BUDGET_EXCEEDED,
+    LEADER_FAILOVER,
 ]
 
 
@@ -236,6 +288,40 @@ def register_slow_replay_jobs(count: int) -> None:
 def register_host_fallback_tasks(count: int) -> None:
     if count:
         HOST_FALLBACK_TASKS.add(count)
+
+
+def register_transport_retry(endpoint_class: str, kind: str) -> None:
+    TRANSPORT_RETRIES.inc(endpoint_class, kind)
+
+
+def register_breaker_transition(host: str, state: str) -> None:
+    BREAKER_TRANSITIONS.inc(host, state)
+
+
+def set_breaker_open(host: str, is_open: int) -> None:
+    BREAKER_OPEN.set(float(is_open), host)
+
+
+def register_resync_parked(reason: str) -> None:
+    RESYNC_PARKED.inc(reason)
+
+
+def set_resync_depth(depth: int, quarantined: int) -> None:
+    RESYNC_DEPTH.set(float(depth))
+    RESYNC_QUARANTINED.set(float(quarantined))
+
+
+def register_status_writes_shed(count: int) -> None:
+    if count:
+        STATUS_WRITES_SHED.add(count)
+
+
+def register_cycle_budget_exceeded() -> None:
+    CYCLE_BUDGET_EXCEEDED.inc()
+
+
+def register_leader_failover(mode: str) -> None:
+    LEADER_FAILOVER.inc(mode)
 
 
 def render_prometheus() -> str:

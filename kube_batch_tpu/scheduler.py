@@ -58,6 +58,11 @@ class Scheduler:
         # re-reading in _maybe_reload_conf closes the window for the loop;
         # here, force one reload check on the first cycle instead.
         self._conf_mtime: Optional[float] = None
+        # soft per-cycle time budget (seconds, KB_CYCLE_BUDGET; 0 = off):
+        # a cycle that already overran it when the action pipeline finishes
+        # sheds the close-time status flush to the cache's async pool and
+        # keeps ticking, instead of stalling the loop in egress writeback
+        self.cycle_budget = float(os.environ.get("KB_CYCLE_BUDGET", "0") or 0)
 
     def _stat_conf(self) -> Optional[float]:
         if not self._conf_path:
@@ -106,6 +111,10 @@ class Scheduler:
             resync()
         self._maybe_reload_conf()
         start = telemetry.perf_counter()
+        # the soft budget reads the INJECTED clock (virtual elapsed inside
+        # one run_once is 0 by construction, so simulated cycles never shed
+        # nondeterministically; production's clock is the wall)
+        budget_start = self.clock.monotonic() if self.cycle_budget > 0 else 0.0
         ssn = open_session(self.cache, self.conf.tiers)
         # the configured pipeline, for actions whose behavior depends on
         # what runs after them (reclaim's idle-fit claimant gate)
@@ -118,7 +127,21 @@ class Scheduler:
                     action.name, (telemetry.perf_counter() - a_start) * 1e6
                 )
         finally:
-            close_session(ssn)
+            shed = (
+                self.cycle_budget > 0
+                and self.clock.monotonic() - budget_start > self.cycle_budget
+            )
+            if shed:
+                logger.warning(
+                    "cycle over its %.2fs soft budget before close; shedding "
+                    "the status flush", self.cycle_budget)
+                metrics.register_cycle_budget_exceeded()
+                self.cache.shed_status_writes = True
+            try:
+                close_session(ssn)
+            finally:
+                if shed:
+                    self.cache.shed_status_writes = False
         metrics.observe_e2e_latency((telemetry.perf_counter() - start) * 1e3)
         # drain async binder dispatch (cache.go:478's goroutines) outside the
         # measured cycle so callers observe a deterministic post-cycle state
@@ -135,6 +158,9 @@ class Scheduler:
         cache_run = getattr(self.cache, "run", None)
         if cache_run is not None:
             cache_run(resync_period=min(self.schedule_period, 1.0))
+        # re-arm after a prior stop(): the warm-standby loop re-enters
+        # run_forever in the same process after a leadership loss
+        self._stop = False
         try:
             while not self._stop:
                 tick = self.clock.monotonic()

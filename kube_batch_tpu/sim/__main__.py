@@ -2,6 +2,9 @@
 
     python -m kube_batch_tpu.sim --seed 7 --preset smoke
     python -m kube_batch_tpu.sim --preset fault --trace /tmp/fault.jsonl
+    python -m kube_batch_tpu.sim --preset bind-storm        # chaos: binder flaps under a gang burst
+    python -m kube_batch_tpu.sim --preset brownout          # chaos: apiserver egress window outage
+    python -m kube_batch_tpu.sim --preset leader-failover   # chaos: warm-standby takeover mid-run
 
 Emits a single JSON report (BENCH_*.json style: `metric`/`value`/`unit`
 plus the longitudinal detail) on stdout. Same seed ⇒ byte-identical trace
@@ -20,7 +23,8 @@ from kube_batch_tpu.sim.runner import run_preset
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--preset", default="smoke",
-                    help="scenario: smoke | fault | churn (default smoke)")
+                    help="scenario: smoke | fault | churn | brownout | "
+                         "bind-storm | leader-failover (default smoke)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cycles", type=int, default=None,
                     help="override the preset's virtual-cycle budget")
@@ -43,7 +47,8 @@ def main(argv=None) -> int:
     print(out, flush=True)
     errs = report.get("invariants", {}).get("errors", [])
     recovered = report.get("fault_recovery", {}).get("recovered", True)
-    return 0 if not errs and recovered else 1
+    duplicates = report.get("bind_integrity", {}).get("duplicate_binds", 0)
+    return 0 if not errs and recovered and not duplicates else 1
 
 
 if __name__ == "__main__":

@@ -32,11 +32,15 @@ NODE_CRASH = "node-crash"
 NODE_READD = "node-readd"
 BIND_FAIL = "bind-fail"              # next N binder calls fail (resync path)
 WATCH_FLAP = "watch-flap"            # watch reconnect: full MODIFIED replay
+BROWNOUT = "apiserver-brownout"      # every egress call fails for a window
+BROWNOUT_END = "brownout-end"
+LEADER_FAILOVER = "leader-failover"  # leadership lost; warm standby takes over
 # observed (recorded from scheduler effects, never scheduled)
 BIND = "bind"
 EVICT = "evict"
 
-FAULT_KINDS = frozenset({NODE_CRASH, NODE_READD, BIND_FAIL, WATCH_FLAP})
+FAULT_KINDS = frozenset({NODE_CRASH, NODE_READD, BIND_FAIL, WATCH_FLAP,
+                         BROWNOUT, BROWNOUT_END, LEADER_FAILOVER})
 
 
 @dataclasses.dataclass

@@ -43,6 +43,20 @@ def watch_flap_script(t: float) -> List[ev.SimEvent]:
     return [ev.SimEvent(t, ev.WATCH_FLAP, {})]
 
 
+def brownout_script(t: float, duration: float = 8.0) -> List[ev.SimEvent]:
+    """Apiserver brownout: every egress call (bind/evict) fails from t to
+    t + duration — the circuit breaker opens, the degraded cycle parks
+    decisions in the resync queue, and the loop must keep ticking."""
+    return [ev.SimEvent(t, ev.BROWNOUT, {"duration": duration})]
+
+
+def leader_failover_script(t: float) -> List[ev.SimEvent]:
+    """Leadership loss mid-run: the warm standby takes over — the cache
+    rebuilds from the pod store and revalidates (keeps) the resident
+    device cache (cache.failover_recover)."""
+    return [ev.SimEvent(t, ev.LEADER_FAILOVER, {})]
+
+
 class FaultInjector:
     """Applies fault events against a running simulation. The runner owns
     the clock/heap/trace; this class owns what a fault *means*."""
@@ -58,6 +72,9 @@ class FaultInjector:
             ev.NODE_READD: self._node_readd,
             ev.BIND_FAIL: self._bind_fail,
             ev.WATCH_FLAP: self._watch_flap,
+            ev.BROWNOUT: self._brownout,
+            ev.BROWNOUT_END: self._brownout_end,
+            ev.LEADER_FAILOVER: self._leader_failover,
         }[event.kind]
         handler(event)
 
@@ -118,6 +135,30 @@ class FaultInjector:
     def _bind_fail(self, event: ev.SimEvent) -> None:
         self.runner.trace.record(event)
         self.runner.kubelet.fail_next_binds(event.data["count"])
+
+    def _brownout(self, event: ev.SimEvent) -> None:
+        runner = self.runner
+        duration = float(event.data.get("duration", 8.0))
+        runner.trace.record(ev.SimEvent(event.time, ev.BROWNOUT,
+                                        {"duration": duration}))
+        runner.kubelet.set_brownout(True)
+        runner.heap.push(ev.SimEvent(event.time + duration,
+                                     ev.BROWNOUT_END, {}))
+
+    def _brownout_end(self, event: ev.SimEvent) -> None:
+        self.runner.trace.record(event)
+        self.runner.kubelet.set_brownout(False)
+
+    def _leader_failover(self, event: ev.SimEvent) -> None:
+        """Leadership loss: the warm standby takes over through the real
+        recovery path (SchedulerCache.failover_recover — pod-store rebuild
+        + resident-cache revalidation), exactly what cmd/server.py's
+        run_warm_standby does on LostLeadership."""
+        runner = self.runner
+        report = runner.failover()
+        runner.trace.record(ev.SimEvent(event.time, ev.LEADER_FAILOVER, {
+            "mode": report["mode"],
+        }))
 
     def _watch_flap(self, event: ev.SimEvent) -> None:
         """Watch reconnect: the stream replays the whole store as MODIFIED

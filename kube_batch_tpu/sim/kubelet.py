@@ -39,6 +39,7 @@ class SimKubelet:
         self._bind_acks: List[Tuple[str, str]] = []   # (pod key, node)
         self._evict_acks: List[str] = []              # pod key
         self._fail_binds = 0  # pending injected per-pod bind failures
+        self._brownout = False  # apiserver brownout: every call fails
         self.binds_total = 0
         self.bind_failures = 0
 
@@ -47,9 +48,23 @@ class SimKubelet:
         with self._lock:
             self._fail_binds += int(n)
 
+    def set_brownout(self, active: bool) -> None:
+        """The APISERVER_BROWNOUT window: while active, EVERY egress call
+        fails (the apiserver unreachable/overloaded) — upstream, the
+        circuit breaker opens and the degraded cycle parks decisions."""
+        with self._lock:
+            self._brownout = active
+
+    def _maybe_fail(self, what: str) -> None:
+        # lock held by caller
+        if self._brownout:
+            self.bind_failures += 1
+            raise SimBindFailure(f"apiserver brownout: {what}")
+
     # ---- Binder seam -----------------------------------------------------
     def bind(self, pod: Pod, hostname: str) -> None:
         with self._lock:
+            self._maybe_fail(f"bind {pod.key()}")
             if self._fail_binds > 0:
                 self._fail_binds -= 1
                 self.bind_failures += 1
@@ -59,10 +74,15 @@ class SimKubelet:
 
     def bind_many(self, pairs) -> None:
         """All-or-nothing batch (cache._dispatch_async retries per-task
-        through bind() on failure, which consumes the injected failure
-        budget one pod at a time)."""
+        through bind() on failure). A failed batch consumes ONE unit of the
+        injected budget — one failed API call — so the budget drains even
+        when the circuit breaker blocks the per-task fallback and the next
+        attempts are half-open bind_many probes."""
         with self._lock:
+            self._maybe_fail("bind_many")
             if self._fail_binds > 0:
+                self._fail_binds -= 1
+                self.bind_failures += 1
                 raise SimBindFailure("injected bind_many failure")
             for pod, hostname in pairs:
                 self._bind_acks.append((pod.key(), hostname))
@@ -71,6 +91,7 @@ class SimKubelet:
     # ---- Evictor seam ----------------------------------------------------
     def evict(self, pod: Pod) -> None:
         with self._lock:
+            self._maybe_fail(f"evict {pod.key()}")
             self._evict_acks.append(pod.key())
 
     # ---- runner drain ----------------------------------------------------

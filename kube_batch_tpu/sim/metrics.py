@@ -48,6 +48,9 @@ class LongitudinalMetrics:
         self.completions: Dict[str, float] = {}   # job uid → all-succeeded vt
         self.evictions = 0
         self.binds = 0
+        # per-POD arrival→bind latency (every incarnation), the bind-storm
+        # preset's headline: p99 must stay bounded while the binder flaps
+        self.pod_bind_latency: List[float] = []
         self.fairness: List[Dict] = []            # per-cycle queue shares
         self.cycles = 0
         # cross-cycle resident-snapshot bookkeeping: which open/snapshot
@@ -64,6 +67,9 @@ class LongitudinalMetrics:
     def note_bind(self, job_uid: str, t: float) -> None:
         self.binds += 1
         self.first_bind.setdefault(job_uid, t)
+
+    def note_pod_bind_latency(self, dt: float) -> None:
+        self.pod_bind_latency.append(dt)
 
     def note_eviction(self) -> None:
         self.evictions += 1
@@ -119,6 +125,7 @@ class LongitudinalMetrics:
             },
             "jct_vt": percentile_summary(jct),
             "wait_vt": percentile_summary(wait),
+            "pod_bind_latency_vt": percentile_summary(self.pod_bind_latency),
             "makespan_vt": makespan,
             "binds": self.binds,
             "evictions": self.evictions,

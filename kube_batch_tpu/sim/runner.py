@@ -31,10 +31,13 @@ from kube_batch_tpu.sim import kubelet as kl
 from kube_batch_tpu.sim import workload
 from kube_batch_tpu.sim.clock import EventHeap, VirtualClock
 from kube_batch_tpu.sim.events import SimEvent, TraceRecorder
+from kube_batch_tpu.k8s.transport import CircuitBreaker, GuardedBackend
 from kube_batch_tpu.sim.faults import (
     BUSIEST,
     FaultInjector,
     bind_fail_script,
+    brownout_script,
+    leader_failover_script,
     node_crash_script,
     watch_flap_script,
 )
@@ -86,7 +89,14 @@ def preset(name: str, seed: int = 0) -> SimConfig:
     """Named scenarios. `smoke` is the tier-1-sized run; `fault` crashes
     the busiest node under long-running gangs and must end with the
     displaced gangs re-placed; `churn` layers binder failures and a watch
-    flap over the smoke workload (repair-path coverage)."""
+    flap over the smoke workload (repair-path coverage).
+
+    Chaos presets (fault-hardening evidence): `brownout` fails every
+    egress call for a window — the breaker opens and the degraded cycle
+    must keep ticking; `bind-storm` lands hundreds of gang pods while the
+    binder flaps — zero lost/duplicate binds, bounded arrival→bind p99;
+    `leader-failover` loses leadership mid-run — the warm standby must
+    keep the resident device cache (no recompile/re-upload)."""
     if name == "smoke":
         return SimConfig(seed=seed)
     if name == "fault":
@@ -113,7 +123,49 @@ def preset(name: str, seed: int = 0) -> SimConfig:
             *watch_flap_script(9.0),
         )
         return cfg
-    raise KeyError(f"unknown preset {name!r} (smoke | fault | churn)")
+    if name == "brownout":
+        # apiserver brownout mid-workload: every egress call fails for a
+        # window — the breaker must open, the degraded cycle must park
+        # decisions and KEEP TICKING, and the workload must still drain
+        # after the window (recovery through the resync backoff queue)
+        cfg = SimConfig(seed=seed, cycles=90, n_jobs=12, arrival_rate=1.5)
+        cfg.faults = tuple(brownout_script(6.0, duration=8.0))
+        return cfg
+    if name == "bind-storm":
+        # hundreds of gang pods arrive in a tight burst while the binder
+        # flaps (injected failures + a short brownout): the recovery
+        # invariants are zero lost/duplicate binds and a bounded
+        # pod-arrival→bind p99 despite the flapping
+        # Job-controller semantics (evict_recreates): under storm pressure
+        # preempt legitimately evicts singletons to start starving gangs —
+        # with bare-pod semantics those victims would be DELETED and the
+        # drain invariant (every submitted gang completes) could not hold
+        arrivals = workload.poisson_arrivals(
+            seed=seed, n_jobs=120, rate=30.0, queues=["q0"],
+            gang_sizes=(1, 2, 4), duration_range=(2.0, 6.0),
+            start_latency=0.25,
+        )
+        cfg = SimConfig(
+            seed=seed, n_nodes=10, cycles=140, n_jobs=0, arrivals=arrivals,
+            queues=(("q0", 1),), evict_recreates=True,
+            faults=(
+                *bind_fail_script(2.0, count=3),
+                *brownout_script(4.0, duration=3.0),
+                *bind_fail_script(12.0, count=2),
+            ),
+        )
+        return cfg
+    if name == "leader-failover":
+        # leadership loss mid-run: the warm standby takes over through
+        # cache.failover_recover — pod-store rebuild + resident-cache
+        # revalidation — and must keep the device-resident buffers (no
+        # full recompile/re-upload) while the workload drains normally
+        cfg = SimConfig(seed=seed, cycles=70, n_jobs=14, arrival_rate=1.2)
+        cfg.faults = tuple(leader_failover_script(9.0))
+        return cfg
+    raise KeyError(
+        f"unknown preset {name!r} (smoke | fault | churn | brownout | "
+        "bind-storm | leader-failover)")
 
 
 class SimRunner:
@@ -124,7 +176,16 @@ class SimRunner:
         self.trace = TraceRecorder()
         self.metrics = LongitudinalMetrics()
         self.kubelet = kl.SimKubelet()
-        self.cache = SchedulerCache(binder=self.kubelet, evictor=self.kubelet)
+        # the kubelet rides the REAL transport circuit breaker (paced by the
+        # virtual clock): a brownout opens it exactly like a production
+        # apiserver outage would, and the cache's degraded path parks
+        # decisions instead of hammering the failing egress
+        self.breaker = CircuitBreaker(
+            threshold=3, cooldown=2.5, clock=self.clock.monotonic,
+            name="sim-apiserver",
+        )
+        guard = GuardedBackend(self.kubelet, self.breaker)
+        self.cache = SchedulerCache(binder=guard, evictor=guard)
         if cfg.conf_text:
             conf = parse_scheduler_conf(cfg.conf_text)
         else:
@@ -145,11 +206,32 @@ class SimRunner:
         self.job_succeeded: Dict[str, set] = {}  # job uid → succeeded keys
         self._creation = itertools.count(1)
         self._reincarnation: Dict[str, int] = {}
+        # bind-integrity bookkeeping: when each incarnation went Pending
+        # (pod-arrival→bind latency) and which (key, uid) incarnations have
+        # already been ack'd (a second ack = a duplicate bind — always a bug)
+        self.pending_since: Dict[str, float] = {}
+        self.bound_uids: set = set()
+        self.duplicate_binds = 0
+        self.failover_events: List[Dict] = []
 
     # ---- shared lookups --------------------------------------------------
     def job_of_pod(self, key: str) -> Optional[str]:
         info = self.pod_info.get(key)
         return info["job"] if info else None
+
+    # ---- leader failover (warm standby) ----------------------------------
+    def failover(self) -> Dict:
+        """The LEADER_FAILOVER fault's body: the warm standby takes over via
+        the real recovery path. Resident counters are snapshotted before,
+        so the report can prove the no-recompile/no-re-upload invariant
+        (full_uploads flat on the warm path)."""
+        before = {p: dict(c)
+                  for p, c in self.cache.columns.resident_counters().items()}
+        report = self.cache.failover_recover()
+        report["t"] = self.clock.now()
+        report["resident_before"] = before
+        self.failover_events.append(report)
+        return report
 
     # ---- setup -----------------------------------------------------------
     def _setup(self) -> None:
@@ -221,6 +303,7 @@ class SimRunner:
                 "duration": t["duration"],
                 "start_latency": t["start_latency"],
             }
+            self.pending_since[key] = event.time
             self.cache.add_pod(pod)
         self.job_tasks[job_uid] = keys
         self.job_succeeded[job_uid] = set()
@@ -283,6 +366,7 @@ class SimRunner:
             job = self.job_of_pod(key)
             if job is not None:
                 self.job_succeeded.get(job, set()).discard(key)
+            self.pending_since[key] = t  # fresh incarnation awaits its bind
             self.trace.record(SimEvent(t, kind, data))
 
     def _on_pod_failed(self, event: SimEvent) -> None:
@@ -322,7 +406,16 @@ class SimRunner:
             if info is None:
                 continue
             self.metrics.note_bind(info["job"], now)
+            since = self.pending_since.pop(key, None)
+            if since is not None:
+                self.metrics.note_pod_bind_latency(now - since)
             stored = self.cache.pods.get(key)
+            if stored is not None:
+                tag = (key, stored.uid)
+                if tag in self.bound_uids:
+                    self.duplicate_binds += 1
+                else:
+                    self.bound_uids.add(tag)
             if stored is not None:
                 # uid pins the follow-up to THIS incarnation (see _stale)
                 self.heap.push(SimEvent(
@@ -453,6 +546,18 @@ class SimRunner:
             "seed": cfg.seed,
             "cycles_run": cycles_run,
             "resident_scatter": scatter,
+            # fault-hardening evidence: bind integrity (no lost/duplicate
+            # binds), the egress breaker's life, the repair queue's story
+            "bind_integrity": {
+                "acked_binds": self.kubelet.binds_total,
+                "unique_pods_bound": len(self.bound_uids),
+                "duplicate_binds": self.duplicate_binds,
+            },
+            "transport": {
+                "breaker_state": self.breaker.state,
+                "breaker_transitions": dict(self.breaker.transitions),
+            },
+            "resync": self.cache.resync.stats(),
             "config": {
                 "n_nodes": cfg.n_nodes,
                 "queues": list(map(list, cfg.queues)),
@@ -469,7 +574,42 @@ class SimRunner:
         recovery = self._fault_recovery()
         if recovery is not None:
             report["fault_recovery"] = recovery
+        failover = self._failover_report(scatter)
+        if failover is not None:
+            report["failover"] = failover
         return report
+
+    def _failover_report(self, scatter_now: Dict) -> Optional[List[Dict]]:
+        """Per-failover recovery evidence: how many cycles until the
+        pending backlog drained again, and whether the resident device
+        cache survived (full_uploads flat ⇒ no re-upload, warm path)."""
+        if not self.failover_events:
+            return None
+        out = []
+        for evr in self.failover_events:
+            recovery_cycles = None
+            n = 0
+            for rec in self.metrics.fairness:
+                if rec["t"] < evr["t"]:
+                    continue
+                n += 1
+                if rec["pending"] == 0:
+                    recovery_cycles = n
+                    break
+            uploads_delta = {
+                path: (scatter_now.get(path, {}).get("full_uploads", 0)
+                       - evr["resident_before"].get(path, {})
+                       .get("full_uploads", 0))
+                for path in scatter_now
+            }
+            out.append({
+                "t": evr["t"],
+                "mode": evr["mode"],
+                "resident_tokens": evr["resident_tokens"],
+                "recovery_cycles": recovery_cycles,
+                "resident_full_uploads_delta": uploads_delta,
+            })
+        return out
 
 
 def run_preset(name: str, seed: int = 0, cycles: Optional[int] = None,

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # kbt-check, both tiers: the static AST/flow rules over the package tree
-# AND the jaxpr-level audit of the registered jitted entry points.
-# Exit 0 = clean, 1 = findings, 2 = usage error (same contract as the CLI).
+# AND the jaxpr-level audit of the registered jitted entry points — then
+# the seeded chaos smoke (bind-storm + leader-failover sim presets), so
+# fault-hardening invariants run on every PR alongside the lint tiers.
+# Exit 0 = clean, 1 = findings / violated chaos invariants, 2 = usage error.
 #
 # CI usage:  scripts/check.sh [--jsonl]
 # The jaxpr tier imports jax; pin it to CPU so the check never touches (or
@@ -15,4 +17,14 @@ cd "$(dirname "$0")/.."
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="--xla_force_host_platform_device_count=4${XLA_FLAGS:+ $XLA_FLAGS}"
 fi
-exec env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr "$@"
+env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr "$@"
+
+# chaos smoke: each preset's CLI exits nonzero on a violated recovery
+# invariant (lost/duplicate binds, accounting drift, failed fault
+# recovery) — deterministic per seed, CPU-only, ~1 min combined
+echo "kbt-check: chaos smoke (bind-storm, leader-failover)"
+env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
+  --preset bind-storm --seed 0 --no-fairness-series >/dev/null
+env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
+  --preset leader-failover --seed 5 --no-fairness-series >/dev/null
+echo "kbt-check: chaos smoke clean"

@@ -974,6 +974,89 @@ class TestSuppressions:
 
 
 # ---------------------------------------------------------------------------
+# KBT011 — raw urllib / ad-hoc sleep retry loop outside the transport
+# ---------------------------------------------------------------------------
+
+
+class TestKBT011:
+    def test_raw_urlopen_in_k8s_triggers(self):
+        src = """
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+        """
+        assert rule_ids(findings_for(src, "k8s/watch.py")) == ["KBT011"]
+
+    def test_from_import_urlopen_is_caught(self):
+        src = """
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+        """
+        assert rule_ids(findings_for(src, "cmd/server.py")) == ["KBT011"]
+
+    def test_sleep_retry_loop_triggers(self):
+        src = """
+        import time
+
+        def renew(call):
+            for attempt in range(5):
+                try:
+                    return call()
+                except OSError:
+                    time.sleep(2 ** attempt)
+        """
+        assert rule_ids(findings_for(src, "k8s/bind.py")) == ["KBT011"]
+
+    def test_transport_module_is_the_sanctioned_home(self):
+        src = """
+        import time
+        import urllib.request
+
+        def call(url, delays):
+            for d in delays:
+                try:
+                    return urllib.request.urlopen(url)
+                except OSError:
+                    time.sleep(d)
+        """
+        assert findings_for(src, "k8s/transport.py") == []
+
+    def test_sleep_outside_a_loop_is_not_a_retry(self):
+        src = """
+        import time
+
+        def settle():
+            time.sleep(0.1)
+        """
+        assert findings_for(src, "k8s/bind.py") == []
+
+    def test_annotation_suppresses(self):
+        src = """
+        import time
+
+        def sample(frames):
+            while frames:
+                frames.pop()
+                # kbt: allow[KBT011] sampling cadence, not a retry loop
+                time.sleep(0.01)
+        """
+        assert findings_for(src, "cmd/server.py") == []
+
+    def test_out_of_scope_urlopen_unflagged(self):
+        src = """
+        import urllib.request
+
+        def fetch(url):
+            return urllib.request.urlopen(url).read()
+        """
+        assert findings_for(src, "testing/e2e.py") == []
+
+
+# ---------------------------------------------------------------------------
 # self-enforcement: the package must be clean (tier-1)
 # ---------------------------------------------------------------------------
 
@@ -989,8 +1072,8 @@ class TestSelfEnforcement:
             # each rule documents the incident that motivated it
             assert rule.__doc__ and len(rule.__doc__.strip()) > 40
 
-    def test_all_ten_rules_are_registered(self):
-        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 11)]
+    def test_all_eleven_rules_are_registered(self):
+        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 12)]
 
     def test_jaxpr_registry_has_zero_unsuppressed_findings(self):
         # tier B self-enforcement: every registered jitted entry point

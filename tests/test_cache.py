@@ -155,6 +155,159 @@ class TestResyncRepair:
         assert task.node_name is None
 
 
+class TestResyncBackoffAndQuarantine:
+    """The bounded repair queue (cache/resync.py): per-task exponential
+    backoff in repair ticks, poison quarantine with a condition, breaker
+    parks exempt from the poison budget, release on external change."""
+
+    def _failing_cache(self):
+        class ExplodingBinder:
+            def bind(self, pod, hostname):
+                raise RuntimeError("apiserver down")
+
+        cache = build_cache(queues=["default"], nodes=[build_node("n1")])
+        cache.binder = ExplodingBinder()
+        pod = build_pod("ns", "p1", None, PodPhase.PENDING,
+                        {"cpu": 1000, "memory": GiB})
+        cache.add_pod(pod)
+        return cache
+
+    def _task(self, cache):
+        return next(iter(cache.jobs["ns/p1"].tasks.values()))
+
+    def test_repeat_failures_escalate_backoff(self):
+        cache = self._failing_cache()
+        cache.bind(self._task(cache), "n1")      # attempt 1 parks
+        cache.process_resync_tasks()             # tick 1: due (delay 1)
+        assert cache.err_tasks == []
+        cache.bind(self._task(cache), "n1")      # attempt 2 parks: delay 2
+        cache.process_resync_tasks()             # tick 2: NOT yet due
+        assert len(cache.err_tasks) == 1
+        cache.process_resync_tasks()             # tick 3: due now
+        assert cache.err_tasks == []
+
+    def test_poison_task_quarantined_with_condition(self):
+        cache = self._failing_cache()
+        cache.resync.poison_after = 3
+        cache.resync.backoff_cap = 1             # keep the test short
+        for _ in range(3):
+            cache.bind(self._task(cache), "n1")
+            cache.process_resync_tasks()
+        # the 3rd real failure exhausted the budget: one more pass shelves
+        cache.process_resync_tasks()
+        assert "ns/p1" in cache.resync.quarantined
+        assert cache.err_tasks == []             # out of the retry flow
+        cond = cache.pod_conditions["ns/p1"]
+        assert cond["status"] == "False" and "quarantined" in cond["message"]
+        # parked again (a stray late failure) → still shelved, not retried
+        cache.resync_task(self._task(cache))
+        cache.process_resync_tasks()
+        assert "ns/p1" in cache.resync.quarantined
+
+    def test_external_pod_update_releases_quarantine(self):
+        import dataclasses
+
+        cache = self._failing_cache()
+        cache.resync.poison_after = 1
+        cache.bind(self._task(cache), "n1")
+        cache.process_resync_tasks()
+        assert "ns/p1" in cache.resync.quarantined
+        # the user edits the pod: quarantine releases, history resets
+        cache.update_pod(dataclasses.replace(cache.pods["ns/p1"]))
+        assert "ns/p1" not in cache.resync.quarantined
+        assert cache.resync.released_total == 1
+
+    def test_pod_deletion_forgets_all_bookkeeping(self):
+        cache = self._failing_cache()
+        cache.bind(self._task(cache), "n1")
+        assert len(cache.err_tasks) == 1
+        cache.delete_pod(cache.pods["ns/p1"])
+        assert cache.err_tasks == []
+        cache.process_resync_tasks()             # nothing resurrects
+
+    def test_breaker_parks_never_poison(self):
+        cache = self._failing_cache()
+        cache.resync.poison_after = 2
+        task = self._task(cache)
+        for _ in range(10):
+            cache.resync_task(task, reason="breaker-open")
+            cache.process_resync_tasks()
+        for _ in range(cache.resync.backoff_cap + 1):
+            cache.process_resync_tasks()         # drain the parked entry
+        assert cache.resync.quarantined == {}
+        assert cache.resync.parked_by_reason["breaker-open"] == 10
+
+    def test_overflow_forces_oldest_due_instead_of_dropping(self):
+        from kube_batch_tpu.cache.resync import ResyncQueue
+
+        class T:
+            def __init__(self, k):
+                self._k = k
+
+            def key(self):
+                return self._k
+
+        q = ResyncQueue(backoff_cap=64, poison_after=99, max_entries=4)
+        for i in range(8):
+            t = T(f"t{i}")
+            q.park(t)
+            q.park(t)  # second park → due far in the future
+        assert len(q) == 8
+        due, poisoned = q.tick()
+        assert poisoned == []
+        assert len(due) == 4  # the bound forced the oldest backlog due
+        assert len(q) == 4
+
+
+class TestDegradedStatusShedding:
+    def test_shed_flag_skips_serial_status_writes(self):
+        writes = []
+
+        class Updater:
+            def update_pod_group(self, pg):
+                writes.append(pg)
+
+        cache = build_cache(queues=["default"])
+        cache.status_updater = Updater()
+        cache.add_pod_group(PodGroup(name="pg", namespace="ns",
+                                     queue="default"))
+        job = cache.jobs["ns/pg"]
+        cache.shed_status_writes = True
+        cache.update_job_statuses_bulk([(job, True, False)])
+        assert writes == []              # shed (non-parallel-safe → skip)
+        cache.shed_status_writes = False
+        cache._status_next_write.clear()
+        cache.update_job_statuses_bulk([(job, True, False)])
+        assert len(writes) == 1          # healthy cycle writes again
+
+    def test_updater_degraded_probe_sheds_queue_status(self):
+        wrote = []
+
+        class Updater:
+            degraded_now = True
+
+            def update_pod_group(self, pg):
+                pass
+
+            def update_queue_status(self, name, counts):
+                wrote.append(name)
+
+            def degraded(self):
+                return self.degraded_now
+
+        cache = build_cache(queues=["default"])
+        cache.status_updater = Updater()
+        from kube_batch_tpu.api.types import queue_phase_counts
+
+        counts = {"default": queue_phase_counts()}
+        counts["default"]["pending"] = 1
+        cache.update_queue_statuses(counts)
+        assert wrote == []               # breaker open → shed
+        Updater.degraded_now = False
+        cache.update_queue_statuses(counts)
+        assert wrote == ["default"]      # healthy close converges
+
+
 class TestStatusRateLimit:
     def test_condition_only_updates_rate_limited(self):
         """job_updater.go:20-31: condition-only PodGroup writes throttle to

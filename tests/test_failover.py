@@ -1,0 +1,278 @@
+"""Warm-standby leader failover: the surviving per-cycle device-resident
+cache is revalidated (version token + check_consistency) against the
+pod-store rebuild and KEPT — post-failover cycles are bit-exact with the
+host columns and pay no cold re-upload; only a failed revalidation
+cold-starts. Plus the cmd/server warm-standby re-contend loop."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    Queue,
+)
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.framework.interface import get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim import kubelet as kl
+from kube_batch_tpu.testing.synthetic import GiB
+
+
+def _mk_cache(n_nodes=6):
+    cache = SchedulerCache()
+    # realistic axis capacities so the scatter-delta path engages (micro
+    # columns rightly prefer whole-column uploads) — same sizing rationale
+    # as test_snapshot_delta's round-trip test
+    cache.columns.reserve(n_tasks=2048, n_nodes=128, n_jobs=512)
+    for q in range(2):
+        cache.add_queue(Queue(name=f"q{q}", uid=f"uq{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cache.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 16000.0, "memory": 64 * GiB, "pods": 110.0},
+        ))
+    return cache
+
+
+def _add_gang(cache, serial, size=2):
+    g = f"g{serial}"
+    cache.add_pod_group(PodGroup(
+        name=g, namespace="fo", uid=f"pg-{g}", min_member=size,
+        queue=f"q{serial % 2}", creation_index=serial,
+    ))
+    for k in range(size):
+        cache.add_pod(Pod(
+            name=f"{g}-{k}", namespace="fo", uid=f"pod-{g}-{k}",
+            requests={"cpu": 500.0, "memory": 1 * GiB},
+            annotations={GROUP_NAME_ANNOTATION: g},
+            phase=PodPhase.PENDING,
+            creation_index=serial * 100 + k,
+        ))
+
+
+def _cycle(cache, conf, check_resident=False):
+    """One real scheduling cycle; optionally assert the device-resident
+    per-cycle columns are bit-exact with the freshly built host columns."""
+    from kube_batch_tpu.api.resident import PER_CYCLE_FIELDS
+
+    ssn = open_session(cache, conf.tiers)
+    try:
+        if check_resident:
+            cols = cache.columns
+            snap, _meta = cols.device_snapshot(ssn)
+            swapped = cols.per_cycle_resident(snap)
+            for field in PER_CYCLE_FIELDS:
+                host = np.asarray(getattr(snap, field))
+                dev = np.asarray(getattr(swapped, field))
+                assert np.array_equal(host, dev), (
+                    f"device-resident {field} diverged post-failover"
+                )
+        for name in conf.actions:
+            get_action(name).execute(ssn)
+    finally:
+        close_session(ssn)
+    cache.flush_binds()
+
+
+def _warm_resident(cache, conf, cycles=6):
+    """Run enough churny cycles that the per-cycle device cache exists and
+    the scatter path has engaged."""
+    for i in range(cycles):
+        _add_gang(cache, serial=i + 1)
+        _cycle(cache, conf)
+        # progress some pods so statuses churn
+        for key in sorted(cache.pods)[: 2]:
+            pod = cache.pods[key]
+            if pod.node_name and pod.phase == PodPhase.PENDING:
+                kl.set_running(cache, key, pod.node_name)
+    rc = cache.columns._per_cycle_dev.get(None)
+    assert rc is not None and rc.version > 0
+    return rc
+
+
+class TestWarmStandbyRevalidation:
+    def test_warm_failover_keeps_resident_cache_bit_exact(self):
+        """The acceptance path: after failover_recover the SAME resident
+        cache object serves (compiled executables + buffers kept), the next
+        cycle is bit-exact vs the host columns, and its upload counters
+        move like any steady-state cycle — NOT like a cold start."""
+        conf = load_scheduler_conf(None)
+        cache = _mk_cache()
+        rc = _warm_resident(cache, conf)
+
+        # baseline: what a normal steady-state cycle adds in full uploads
+        # (tiny columns legitimately prefer whole-column re-uploads)
+        pre = rc.counters()
+        _add_gang(cache, serial=100)
+        _cycle(cache, conf)
+        steady_delta = rc.counters()["full_uploads"] - pre["full_uploads"]
+
+        report = cache.failover_recover()
+        assert report["mode"] == "warm", report
+        assert report["resident_tokens"]["single"] > 0
+        # identity: the cache OBJECT survived — nothing was recompiled
+        assert cache.columns._per_cycle_dev.get(None) is rc
+
+        before = rc.counters()
+        _cycle(cache, conf, check_resident=True)
+        after = rc.counters()
+        post_failover_delta = after["full_uploads"] - before["full_uploads"]
+        # the first post-failover cycle costs no more than an ordinary
+        # steady-state cycle — and far less than a cold start (which pays
+        # one full upload per per-cycle field)
+        from kube_batch_tpu.api.resident import PER_CYCLE_FIELDS
+
+        assert post_failover_delta <= steady_delta, (
+            f"warm failover re-uploaded: {post_failover_delta} vs "
+            f"steady {steady_delta}"
+        )
+        assert post_failover_delta < len(PER_CYCLE_FIELDS)
+        assert cache.columns.check_consistency(cache) == []
+
+    def test_cold_start_for_comparison_re_uploads_everything(self):
+        """The cold path the warm standby avoids: dropping residency makes
+        the next cycle full-upload every per-cycle field."""
+        from kube_batch_tpu.api.resident import PER_CYCLE_FIELDS
+
+        conf = load_scheduler_conf(None)
+        cache = _mk_cache()
+        _warm_resident(cache, conf)
+        cache.columns.drop_resident()
+        assert cache.columns._per_cycle_dev == {}
+        _cycle(cache, conf, check_resident=True)
+        rc = cache.columns._per_cycle_dev.get(None)
+        assert rc is not None
+        assert rc.counters()["full_uploads"] >= len(PER_CYCLE_FIELDS)
+
+    def test_failed_revalidation_cold_starts(self, monkeypatch):
+        conf = load_scheduler_conf(None)
+        cache = _mk_cache()
+        _warm_resident(cache, conf)
+        monkeypatch.setattr(
+            cache.columns.__class__, "check_consistency",
+            lambda self, c: ["planted inconsistency"],
+        )
+        report = cache.failover_recover()
+        assert report["mode"] == "cold"
+        assert report["errors"] == ["planted inconsistency"]
+        assert cache.columns._per_cycle_dev == {}
+
+    def test_unsynced_resident_cache_never_survives(self):
+        """A resident cache that never synced a snapshot (version token 0)
+        has mirrors of unknown provenance — revalidation must drop it."""
+        from kube_batch_tpu.api.resident import PerCycleDeviceCache
+
+        cache = _mk_cache()
+        cache.columns._per_cycle_dev[None] = PerCycleDeviceCache()
+        report = cache.columns.revalidate_resident(cache)
+        assert report["mode"] == "cold"
+        assert cache.columns._per_cycle_dev == {}
+
+    def test_failover_flushes_quarantine(self):
+        """The new leader's rebuilt state supersedes the old reign's
+        failure history — shelved tasks get a fresh start."""
+        conf = load_scheduler_conf(None)
+        cache = _mk_cache()
+        _warm_resident(cache, conf)
+        cache.resync.poison_after = 1
+
+        class Exploding:
+            def bind(self, pod, hostname):
+                raise RuntimeError("down")
+
+        cache.binder = Exploding()
+        _add_gang(cache, serial=50, size=1)
+        _cycle(cache, conf)
+        cache.process_resync_tasks()
+        cache.process_resync_tasks()
+        assert cache.resync.quarantined
+        cache.failover_recover()
+        assert cache.resync.quarantined == {}
+
+
+class TestWarmStandbyLoop:
+    def test_lost_lease_recovers_and_recontends(self, monkeypatch):
+        """run_warm_standby: reign 1 loses the lease (LostLeadership), the
+        loop resets the elector, reign 2 recovers through failover_recover
+        and schedules again — same process, no crash."""
+        from kube_batch_tpu.cmd.leader_election import LostLeadership
+        from kube_batch_tpu.cmd.server import run_warm_standby
+
+        cache = _mk_cache()
+        recoveries = []
+        monkeypatch.setattr(
+            cache, "failover_recover",
+            lambda: recoveries.append(1) or {"mode": "warm",
+                                             "resident_tokens": {},
+                                             "errors": []},
+        )
+        sched = Scheduler(cache, conf=load_scheduler_conf(None),
+                          schedule_period=0.0)
+        sched.on_cycle_end = sched.stop  # each reign runs exactly one cycle
+
+        class StubElector:
+            def __init__(self):
+                self.runs = 0
+                self.resets = 0
+
+            def run(self, lead, on_stopped_leading=None):
+                self.runs += 1
+                if self.runs == 1:
+                    raise LostLeadership("reign 1 lost the lease")
+                lead()
+
+            def reset(self):
+                self.resets += 1
+
+        elector = StubElector()
+        run_warm_standby(elector, sched, cache, max_takeovers=3)
+        assert elector.runs == 2 and elector.resets == 1
+        assert recoveries == [1]  # reign 2 recovered before its first cycle
+
+    def test_elector_reset_rearms_for_the_same_process(self, tmp_path):
+        from kube_batch_tpu.cmd.leader_election import LeaderElector
+
+        e = LeaderElector(str(tmp_path), identity="a")
+        e.release()
+        assert e._stop.is_set()
+        e.reset()
+        assert not e._stop.is_set() and e._renew_thread is None
+
+    def test_scheduler_rearms_after_stop(self):
+        """run_forever must be re-enterable after stop() — the standby's
+        second reign reuses the same Scheduler object."""
+        cache = _mk_cache()
+        sched = Scheduler(cache, conf=load_scheduler_conf(None),
+                          schedule_period=0.0)
+        sched.on_cycle_end = sched.stop
+        sched.run_forever()   # reign 1: one cycle then stop
+        sched.run_forever()   # reign 2 must actually run, not exit at once
+        assert sched._stop    # stopped again via on_cycle_end
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_failover_mid_churn_open_state_matches_full_view(seed):
+    """After a failover rebuild, the next session open hands out exactly
+    what a from-scratch session_view derives (the delta machinery was
+    invalidated by the rebuild, not corrupted by it)."""
+    conf = load_scheduler_conf(None)
+    cache = _mk_cache()
+    _warm_resident(cache, conf)
+    cache.failover_recover()
+    ssn = open_session(cache, conf.tiers)
+    try:
+        expected = cache.session_view()
+        assert set(ssn.jobs) | {j.uid for j in ssn.gate_dropped_jobs} \
+            == set(expected.jobs)
+    finally:
+        close_session(ssn)

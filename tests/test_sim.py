@@ -139,6 +139,68 @@ class TestSimFaults:
         # the high-priority job ran to completion on the freed capacity
         assert r["jobs"]["completed"] >= 1
 
+    def test_apiserver_brownout_degrades_without_stalling(self):
+        """The brownout preset: every egress call fails for a virtual-time
+        window. The breaker must open (fail-fast), the degraded cycle must
+        park decisions in the resync queue and KEEP TICKING through the
+        window, and the workload must fully drain after it — with zero
+        duplicate binds."""
+        r = run_preset("brownout", seed=2)
+        # the loop ticked through and past the brownout window (t=6..14)
+        assert r["cycles_run"] >= 16, r["cycles_run"]
+        assert r["jobs"]["completed"] == r["jobs"]["submitted"]
+        # breaker story: opened during the window, closed after it
+        trans = r["transport"]["breaker_transitions"]
+        assert trans.get("open", 0) >= 1 and trans.get("half-open", 0) >= 1
+        assert r["transport"]["breaker_state"] == "closed"
+        # decisions were parked (breaker fail-fast), not hammered
+        assert r["resync"]["parked_by_reason"].get("breaker-open", 0) > 0
+        assert r["resync"]["depth"] == 0          # all repaired by the end
+        assert r["resync"]["quarantined"] == 0    # nothing poisoned
+        assert r["bind_integrity"]["duplicate_binds"] == 0
+        assert r["invariants"]["errors"] == []
+
+    def test_bind_storm_no_lost_or_duplicate_binds(self):
+        """The bind-storm preset: 120 gangs (~280 pods) land in a burst
+        while the binder flaps (injected failures + a short brownout).
+        Recovery invariants: every gang completes (no lost binds), no pod
+        is bound twice (no duplicate binds), and pod-arrival→bind p99 stays
+        bounded despite the flapping."""
+        r = run_preset("bind-storm", seed=0)
+        assert r["jobs"]["submitted"] == 120
+        assert r["jobs"]["completed"] == r["jobs"]["submitted"]
+        bi = r["bind_integrity"]
+        assert bi["duplicate_binds"] == 0
+        assert bi["acked_binds"] == bi["unique_pods_bound"]
+        lat = r["pod_bind_latency_vt"]
+        assert lat["n"] >= 280 and lat["p99"] < 20.0, lat
+        assert r["transport"]["breaker_transitions"].get("open", 0) >= 1
+        assert r["invariants"]["errors"] == []
+
+    def test_leader_failover_warm_standby_keeps_resident_cache(self):
+        """The leader-failover preset: leadership is lost mid-run; the warm
+        standby takes over through cache.failover_recover. Revalidation
+        must KEEP the resident device cache (mode=warm, version token
+        intact), the cluster must recover within bounded cycles, and the
+        workload must drain with clean invariants."""
+        r = run_preset("leader-failover", seed=5)
+        assert r["jobs"]["completed"] == r["jobs"]["submitted"]
+        fo = r["failover"]
+        assert len(fo) == 1
+        assert fo[0]["mode"] == "warm", fo
+        assert fo[0]["resident_tokens"].get("single", 0) > 0
+        assert fo[0]["recovery_cycles"] is not None
+        assert fo[0]["recovery_cycles"] <= 20
+        assert r["bind_integrity"]["duplicate_binds"] == 0
+        assert r["invariants"]["errors"] == []
+
+    def test_chaos_presets_are_seed_deterministic(self):
+        """Same seed ⇒ byte-identical trace holds for the chaos machinery
+        too (breaker paced by the virtual clock, tick-based resync)."""
+        a = run_preset("brownout", seed=11)
+        b = run_preset("brownout", seed=11)
+        assert a["trace_sha256"] == b["trace_sha256"]
+
     def test_evict_recreates_controller_restores_pending_replica(self):
         """evict_recreates=True models a Job/ReplicaSet owner: the evicted
         replica reincarnates Pending (fresh uid) instead of vanishing, and

@@ -1,0 +1,435 @@
+"""Classified retry/backoff transport + circuit breaker (k8s/transport.py):
+the error-classification table, Retry-After honoring, decorrelated-jitter
+bounds, per-endpoint-class budgets, breaker state transitions, and the
+K8sBackend idempotency satellites (bind 409, evict 404) — all against
+stubbed openers/clocks, no network."""
+
+import io
+import random
+import socket
+import ssl
+import urllib.error
+
+import pytest
+
+from kube_batch_tpu.k8s.transport import (
+    FATAL,
+    THROTTLE,
+    TRANSIENT,
+    ApiTransport,
+    Backoff,
+    CircuitBreaker,
+    CircuitOpenError,
+    GuardedBackend,
+    RetryPolicy,
+    classify_error,
+)
+
+
+def http_error(code: int, headers=None) -> urllib.error.HTTPError:
+    return urllib.error.HTTPError("http://api", code, "x", headers or {},
+                                  io.BytesIO())
+
+
+class TestClassification:
+    @pytest.mark.parametrize("exc,kind", [
+        (http_error(429), THROTTLE),
+        (http_error(503), THROTTLE),
+        (http_error(408), TRANSIENT),
+        (http_error(500), TRANSIENT),
+        (http_error(502), TRANSIENT),
+        (http_error(504), TRANSIENT),
+        (http_error(501), FATAL),      # Not Implemented: retrying is noise
+        (http_error(400), FATAL),
+        (http_error(404), FATAL),
+        (http_error(409), FATAL),
+        (http_error(403), FATAL),
+        (ConnectionRefusedError(), TRANSIENT),
+        (ConnectionResetError(), TRANSIENT),
+        (socket.timeout(), TRANSIENT),
+        (TimeoutError(), TRANSIENT),
+        (OSError("unreachable"), TRANSIENT),
+        (urllib.error.URLError(ConnectionRefusedError()), TRANSIENT),
+        (urllib.error.URLError("bad"), TRANSIENT),
+        (ssl.SSLError(), TRANSIENT),
+        (ValueError("bug"), FATAL),    # unknown program errors don't retry
+    ])
+    def test_table(self, exc, kind):
+        assert classify_error(exc)[0] == kind
+
+    def test_mid_response_drops_are_transient(self):
+        """A connection cut mid-body surfaces as http.client exceptions or
+        a truncated-JSON decode error, none of which are OSErrors — they
+        must retry (and count as breaker failures), not classify fatal."""
+        import http.client
+        import json as _json
+
+        assert classify_error(http.client.IncompleteRead(b"x"))[0] == TRANSIENT
+        assert classify_error(http.client.BadStatusLine(""))[0] == TRANSIENT
+        try:
+            _json.loads("{trunc")
+        except _json.JSONDecodeError as e:
+            assert classify_error(e)[0] == TRANSIENT
+
+    def test_cert_verification_failure_is_fatal(self):
+        try:
+            err = ssl.SSLCertVerificationError("bad cert")
+        except AttributeError:  # pragma: no cover — very old ssl
+            pytest.skip("no SSLCertVerificationError")
+        assert classify_error(err)[0] == FATAL
+        # also when wrapped in a URLError, as urlopen delivers it
+        assert classify_error(urllib.error.URLError(err))[0] == FATAL
+
+    def test_retry_after_seconds_parsed(self):
+        kind, after = classify_error(http_error(429, {"Retry-After": "7"}))
+        assert (kind, after) == (THROTTLE, 7.0)
+
+    def test_retry_after_http_date_falls_back_to_backoff(self):
+        kind, after = classify_error(
+            http_error(503, {"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"}))
+        assert kind == THROTTLE and after is None
+
+
+class TestBackoffAndPolicy:
+    def test_decorrelated_jitter_bounds(self):
+        bo = Backoff(base=0.5, cap=30.0, rng=random.Random(7))
+        prev = 0.5
+        for _ in range(200):
+            d = bo.next()
+            assert 0.5 <= d <= min(30.0, prev * 3.0) + 1e-9
+            prev = max(0.5, d)
+
+    def test_backoff_caps(self):
+        bo = Backoff(base=1.0, cap=4.0, rng=random.Random(1))
+        for _ in range(50):
+            assert bo.next() <= 4.0
+
+    def test_reset_restarts_the_ramp(self):
+        bo = Backoff(base=1.0, cap=100.0, rng=random.Random(3))
+        for _ in range(10):
+            bo.next()
+        bo.reset()
+        assert bo.next() <= 3.0  # first post-reset draw ≤ base*3
+
+    def test_budgets_per_endpoint_class(self):
+        p = RetryPolicy(budgets={"write": 2})
+        assert p.budget("write") == 2
+        assert p.budget("read") == 5       # default
+        assert p.budget("watch") == 1      # the watch loop is the retry
+        assert p.budget("unknown") == p.budget("read")
+
+    def test_throttle_delay_honors_retry_after_capped(self):
+        p = RetryPolicy(base=0.1, cap=5.0, rng=random.Random(0))
+        bo = p.backoff_state()
+        assert p.delay(THROTTLE, 3.0, bo) == 3.0
+        assert p.delay(THROTTLE, 500.0, bo) == 5.0  # hostile header capped
+        # no header → ordinary jittered backoff
+        assert 0.1 <= p.delay(THROTTLE, None, bo) <= 5.0
+
+
+def make_transport(**kw) -> ApiTransport:
+    t = ApiTransport(
+        "http://api", retry_policy=kw.pop("retry_policy", None)
+        or RetryPolicy(base=0.01, cap=0.05, rng=random.Random(0)),
+        breaker=kw.pop("breaker", None)
+        or CircuitBreaker(threshold=3, cooldown=10.0, clock=lambda: 0.0),
+    )
+    t.slept = []
+    t._sleep = t.slept.append
+    return t
+
+
+class TestCallRetryLoop:
+    def test_transient_retries_then_succeeds(self):
+        t = make_transport()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionRefusedError()
+            return "ok"
+
+        assert t._call("read", fn) == "ok"
+        assert len(calls) == 3 and len(t.slept) == 2
+        assert t.breaker.state == "closed"
+
+    def test_budget_exhaustion_raises_the_last_error(self):
+        t = make_transport(breaker=CircuitBreaker(
+            threshold=10, cooldown=10.0, clock=lambda: 0.0))
+
+        def fn():
+            raise ConnectionResetError("still down")
+
+        with pytest.raises(ConnectionResetError):
+            t._call("write", fn)
+        # write budget = 4 attempts → 3 sleeps
+        assert len(t.slept) == 3
+
+    def test_fatal_is_raised_immediately_and_spares_the_breaker(self):
+        t = make_transport()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise http_error(404)
+
+        with pytest.raises(urllib.error.HTTPError):
+            t._call("read", fn)
+        assert len(calls) == 1 and t.slept == []
+        # a 4xx means the server is healthy: consecutive-failure count reset
+        assert t.breaker.state == "closed"
+
+    def test_retry_after_shapes_the_sleep(self):
+        t = make_transport(retry_policy=RetryPolicy(
+            base=0.01, cap=30.0, rng=random.Random(0)))
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise http_error(429, {"Retry-After": "2"})
+            return "ok"
+
+        assert t._call("read", fn) == "ok"
+        assert t.slept == [2.0]
+
+    def test_retry_false_makes_one_attempt(self):
+        t = make_transport()
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ConnectionRefusedError()
+
+        with pytest.raises(ConnectionRefusedError):
+            t._call("read", fn, retry=False)
+        assert len(calls) == 1 and t.slept == []
+
+    def test_open_breaker_fails_fast(self):
+        clock = [0.0]
+        t = make_transport(breaker=CircuitBreaker(
+            threshold=1, cooldown=10.0, clock=lambda: clock[0]))
+
+        def fn():
+            raise ConnectionRefusedError()
+
+        with pytest.raises(ConnectionRefusedError):
+            t._call("read", fn, retry=False)
+        assert t.breaker.state == "open"
+        with pytest.raises(CircuitOpenError):
+            t._call("read", lambda: "never runs")
+
+
+class TestCircuitBreaker:
+    def test_closed_to_open_after_threshold(self):
+        b = CircuitBreaker(threshold=3, cooldown=5.0, clock=lambda: 0.0,
+                           name="t")
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open" and b.is_open
+
+    def test_success_resets_the_consecutive_count(self):
+        b = CircuitBreaker(threshold=2, cooldown=5.0, clock=lambda: 0.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_admits_one_probe_then_closes_on_success(self):
+        clock = [0.0]
+        b = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: clock[0],
+                           name="t2")
+        b.record_failure()
+        assert not b.allow()            # open, cooldown running
+        clock[0] = 6.0
+        assert b.allow()                # half-open: the single probe
+        assert not b.allow()            # second caller refused mid-probe
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_failure_reopens_with_fresh_cooldown(self):
+        clock = [0.0]
+        b = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: clock[0])
+        b.record_failure()
+        clock[0] = 6.0
+        assert b.allow()                # probe
+        b.record_failure()
+        assert b.state == "open"
+        clock[0] = 10.0                 # 4s into the NEW cooldown
+        assert not b.allow()
+        clock[0] = 11.5
+        assert b.allow()                # next probe window
+
+    def test_transition_counters(self):
+        clock = [0.0]
+        b = CircuitBreaker(threshold=1, cooldown=1.0, clock=lambda: clock[0])
+        b.record_failure()
+        clock[0] = 2.0
+        b.allow()
+        b.record_success()
+        assert b.transitions["open"] == 1
+        assert b.transitions["half-open"] == 1
+        assert b.transitions["closed"] == 1
+
+
+class _RecordingBackend:
+    def __init__(self, fail=0):
+        self.fail = fail
+        self.binds = []
+        self.evicts = []
+
+    def bind(self, pod, hostname):
+        if self.fail > 0:
+            self.fail -= 1
+            raise RuntimeError("down")
+        self.binds.append((pod, hostname))
+
+    def bind_many(self, pairs):
+        if self.fail > 0:
+            self.fail -= 1
+            raise RuntimeError("down")
+        self.binds.extend(pairs)
+
+    def evict(self, pod):
+        self.evicts.append(pod)
+
+
+class TestGuardedBackend:
+    def test_failures_open_then_calls_fail_fast(self):
+        clock = [0.0]
+        backend = _RecordingBackend(fail=2)
+        g = GuardedBackend(backend, CircuitBreaker(
+            threshold=2, cooldown=5.0, clock=lambda: clock[0], name="g"))
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                g.bind("p", "n")
+        assert g.degraded()
+        with pytest.raises(CircuitOpenError):
+            g.bind("p", "n")
+        assert backend.binds == []
+        clock[0] = 6.0                  # half-open probe goes through
+        g.bind("p", "n")
+        assert backend.binds == [("p", "n")] and not g.degraded()
+
+    def test_bind_many_capability_mirrors_the_backend(self):
+        class NoBatch:
+            def bind(self, pod, hostname):
+                pass
+
+            def evict(self, pod):
+                pass
+
+        g = GuardedBackend(NoBatch(), CircuitBreaker(clock=lambda: 0.0))
+        assert g.bind_many is None      # cache's capability probe sees none
+        g2 = GuardedBackend(_RecordingBackend(),
+                            CircuitBreaker(clock=lambda: 0.0))
+        g2.bind_many([("p", "n")])
+
+
+class _StubTransport:
+    """Raises the queued errors in order, then records the call."""
+
+    def __init__(self, errors=()):
+        self.errors = list(errors)
+        self.calls = []
+
+    def request(self, method, path, body=None, **kw):
+        if self.errors:
+            raise self.errors.pop(0)
+        self.calls.append((method, path))
+
+    def degraded(self):
+        return False
+
+
+class TestK8sBackendIdempotency:
+    def _backend(self, errors=()):
+        from kube_batch_tpu.k8s.bind import K8sBackend
+
+        b = K8sBackend("http://api")
+        b.transport = _StubTransport(errors)
+        return b
+
+    def test_bind_409_is_idempotent_success(self):
+        from kube_batch_tpu.api.pod import Pod
+
+        b = self._backend([http_error(409)])
+        b.bind(Pod(name="p", namespace="ns", uid="u1"), "n0")  # no raise
+
+    def test_bind_other_http_errors_still_raise(self):
+        from kube_batch_tpu.api.pod import Pod
+
+        b = self._backend([http_error(403)])
+        with pytest.raises(urllib.error.HTTPError):
+            b.bind(Pod(name="p", namespace="ns", uid="u1"), "n0")
+
+    def test_evict_404_still_swallowed(self):
+        from kube_batch_tpu.api.pod import Pod
+
+        b = self._backend([http_error(404)])
+        b.evict(Pod(name="p", namespace="ns", uid="u1"))  # no raise
+
+    def test_rate_limited_wrapper_forwards_degraded(self):
+        """The cache's shed probe must see the wrapped backend's breaker
+        through RateLimitedStatusUpdater — the production wiring."""
+        from kube_batch_tpu.cmd.server import (
+            RateLimitedStatusUpdater,
+            TokenBucket,
+        )
+
+        class Backend:
+            degraded_now = False
+
+            def degraded(self):
+                return self.degraded_now
+
+        backend = Backend()
+        wrapped = RateLimitedStatusUpdater(backend, bucket=TokenBucket(50, 100))
+        assert wrapped.degraded() is False
+        backend.degraded_now = True
+        assert wrapped.degraded() is True
+
+    def test_per_role_breaker_names(self):
+        """Several transports against one host get distinct breaker metric
+        labels (writeback vs watch vs lease) — a shared label would be
+        last-writer-wins on the open gauge."""
+        t1 = ApiTransport("http://api", role="writeback")
+        t2 = ApiTransport("http://api", role="watch")
+        assert t1.breaker.name != t2.breaker.name
+        assert t1.breaker.name.endswith("/writeback")
+
+
+class TestWatchBackoffSharing:
+    def test_reconnect_draws_delays_from_the_shared_policy(self):
+        """The per-resource reconnect loop survives seed failures by
+        sleeping policy-provided (tiny, test-tuned) delays and proceeds
+        once the transport recovers — the private 1→30s doubling is gone."""
+        import threading
+
+        from kube_batch_tpu.k8s.watch import WatchAdapter
+
+        w = WatchAdapter.__new__(WatchAdapter)  # transport stubbed below
+        w.transport = make_transport()
+        w._stream_factory = None
+        w._stop = threading.Event()
+        seeds = []
+
+        def seed(kind):
+            seeds.append(1)
+            if len(seeds) < 3:
+                raise OSError("apiserver down")
+            return "5"
+
+        def watch_events(path):
+            w._stop.set()  # one successful watch connect ends the test
+            assert "resourceVersion=5" in path
+            return iter(())
+
+        w._seed = seed
+        w._watch_events = watch_events
+        seeded = []
+        w._run_resource("pods", on_seeded=lambda: seeded.append(1))
+        assert len(seeds) == 3 and seeded == [1]
