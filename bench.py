@@ -310,10 +310,19 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         # per-cycle device-resident cache's delta-vs-full bytes-moved
         # evidence, per path (api/resident.py counters)
         "solve_mode": get_action("allocate").last_solve_mode,
+        "shard_impl": _shard_impl(),
         "resident_scatter": _resident_scatter_summary(
             cache.columns.resident_counters()
         ),
     }
+
+
+def _shard_impl() -> str:
+    from kube_batch_tpu.parallel.mesh import shard_map_enabled, task_shards
+
+    impl = "shard_map" if shard_map_enabled() else "pjit"
+    ts = task_shards()
+    return f"{impl},tasks={ts}" if ts > 1 else impl
 
 
 def run_multicycle_pair(conf, n_tasks, n_nodes, cycles=8):
@@ -329,11 +338,127 @@ def run_multicycle_pair(conf, n_tasks, n_nodes, cycles=8):
     return mc_delta, mc_full, reduction
 
 
+def collective_evidence(n_tasks, n_nodes):
+    """Per-round cross-shard byte accounting of the shard_map allocate
+    solve, TRACED at the bench's real padded shapes (utils/jitstats.
+    collective_inventory over the program XLA compiles — measured from the
+    jaxpr, not asserted).  The scaling proof: re-trace with the node count
+    doubled at fixed tasks (per-round bytes must not move — the round
+    collectives are the O(tasks) winner-vector reductions) and with the
+    task count doubled (bytes must ~double)."""
+    from kube_batch_tpu.analysis.jaxpr_audit import abstract_snapshot
+    from kube_batch_tpu.api.snapshot import bucket
+    from kube_batch_tpu.parallel.mesh import (
+        collective_stats,
+        default_mesh,
+        shard_map_enabled,
+    )
+
+    mesh = default_mesh()
+    if mesh is None:
+        return {"skipped": "single-device backend"}
+    if not shard_map_enabled():
+        return {"skipped": "KB_SHARD_MAP=0 (pjit oracle path)"}
+    J, Q = bucket(max(1, n_tasks // 4)), 8
+
+    def stats(t, n):
+        return collective_stats(
+            mesh, snap=abstract_snapshot(T=bucket(t), N=bucket(n), J=J, Q=Q)
+        )
+
+    base = stats(n_tasks, n_nodes)
+    nodes2 = stats(n_tasks, 2 * n_nodes)
+    tasks2 = stats(2 * n_tasks, n_nodes)
+    rounds = get_action("allocate").last_solve_rounds
+    return {
+        "mesh": base["mesh"],
+        "task_bucket": base["task_bucket"],
+        "node_bucket": base["node_bucket"],
+        "per_round_bytes": base["per_round_bytes"],
+        # the one-time node-ledger all_gather (O(N·R) per SOLVE, not round)
+        "per_solve_bytes": base["per_solve_bytes"],
+        "ops": base["ops"],
+        # measured rounds of the last cycle × traced per-round bytes = the
+        # cycle's cross-shard budget
+        "rounds_last_cycle": rounds,
+        "bytes_last_cycle": (
+            base["per_solve_bytes"]
+            + base["per_round_bytes"] * max(rounds, 1)
+        ),
+        "per_round_bytes_nodes_x2": nodes2["per_round_bytes"],
+        "per_round_bytes_tasks_x2": tasks2["per_round_bytes"],
+        "per_round_scales_with_tasks": bool(
+            nodes2["per_round_bytes"] == base["per_round_bytes"]
+            and tasks2["per_round_bytes"] > base["per_round_bytes"]
+        ),
+    }
+
+
+def hbm_round_head_model(T=500_000, N=50_000, R=8, node_ring=8,
+                         hbm_gb=16.0):
+    """Per-device residency model of the [T, N]-scale round-head
+    intermediates at the 500k×50k north star: ~14 live bytes per
+    (task, node) block element at the round peak (masked+score_static f32,
+    tie-hash i32, fit/static bools).  The node axis shards along one
+    fixed-width ICI ring (``node_ring``); extra devices can only join the
+    TASK axis — which is exactly when 2-D sharding is the difference
+    between fitting the 16 GB v5e HBM and not.  The task-axis bench probe
+    pairs this model with an actually-completed 2-D-mesh cycle."""
+    BYTES_PER_ELT = 14
+    budget = hbm_gb * 2**30
+    rows = []
+    for ts in (1, 2, 4, 8):
+        per_dev = (T / ts) * (N / node_ring) * BYTES_PER_ELT
+        rows.append({
+            "task_shards": ts,
+            "devices": ts * node_ring,
+            "round_head_gb": round(per_dev / 2**30, 1),
+            "fits_hbm": bool(per_dev < budget),
+        })
+    return {
+        "tasks": T, "nodes": N, "node_ring": node_ring,
+        "hbm_gb": hbm_gb, "bytes_per_elt": BYTES_PER_ELT,
+        "configs": rows,
+    }
+
+
+def task_axis_probe(conf, n_tasks, n_nodes, cycles=3):
+    """The task-axis-sharded cycle: rerun the steady-state regime on a 2-D
+    (tasks=2 × nodes) mesh (KB_TASK_SHARDS=2) and report that the cycle
+    completes sharded with zero steady retraces, next to the HBM model
+    showing the node×task sizes only the 2-D mesh can hold resident."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < 4 or n_dev % 2:
+        return {"skipped": f"{n_dev} devices (need an even count >= 4)",
+                "hbm_model": hbm_round_head_model()}
+    saved = os.environ.get("KB_TASK_SHARDS")
+    os.environ["KB_TASK_SHARDS"] = "2"
+    try:
+        rep = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+    finally:
+        if saved is None:
+            os.environ.pop("KB_TASK_SHARDS", None)
+        else:
+            os.environ["KB_TASK_SHARDS"] = saved
+    return {
+        "task_shards": 2,
+        "solve_mode": rep.get("solve_mode"),
+        "steady_e2e_ms": rep.get("steady", {}).get("e2e"),
+        "retraces_steady": rep.get("retraces_steady"),
+        "resident_scatter": rep.get("resident_scatter"),
+        "hbm_model": hbm_round_head_model(),
+    }
+
+
 def sharded_multicycle(conf, n_tasks, n_nodes, cycles=6):
     """The sharded steady-state section: the multicycle regime (persistent
     cache, 2% churn, ±10% wobble) dispatched over the device mesh — reports
-    the per-shard delta-vs-full upload reduction and the retrace counters.
-    Requires ≥2 devices and a node axis past the shard gate."""
+    the per-shard delta-vs-full upload reduction, the retrace counters,
+    the traced per-round collective-bytes evidence, and the task-axis
+    (2-D mesh) probe.  Requires ≥2 devices and a node axis past the shard
+    gate."""
     import jax
 
     from kube_batch_tpu.parallel.mesh import SHARD_MIN_NODES
@@ -345,6 +470,18 @@ def sharded_multicycle(conf, n_tasks, n_nodes, cycles=6):
     rep = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
     if rep.get("solve_mode") != "sharded":
         rep["warning"] = "solve did not dispatch sharded"
+    try:
+        rep["collectives"] = collective_evidence(n_tasks, n_nodes)
+    except Exception as e:  # noqa: BLE001 — evidence must not sink the bench
+        rep["collectives_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # probe at a bounded size: the 2-D mesh's point is the HBM model +
+        # a completed sharded cycle, not a second full-scale run
+        rep["task_axis"] = task_axis_probe(
+            conf, min(n_tasks, 2000), min(n_nodes, 600)
+        )
+    except Exception as e:  # noqa: BLE001
+        rep["task_axis_error"] = f"{type(e).__name__}: {e}"
     return rep
 
 

@@ -466,6 +466,9 @@ class AllocateAction(Action):
             apply_rows = placed[apply_mask]
             cols.t_node[apply_rows] = node_of[apply_mask]
             cols.j_alloc += job_alloc_sum
+            # alloc-twin choke: the f32 j_alloc32 refresh visits exactly
+            # the rows this vectorized update moved
+            cols.note_job_alloc_rows(np.any(job_alloc_sum != 0.0, axis=1))
             cols.j_pend -= job_total_sum
             np.maximum(cols.j_pend, 0.0, out=cols.j_pend)
             n_pipe_applied = np.bincount(pjobs[pipe_sel], minlength=nJ)

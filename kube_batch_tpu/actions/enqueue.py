@@ -212,8 +212,11 @@ class EnqueueAction(Action):
         ordered = minres_rows[order]
 
         # the jitted prefix-scan (ops/admission.py) at the padded job-axis
-        # capacity — shape-stable across the steady-state wobble
-        from kube_batch_tpu.ops.admission import enqueue_gate_solve
+        # capacity — shape-stable across the steady-state wobble.  When the
+        # cycle's solves shard over the mesh, the scan rides the mesh too
+        # (a replicated shard_map body: every device/process computes the
+        # same admitted mask — multi-controller placement consistency)
+        from kube_batch_tpu.parallel.mesh import dispatch_enqueue_gate
 
         capJ = cols.jobs.cap
         k = ordered.size
@@ -221,9 +224,10 @@ class EnqueueAction(Action):
         minr[:k] = cols.j_minres[ordered]
         candv = np.zeros(capJ, bool)
         candv[:k] = enq_ok[order]
-        admitted_dev = enqueue_gate_solve(
+        admitted_dev = dispatch_enqueue_gate(
             minr, candv,
             idle.vec.astype(np.float32), spec.quanta.astype(np.float32),
+            n_nodes_padded=cols.nodes.cap,
         )
         # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback: the
         # admitted-rows mask the promotions below consume

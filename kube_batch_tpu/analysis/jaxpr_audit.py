@@ -83,14 +83,17 @@ class EntryPoint:
 _T, _N, _J, _Q, _R, _W, _K = 16, 8, 4, 2, 3, 1, 1
 
 
-def _abstract_snapshot():
+def abstract_snapshot(T=_T, N=_N, J=_J, Q=_Q, R=_R, W=_W, K=_K):
+    """A DeviceSnapshot of ShapeDtypeStructs — the audit's default small
+    shapes, or caller-supplied bucket sizes (the bench traces the
+    collective inventory at its REAL padded shapes so the byte counts are
+    the production program's)."""
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.api.snapshot import DeviceSnapshot
 
     f32, i32, b, u32 = jnp.float32, jnp.int32, jnp.bool_, jnp.uint32
-    T, N, J, Q, R, W, K = _T, _N, _J, _Q, _R, _W, _K
     return DeviceSnapshot(
         task_req=S((T, R), f32), task_resreq=S((T, R), f32),
         task_job=S((T,), i32), task_prio=S((T,), i32),
@@ -119,25 +122,25 @@ def _abstract_snapshot():
 def _build_allocate():
     from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
-    return allocate_solve, (_abstract_snapshot(), AllocateConfig())
+    return allocate_solve, (abstract_snapshot(), AllocateConfig())
 
 
 def _build_failure_histogram():
     from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
-    return failure_histogram_solve, (_abstract_snapshot(),)
+    return failure_histogram_solve, (abstract_snapshot(),)
 
 
 def _build_evict_reclaim():
     from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
 
-    return evict_solve, (_abstract_snapshot(), EvictConfig(mode="reclaim"))
+    return evict_solve, (abstract_snapshot(), EvictConfig(mode="reclaim"))
 
 
 def _build_evict_preempt():
     from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
 
-    return evict_solve, (_abstract_snapshot(), EvictConfig(mode="preempt"))
+    return evict_solve, (abstract_snapshot(), EvictConfig(mode="preempt"))
 
 
 def _build_resident_scatter():
@@ -210,25 +213,38 @@ REGISTRY: Tuple[EntryPoint, ...] = (
 # --------------------------------------------------------------------------
 
 
-def _build_sharded_allocate(mesh):
+def _build_sharded_allocate(mesh, impl):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import allocate_solve_fn
 
-    return allocate_solve_fn(mesh, AllocateConfig()), (_abstract_snapshot(),)
+    return allocate_solve_fn(mesh, AllocateConfig(), impl=impl), (
+        abstract_snapshot(),)
 
 
-def _build_sharded_histogram(mesh):
+def _build_sharded_histogram(mesh, impl):
     from kube_batch_tpu.parallel.mesh import failure_histogram_fn
 
-    return failure_histogram_fn(mesh), (_abstract_snapshot(),)
+    return failure_histogram_fn(mesh, impl=impl), (abstract_snapshot(),)
 
 
-def _build_sharded_evict(mesh, mode):
+def _build_sharded_evict(mesh, mode, impl):
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.parallel.mesh import evict_solve_fn
 
-    return evict_solve_fn(mesh, EvictConfig(mode=mode)), (
-        _abstract_snapshot(),)
+    return evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl), (
+        abstract_snapshot(),)
+
+
+def _build_sharded_gate(mesh):
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.parallel.mesh import enqueue_gate_solve_fn
+
+    return enqueue_gate_solve_fn(mesh), (
+        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
+        S((_R,), jnp.float32), S((_R,), jnp.float32),
+    )
 
 
 def _build_shard_scatter(mesh):
@@ -239,8 +255,9 @@ def _build_shard_scatter(mesh):
         SHARD_SCATTER_SLOTS,
         _mesh_shard_scatter_fn,
     )
+    from kube_batch_tpu.parallel.mesh import NODE_AXIS
 
-    d = int(mesh.devices.size)
+    d = int(dict(mesh.shape)[NODE_AXIS])  # node-axis extent, not device count
     return _mesh_shard_scatter_fn(mesh), (
         S((_N, _R), jnp.float32),
         S((d, SHARD_SCATTER_SLOTS), jnp.int32),
@@ -263,7 +280,13 @@ def _build_repl_scatter(mesh):
 
 def sharded_registry() -> Tuple[EntryPoint, ...]:
     """Entry points for the mesh-sharded solve path — empty on single-device
-    backends (no mesh to shard over)."""
+    backends (no mesh to shard over).  BOTH implementations are traced:
+    the shard_map bodies (the production path — KBT101-104 must cover the
+    authored-collective programs) and the pjit oracle (KB_SHARD_MAP=0), so
+    neither can silently regress.  On ≥4-device backends a 2-D
+    (tasks × nodes) mesh variant of the shard_map allocate body is traced
+    too — the task-axis-sharded program is a distinct jaxpr (block
+    slicing + task-axis all_gathers) and needs its own audit."""
     import functools
 
     import jax
@@ -278,22 +301,36 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
         n_dev -= 1
     mesh = make_mesh(n_dev)
     p = functools.partial
-    return (
-        EntryPoint("parallel.mesh.sharded_allocate_solve",
-                   p(_build_sharded_allocate, mesh)),
-        EntryPoint("parallel.mesh.sharded_failure_histogram",
-                   p(_build_sharded_histogram, mesh)),
-        EntryPoint("parallel.mesh.sharded_evict_solve[reclaim]",
-                   p(_build_sharded_evict, mesh, "reclaim")),
-        EntryPoint("parallel.mesh.sharded_evict_solve[preempt]",
-                   p(_build_sharded_evict, mesh, "preempt")),
+    entries = []
+    for impl in ("shard_map", "pjit"):
+        tag = f"[{impl}]"
+        entries += [
+            EntryPoint(f"parallel.mesh.sharded_allocate_solve{tag}",
+                       p(_build_sharded_allocate, mesh, impl)),
+            EntryPoint(f"parallel.mesh.sharded_failure_histogram{tag}",
+                       p(_build_sharded_histogram, mesh, impl)),
+            EntryPoint(f"parallel.mesh.sharded_evict_solve[reclaim]{tag}",
+                       p(_build_sharded_evict, mesh, "reclaim", impl)),
+            EntryPoint(f"parallel.mesh.sharded_evict_solve[preempt]{tag}",
+                       p(_build_sharded_evict, mesh, "preempt", impl)),
+        ]
+    entries += [
+        EntryPoint("parallel.mesh.sharded_enqueue_gate",
+                   p(_build_sharded_gate, mesh)),
         EntryPoint("api.resident.scatter_sharded",
                    p(_build_shard_scatter, mesh),
                    donate=_scatter_donation()),
         EntryPoint("api.resident.scatter_repl",
                    p(_build_repl_scatter, mesh),
                    donate=_scatter_donation()),
-    )
+    ]
+    if n_dev >= 4 and n_dev % 2 == 0 and _T % 2 == 0:
+        mesh2 = make_mesh(n_dev, task_shards=2)
+        entries.append(EntryPoint(
+            "parallel.mesh.sharded_allocate_solve[shard_map,2d]",
+            p(_build_sharded_allocate, mesh2, "shard_map"),
+        ))
+    return tuple(entries)
 
 
 # --------------------------------------------------------------------------

@@ -162,6 +162,13 @@ class ColumnStore:
         self.j_alloc = np.zeros((capJ, R), np.float64)
         self.j_total = np.zeros((capJ, R), np.float64)
         self.j_pend = np.zeros((capJ, R), np.float64)
+        # persistent float32 twin of j_alloc, refreshed only at rows the
+        # dirty choke points touched (JobInfo's allocated add_/sub_, the
+        # columnar replay's vectorized += , row bind/free) — the device
+        # snapshot reads this instead of paying a full [capJ, R] cast every
+        # cycle (the node ledgers' dirty-row treatment, applied to jobs)
+        self.j_alloc32 = np.zeros((capJ, R), np.float32)
+        self._j_alloc_dirty = np.ones(capJ, bool)
         self.j_counts = np.zeros((capJ, N_STATUS), np.int32)
         self.job_by_row: List = [None] * capJ
         # per-cycle scratch (filled by the job scan in device_snapshot)
@@ -410,6 +417,7 @@ class ColumnStore:
         # objects as views (contiguous f64 rows — the .vec setter keeps them
         # zero-copy)
         self.j_alloc[row] = job.allocated.vec
+        self._j_alloc_dirty[row] = True
         self.j_total[row] = job.total_request.vec
         self.j_pend[row] = job.pending_request.vec
         job.allocated.vec = self.j_alloc[row]
@@ -447,6 +455,7 @@ class ColumnStore:
         job.total_request.vec = self.j_total[row].copy()
         job.pending_request.vec = self.j_pend[row].copy()
         self.j_alloc[row] = 0.0
+        self._j_alloc_dirty[row] = True
         self.j_total[row] = 0.0
         self.j_pend[row] = 0.0
         self.j_counts[row] = 0
@@ -455,11 +464,15 @@ class ColumnStore:
 
     def _grow_jobs(self) -> None:
         cap = self.jobs.grown_cap()
-        for name in ("j_alloc", "j_total", "j_pend", "j_counts", "j_min",
+        for name in ("j_alloc", "j_alloc32", "j_total", "j_pend", "j_counts",
+                     "j_min",
                      "j_queue", "j_prio", "j_creation", "j_sess", "j_sched",
                      "j_has_pg", "j_shadow", "j_pdb",
                      "j_has_conds", "j_has_minres", "j_minres", "j_touched"):
             setattr(self, name, _grow(getattr(self, name), cap))
+        dirty = np.ones(cap, bool)  # grown rows refresh on first read
+        dirty[: self._j_alloc_dirty.shape[0]] = self._j_alloc_dirty
+        self._j_alloc_dirty = dirty
         j_phase = np.full(cap, -1, np.int8)
         j_phase[: self.j_phase.shape[0]] = self.j_phase
         self.j_phase = j_phase
@@ -862,6 +875,29 @@ class ColumnStore:
     def note_node_ledger_rows(self, rows) -> None:
         self._node_ledger_dirty[rows] = True
 
+    # ---- job-alloc dirty rows (the j_alloc f32 cast choke point) -----
+    def note_job_alloc(self, row: int) -> None:
+        """Mark one job row's allocated ledger changed — every write path
+        calls this (JobInfo's allocated add_/sub_ via _note_alloc, the
+        columnar replay's vectorized +=, bind/free/grow, the cache's
+        snapshot-less resets), so the per-cycle float32 refresh pays
+        exactly the touched rows instead of a full [capJ, R] cast."""
+        self._j_alloc_dirty[row] = True
+
+    def note_job_alloc_rows(self, rows) -> None:
+        self._j_alloc_dirty[rows] = True
+
+    def job_alloc32(self) -> np.ndarray:
+        """The persistent float32 twin of j_alloc, refreshed at exactly the
+        dirty rows (the node-ledger twin treatment applied to the job
+        axis — previously a full-matrix astype every device_snapshot)."""
+        dirty = self._j_alloc_dirty
+        if dirty.any():
+            rows = np.flatnonzero(dirty)
+            self.j_alloc32[rows] = self.j_alloc[rows]
+            dirty[:] = False
+        return self.j_alloc32
+
     def node_ledgers32(self):
         """(idle32, rel32, used32, alloc32) — the persistent float32 ledger
         twins, refreshed at exactly the dirty rows."""
@@ -1134,7 +1170,7 @@ class ColumnStore:
             job_creation=j_creation,
             job_valid=j_sess,
             job_schedulable=j_sched,
-            job_allocated=self.j_alloc.astype(np.float32),
+            job_allocated=self.job_alloc32(),
             queue_weight=self.q_weight,
             queue_capability=self.q_cap,
             queue_alloc=queue_alloc,
@@ -1257,6 +1293,16 @@ class ColumnStore:
                     f"node ledger twin {label} stale at rows {rows.tolist()}"
                     " (missed note_node_ledger choke point)"
                 )
+        # same contract for the job-alloc twin (note_job_alloc choke)
+        self.job_alloc32()
+        if not np.array_equal(self.j_alloc32, self.j_alloc.astype(np.float32)):
+            rows = np.flatnonzero(np.any(
+                self.j_alloc32 != self.j_alloc.astype(np.float32), axis=1
+            ))[:8]
+            errs.append(
+                f"job alloc twin stale at rows {rows.tolist()}"
+                " (missed note_job_alloc choke point)"
+            )
         return errs
 
 

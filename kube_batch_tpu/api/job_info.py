@@ -109,6 +109,15 @@ class JobInfo:
     def unset_pdb(self) -> None:
         self.pdb = None
 
+    def _note_alloc(self) -> None:
+        """Allocated-ledger dirty choke: the job's `allocated` Resource is
+        a zero-copy view of its ColumnStore j_alloc row, so every add_/sub_
+        writes the column directly — this note keeps the device snapshot's
+        f32 twin (columns.job_alloc32) refreshing exactly the touched
+        rows."""
+        if self._cols is not None and self._row >= 0:
+            self._cols.note_job_alloc(self._row)
+
     # -- task bookkeeping (job_info.go:211-263) ---------------------------
     def _index_add(self, task: TaskInfo) -> None:
         self.task_status_index[task.status][task.key()] = task
@@ -133,6 +142,7 @@ class JobInfo:
         self._index_add(task)
         if is_allocated(task.status):
             self.allocated.add_(task.resreq)
+            self._note_alloc()
         elif task.status == TaskStatus.PENDING:
             self.pending_request.add_(task.resreq)
         self.total_request.add_(task.resreq)
@@ -145,6 +155,7 @@ class JobInfo:
             return
         if is_allocated(existing.status):
             self.allocated.sub_(existing.resreq)
+            self._note_alloc()
         elif existing.status == TaskStatus.PENDING:
             self.pending_request.sub_(existing.resreq)
         self.total_request.sub_(existing.resreq)
@@ -267,6 +278,7 @@ class JobInfo:
                 self.allocated.add_(resreq_sum)
             else:
                 self.allocated.sub_(resreq_sum)
+            self._note_alloc()
 
     def rebucket_moved(self, tasks, status: TaskStatus) -> None:
         """Status-index bucket moves ONLY, for the columnar allocate replay:

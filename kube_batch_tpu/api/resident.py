@@ -92,9 +92,14 @@ SCATTER_SLOTS = SCATTER_SLOT_BUCKETS[-1]
 
 #: per-shard slot-width buckets of the mesh scatter: the [n_shards, slots]
 #: delta is sharded on its leading axis, so each chip receives exactly its
-#: own slice
+#: own slice.  This static ladder is the DEFAULT (zero observed churn);
+#: the sharded cache retargets its live ladder from the churn EWMA
+#: (:func:`adaptive_ladder`), capped by SHARD_SCATTER_SLOTS.
 SHARD_SCATTER_SLOT_BUCKETS: Tuple[int, ...] = (16, 128, 1024)
 SHARD_SCATTER_SLOTS = SHARD_SCATTER_SLOT_BUCKETS[-1]
+
+#: churn EWMA smoothing for the adaptive per-shard ladder
+CHURN_EWMA_DECAY = 0.8
 
 
 def _slot_bucket(n: int, buckets: Tuple[int, ...]) -> int:
@@ -104,6 +109,41 @@ def _slot_bucket(n: int, buckets: Tuple[int, ...]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def all_shard_buckets(max_slots: int) -> Tuple[int, ...]:
+    """Every per-shard bucket width the adaptive ladder can ever select
+    (powers of two from 16 up to the hard cap).  The cold-upload prewarm
+    compiles ALL of them, so a later ladder retarget is pure payload-
+    sizing bookkeeping — no compile can ever land in a steady-state
+    cycle, no matter where the churn EWMA moves."""
+    out = []
+    v = min(16, max_slots)
+    while True:
+        out.append(v)
+        if v >= max_slots:
+            return tuple(out)
+        v = min(v * 2, max_slots)
+
+
+def adaptive_ladder(ewma: float, max_slots: int) -> Tuple[int, ...]:
+    """Per-shard slot-bucket ladder sized from the observed churn EWMA
+    (replacing the static 16/128/1024 cap): the base bucket is the
+    smallest power of two ≥ max(16, 2×ewma) — 2× headroom so the typical
+    steady-state delta lands in the FIRST bucket instead of climbing the
+    ladder — then ×8 steps up to the hard cap.  Zero churn reproduces the
+    static default exactly; a steady high-churn regime drops the
+    too-small buckets (their payloads would never be used) and starts at
+    a bucket the observed deltas actually fit."""
+    base = 16
+    target = max(16.0, 2.0 * ewma)
+    while base < target and base < max_slots:
+        base *= 2
+    base = min(base, max_slots)
+    ladder = [base]
+    while ladder[-1] < max_slots:
+        ladder.append(min(ladder[-1] * 8, max_slots))
+    return tuple(ladder)
 
 
 _SCATTER = None
@@ -348,17 +388,142 @@ class PerCycleDeviceCache:
 class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
     """Per-cycle residency for the mesh-sharded solve path (module
     docstring): node-axis columns live sharded over `mesh`, everything else
-    replicated across it, refreshed by per-shard donated scatter deltas."""
+    replicated across it, refreshed by per-shard donated scatter deltas.
+
+    Multi-host meshes: each process materializes and ships only its own
+    ADDRESSABLE shards — uploads and per-shard payloads go through
+    ``jax.make_array_from_callback`` (the callback is invoked per local
+    shard only), so a host's cross-DCN upstream per cycle is its own
+    shard's delta rows, never the full column.  The byte counters record
+    the per-HOST share on sharded fields.
+
+    The per-shard slot ladder is ADAPTIVE (:func:`adaptive_ladder`): a
+    churn EWMA over the per-cycle max per-shard delta width retargets the
+    bucket set, replacing the static 16/128/1024 sizing.  The cold-upload
+    prewarm compiles the FULL reachable bucket set up front
+    (:func:`all_shard_buckets`, no-op scatters with all-out-of-range
+    padding indices), so a retarget is pure payload-sizing bookkeeping
+    and a real delta of any admissible width is a jit cache hit — steady
+    state never retraces regardless of where the ladder moves."""
 
     def __init__(self, mesh) -> None:
         super().__init__()
         self.mesh = mesh
-        self.n_shards = int(mesh.devices.size)
+        from kube_batch_tpu.parallel.mesh import NODE_AXIS
+
+        # the SCATTER shard count is the node-axis extent — on a 2-D
+        # (tasks, nodes) mesh the node columns replicate across the task
+        # axis, so the [n_shards, slots] payload splits by node shard only
+        self.n_shards = int(dict(mesh.shape)[NODE_AXIS])
+        self.churn_ewma = 0.0
+        self._ladder: Tuple[int, ...] = adaptive_ladder(
+            0.0, SHARD_SCATTER_SLOTS
+        )
+        self._warm: Dict[str, set] = {}   # field → warmed bucket widths
+        self._cycle_max = 0
+        self.ladder_retargets = 0
+
+    def counters(self) -> Dict[str, int]:
+        out = super().counters()
+        out["churn_ewma"] = round(self.churn_ewma, 2)
+        out["slot_ladder"] = list(self._ladder)
+        out["ladder_retargets"] = self.ladder_retargets
+        return out
 
     def _sharding(self, field: str):
         from kube_batch_tpu.parallel.mesh import snapshot_shardings
 
         return getattr(snapshot_shardings(self.mesh), field)
+
+    def _host_fraction(self) -> float:
+        """This process's addressable share of the mesh — the per-host
+        byte-counter scale for sharded payloads."""
+        import jax
+
+        pc = jax.process_count()
+        return 1.0 / pc if pc > 1 else 1.0
+
+    def _put(self, host: np.ndarray, sharding):
+        """Placed upload: single-process goes through device_put; on a
+        multi-host mesh each process materializes only its addressable
+        shards via make_array_from_callback (the per-host scatter/upload
+        contract above)."""
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+        return jax.device_put(host, sharding)
+
+    def _put_payload(self, arr: np.ndarray):
+        """Per-shard scatter payload ([n_shards, slots, ...], leading axis
+        sharded over the node axis): pre-placed per host on multi-process
+        meshes so only the local shards' slices upload; single-process
+        passes the numpy array straight to the jitted scatter (whose
+        in_shardings place it)."""
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kube_batch_tpu.parallel.mesh import NODE_AXIS
+
+        return jax.make_array_from_callback(
+            arr.shape, NamedSharding(self.mesh, P(NODE_AXIS)),
+            lambda idx: arr[idx],
+        )
+
+    def _prewarm_shard_field(self, field: str, dev, n_rows: int):
+        """Compile every not-yet-warm per-shard bucket for `field` — the
+        FULL reachable set (:func:`all_shard_buckets`), not just the live
+        ladder — with no-op scatters (all padding indices → zero writes,
+        two passes so the scatter-OUTPUT buffer layout is covered too).
+        Returns the (donated and rebound) device buffer."""
+        host = self._mirror.get(field)
+        dtype = host.dtype if host is not None else np.float32
+        tail = host.shape[1:] if host is not None else ()
+        s = n_rows // self.n_shards
+        warm = self._warm.setdefault(field, set())
+        todo = [
+            b for b in all_shard_buckets(SHARD_SCATTER_SLOTS)
+            if b not in warm
+        ]
+        for _ in range(2):
+            for slots in todo:
+                rows = np.full((self.n_shards, slots), s, np.int32)
+                vals = np.zeros((self.n_shards, slots) + tail, dtype)
+                dev = _mesh_shard_scatter_fn(self.mesh)(
+                    dev, self._put_payload(rows), self._put_payload(vals)
+                )
+        warm.update(todo)
+        return dev
+
+    def _note_churn(self, per_shard_max: int) -> None:
+        self._cycle_max = max(self._cycle_max, per_shard_max)
+
+    def _retarget_ladder(self) -> None:
+        """EWMA update + ladder retarget at swap end.  Retargeting only
+        changes which payload widths later deltas ship — every reachable
+        bucket was compiled at cold-upload prewarm, so this costs nothing
+        and can never retrace a steady-state cycle."""
+        self.churn_ewma = (
+            CHURN_EWMA_DECAY * self.churn_ewma
+            + (1.0 - CHURN_EWMA_DECAY) * self._cycle_max
+        )
+        self._cycle_max = 0
+        new = adaptive_ladder(self.churn_ewma, SHARD_SCATTER_SLOTS)
+        if new != self._ladder:
+            self._ladder = new
+            self.ladder_retargets += 1
+
+    def swap(self, snap):
+        if snap is self._last_in:
+            return self._last_out
+        out = super().swap(snap)
+        self._retarget_ladder()
+        return out
 
     def _full_upload(self, field: str, host: np.ndarray,
                      prewarm: bool = True):
@@ -369,11 +534,12 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
         (NamedSharding divisibility), so the sharded solve path never
         reaches here with one; the shape buckets (snapshot.bucket) are
         divisible by any power-of-two mesh."""
-        import jax
-
+        sharded_axis = field in NODE_AXIS_FIELDS
         self.full_uploads += 1
-        self.bytes_full += host.nbytes
-        dev = jax.device_put(host, self._sharding(field))
+        self.bytes_full += int(
+            host.nbytes * (self._host_fraction() if sharded_axis else 1.0)
+        )
+        dev = self._put(host, self._sharding(field))
         if not prewarm:
             self._mirror[field] = host.copy()
             self._dev[field] = dev
@@ -381,28 +547,26 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
         # two prewarm passes — see PerCycleDeviceCache._refresh: real deltas
         # see scatter-OUTPUT buffers, whose (sharded) layout can key a fresh
         # specialization vs the device_put-placed first input
-        if field in NODE_AXIS_FIELDS:
-            s = host.shape[0] // self.n_shards
-            for _ in range(2):
-                for slots in SHARD_SCATTER_SLOT_BUCKETS:
-                    rows = np.full((self.n_shards, slots), s, np.int32)
-                    vals = np.zeros(
-                        (self.n_shards, slots) + host.shape[1:], host.dtype
-                    )
-                    dev = _mesh_shard_scatter_fn(self.mesh)(dev, rows, vals)
+        self._mirror[field] = host.copy()
+        if sharded_axis:
+            self._warm.pop(field, None)  # shape may have changed — rewarm
+            dev = self._prewarm_shard_field(field, dev, host.shape[0])
         else:
             for _ in range(2):
                 for slots in SCATTER_SLOT_BUCKETS:
                     rows = np.full(slots, host.shape[0], np.int32)
                     vals = np.zeros((slots,) + host.shape[1:], host.dtype)
                     dev = _mesh_repl_scatter_fn(self.mesh)(dev, rows, vals)
-        self._mirror[field] = host.copy()
         self._dev[field] = dev
         return dev
 
     def _refresh(self, field: str, host: np.ndarray):
-        self.bytes_if_full += host.nbytes
         sharded_axis = field in NODE_AXIS_FIELDS
+        # per-host accounting on sharded fields must scale the DENOMINATOR
+        # too, or upload_reduction would read inflated on multi-host meshes
+        self.bytes_if_full += int(
+            host.nbytes * (self._host_fraction() if sharded_axis else 1.0)
+        )
         mirror = self._mirror.get(field)
         if (
             mirror is None
@@ -421,11 +585,14 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
             s = host.shape[0] // self.n_shards
             shard_ids = changed // s  # ascending: flatnonzero sorts rows
             counts = np.bincount(shard_ids, minlength=self.n_shards)
-            if int(counts.max()) > SHARD_SCATTER_SLOTS:
+            widest = int(counts.max())
+            self._note_churn(widest)
+            if widest > min(self._ladder[-1], SHARD_SCATTER_SLOTS):
+                # over the LIVE ladder's cap — full re-upload; the churn
+                # note above grows the EWMA so a sustained regime retargets
+                # (and pre-warms) a wider ladder instead of thrashing
                 return self._full_upload(field, host, prewarm=False)
-            slots = _slot_bucket(
-                int(counts.max()), SHARD_SCATTER_SLOT_BUCKETS
-            )
+            slots = _slot_bucket(widest, self._ladder)
             if self._payload_bytes(slots, host) * self.n_shards >= host.nbytes:
                 # tiny sharded column: the whole upload is cheaper than the
                 # smallest per-shard scatter payload
@@ -439,8 +606,16 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
             )
             vals[shard_ids, pos] = host[changed]
             dev = _mesh_shard_scatter_fn(self.mesh)(
-                self._dev[field], rows, vals
+                self._dev[field], self._put_payload(rows),
+                self._put_payload(vals),
             )
+            mirror[changed] = host[changed]
+            self._dev[field] = dev
+            self.scatter_updates += 1
+            self.bytes_scatter += int(
+                (rows.nbytes + vals.nbytes) * self._host_fraction()
+            )
+            return dev
         else:
             if changed.size > SCATTER_SLOTS:
                 return self._full_upload(field, host, prewarm=False)

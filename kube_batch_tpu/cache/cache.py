@@ -1023,6 +1023,7 @@ class SchedulerCache:
                 job.allocated.vec[:] = 0.0
                 job.total_request.vec[:] = 0.0
                 job.pending_request.vec[:] = 0.0
+                job._note_alloc()
                 if job._cols is not None:
                     job._cols.j_counts[job._row] = 0
                     job._cols.j_touched[job._row] = True

@@ -45,35 +45,42 @@ from kube_batch_tpu.utils import jitstats
 _GATE = None
 
 
+def gate_scan(min_res, cand, idle0, quanta):
+    """The raw (untraced) admission scan — shared by the single-device jit
+    wrapper below AND the mesh-replicated shard_map wrapper
+    (parallel/mesh.enqueue_gate_solve_fn), so both paths trace the
+    identical program and the verdicts are bit-equal by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, inp):
+        idle, comp = carry
+        m, c = inp
+        eff = idle + comp  # compensated view of the budget
+        fits = jnp.all((m <= eff) | (m - eff < quanta))
+        ok = c & fits
+        # Kahan/Neumaier-compensated deduction: carry the low bits
+        # `idle - m` would round away (module docstring)
+        y = jnp.where(ok, comp - m, comp)
+        t = idle + y
+        comp = (idle - t) + y
+        idle = jnp.maximum(t, 0.0)  # Resource.sub_'s clamp
+        comp = jnp.where(idle > 0.0, comp, 0.0)
+        return (idle, comp), ok
+
+    init = (idle0, jnp.zeros_like(idle0))
+    _, admitted = jax.lax.scan(step, init, (min_res, cand))
+    return admitted
+
+
 def enqueue_gate_fn():
     """The shared jitted admission scan (module-level memo — one compile
     cache for every cache/scheduler instance in the process)."""
     global _GATE
     if _GATE is None:
         import jax
-        import jax.numpy as jnp
 
-        def gate(min_res, cand, idle0, quanta):
-            def step(carry, inp):
-                idle, comp = carry
-                m, c = inp
-                eff = idle + comp  # compensated view of the budget
-                fits = jnp.all((m <= eff) | (m - eff < quanta))
-                ok = c & fits
-                # Kahan/Neumaier-compensated deduction: carry the low bits
-                # `idle - m` would round away (module docstring)
-                y = jnp.where(ok, comp - m, comp)
-                t = idle + y
-                comp = (idle - t) + y
-                idle = jnp.maximum(t, 0.0)  # Resource.sub_'s clamp
-                comp = jnp.where(idle > 0.0, comp, 0.0)
-                return (idle, comp), ok
-
-            init = (idle0, jnp.zeros_like(idle0))
-            _, admitted = jax.lax.scan(step, init, (min_res, cand))
-            return admitted
-
-        _GATE = jitstats.register("enqueue_gate", jax.jit(gate))
+        _GATE = jitstats.register("enqueue_gate", jax.jit(gate_scan))
     return _GATE
 
 

@@ -52,12 +52,15 @@ _H2 = 0x85EBCA77 - (1 << 32)
 _H3 = 0xCA87C3EB - (1 << 32)
 
 
-def _tie_break_hash(T: int, N: int) -> jnp.ndarray:
+def _tie_break_hash(T: int, N: int, t0=0, n0=0) -> jnp.ndarray:
     """[T, N] deterministic per-(task, node) hash in [0, 65535] (i32).
     Ordering is identical to the previous float form (a monotone rescale of
-    the same 16 hash bits)."""
-    ti = jnp.arange(T, dtype=jnp.int32)[:, None]
-    ni = jnp.arange(N, dtype=jnp.int32)[None, :]
+    the same 16 hash bits).  `t0`/`n0` (static or traced i32) offset the
+    indices to GLOBAL coordinates when (T, N) is a block of a larger matrix
+    — the shard_map round head (parallel/shard_solve.py) computes the hash
+    of its local block and must agree bit-for-bit with the full matrix."""
+    ti = (jnp.arange(T, dtype=jnp.int32) + t0)[:, None]
+    ni = (jnp.arange(N, dtype=jnp.int32) + n0)[None, :]
     h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
     h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
     return jax.lax.shift_right_logical(h, 16)
@@ -208,19 +211,77 @@ def _resolve_conflicts(
     return accept, delta
 
 
-@partial(jax.jit, static_argnames=("config",))
-def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
-    """One allocate action pass over the snapshot."""
-    T, R = snap.task_req.shape
-    N = snap.node_alloc.shape[0]
-    J = snap.job_min_avail.shape[0]
-    Q = snap.queue_weight.shape[0]
-
+def local_round_head(snap: DeviceSnapshot, config: AllocateConfig):
+    """Build the single-program round head: ``head(idle, releasing,
+    pending) -> (best, has, chose_idle)`` computed from the full [T, N]
+    matrices in one logical program (on the pjit path GSPMD partitions it
+    implicitly).  The shard_map path substitutes the explicit-collective
+    block head (parallel/shard_solve.py); everything else in the solve is
+    the SHARED :func:`allocate_rounds` machinery, so the two paths can only
+    diverge in the head — which both compute bit-identically."""
     static_ok = static_predicates(snap)           # [T, N]
     score = score_matrix(snap, config.weights)
     # static predicates folded into the score once — every round reuses it
     score_static = jnp.where(static_ok, score, NEG)
+    T, N = score.shape
     tie_hash = _tie_break_hash(T, N)
+
+    def head(idle, releasing, pending):
+        if config.use_pallas:
+            from kube_batch_tpu.ops.pallas_kernels import masked_best_node
+
+            return masked_best_node(
+                score, static_ok, snap.task_req, idle, releasing,
+                pending, snap.quanta,
+                interpret=jax.default_backend() != "tpu",
+            )
+        fit_idle = fits(snap.task_req, idle, snap.quanta)
+        # zero-releasing clusters (every allocate-only cycle) skip
+        # the second [T, N] fit entirely: with an all-zero budget the
+        # only "fits" are tasks below quanta in every dim — BestEffort
+        # tasks, which are never solver-pending (task_pending
+        # excludes them), so all-False is exact for solver outputs
+        fit_rel = jax.lax.cond(
+            jnp.any(releasing > 0.0),
+            lambda rel: fits(snap.task_req, rel, snap.quanta),
+            lambda rel: jnp.zeros_like(fit_idle),
+            releasing,
+        )
+        # score_static pre-folds the loop-invariant static predicate
+        # mask into the score (hoisted out of the rounds)
+        masked = jnp.where(
+            (fit_idle | fit_rel) & pending[:, None], score_static, NEG
+        )
+        best, has = _best_node(masked, tie_hash)
+        # allocate if the chosen node fits Idle, else pipeline onto
+        # Releasing (allocate.go:161-184: the idle-vs-releasing decision
+        # happens on the already-selected best-score node)
+        chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
+        return best, has, chose_idle
+
+    return head
+
+
+def allocate_rounds(
+    snap: DeviceSnapshot,
+    config: AllocateConfig,
+    head_fn,
+    idle0: jnp.ndarray,
+    releasing0: jnp.ndarray,
+    used0: jnp.ndarray,
+) -> AllocateResult:
+    """The solve machinery shared by every allocate path: bidding rounds
+    with ``head_fn`` supplying (best, has, chose_idle) per round, conflict
+    resolution, the proportion gate, and the gang commit/discard outer
+    loop.  ``idle0``/``releasing0``/``used0`` are the GLOBAL [N, R] cycle-
+    start ledgers (the shard_map body passes the explicitly all-gathered
+    replicated copies; per-round cross-shard traffic then lives entirely
+    inside ``head_fn``)."""
+    T, R = snap.task_req.shape
+    N = idle0.shape[0]
+    J = snap.job_min_avail.shape[0]
+    Q = snap.queue_weight.shape[0]
+
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
 
     # proportion deserved is computed once per cycle from the session-open
@@ -294,34 +355,7 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
             )
             pending = eligible & ~placed & ~job_failed[snap.task_job]
 
-            if config.use_pallas:
-                from kube_batch_tpu.ops.pallas_kernels import masked_best_node
-
-                best, has, chose_idle_k = masked_best_node(
-                    score, static_ok, snap.task_req, idle, releasing,
-                    pending, snap.quanta,
-                    interpret=jax.default_backend() != "tpu",
-                )
-                fit_idle = None
-            else:
-                fit_idle = fits(snap.task_req, idle, snap.quanta)
-                # zero-releasing clusters (every allocate-only cycle) skip
-                # the second [T, N] fit entirely: with an all-zero budget the
-                # only "fits" are tasks below quanta in every dim — BestEffort
-                # tasks, which are never solver-pending (task_pending
-                # excludes them), so all-False is exact for solver outputs
-                fit_rel = jax.lax.cond(
-                    jnp.any(releasing > 0.0),
-                    lambda rel: fits(snap.task_req, rel, snap.quanta),
-                    lambda rel: jnp.zeros_like(fit_idle),
-                    releasing,
-                )
-                # score_static pre-folds the loop-invariant static predicate
-                # mask into the score (hoisted out of the rounds)
-                masked = jnp.where(
-                    (fit_idle | fit_rel) & pending[:, None], score_static, NEG
-                )
-                best, has = _best_node(masked, tie_hash)
+            best, has, chose_idle = head_fn(idle, releasing, pending)
             if config.proportion:
                 new_alloc_cnt = jax.ops.segment_sum(
                     (placed & ~pipelined).astype(jnp.int32),
@@ -343,13 +377,6 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
                     job_need,
                     J,
                 )
-            # allocate if the chosen node fits Idle, else pipeline onto
-            # Releasing (allocate.go:161-184: the idle-vs-releasing decision
-            # happens on the already-selected best-score node)
-            if config.use_pallas:
-                chose_idle = chose_idle_k
-            else:
-                chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
             alloc_cand = has & chose_idle
             pipe_cand = has & ~chose_idle
 
@@ -435,9 +462,9 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         return (o < config.outer) & more
 
     init = (
-        snap.node_idle,
-        snap.node_releasing,
-        snap.node_used,
+        idle0,
+        releasing0,
+        used0,
         jnp.full(T, -1, jnp.int32),
         jnp.zeros(T, bool),
         jnp.zeros(J, bool),
@@ -466,6 +493,15 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
         node_used=used,
         deserved=deserved,
         rounds_run=rounds_run,
+    )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
+    """One allocate action pass over the snapshot."""
+    return allocate_rounds(
+        snap, config, local_round_head(snap, config),
+        snap.node_idle, snap.node_releasing, snap.node_used,
     )
 
 

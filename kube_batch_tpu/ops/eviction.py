@@ -89,10 +89,103 @@ class EvictResult(NamedTuple):
     victim_claimant: jnp.ndarray  # [T] i32 — claimant task index a victim serves, -1
 
 
-@partial(jax.jit, static_argnames=("config",))
-def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
+def local_evict_bids(snap: DeviceSnapshot, config: EvictConfig):
+    """Build the single-program bids head: ``bids(victim_ok, claimant_ok)
+    -> (best, has)`` — the per-round [T, N]-scale victim-capacity /
+    feasibility / masked-argmax block, computed from the full matrices in
+    one logical program.  The shard_map path substitutes the explicit-
+    collective block head (parallel/shard_solve.py); the rest of the solve
+    is the SHARED :func:`evict_rounds` machinery."""
     T, R = snap.task_req.shape
     N = snap.node_alloc.shape[0]
+    Q = snap.queue_weight.shape[0]
+    preempt = config.mode == "preempt"
+    task_queue = snap.job_queue[snap.task_job]                      # [T]
+    static_ok = static_predicates(snap)
+    score = score_matrix(snap, config.weights)
+    tie_hash = _tie_break_hash(T, N)
+
+    def bids(victim_ok, claimant_ok):
+        # ---- per-(queue, node) evictable capacity --------------------
+        vreq = jnp.where(victim_ok[:, None], snap.task_resreq, 0.0)
+        vnode = jnp.where(victim_ok, snap.task_node, N)
+        tot_v = jax.ops.segment_sum(vreq, vnode, num_segments=N + 1)[:N]  # [N, R]
+        per_qn = jnp.zeros((Q, N, R), jnp.float32).at[
+            task_queue, jnp.clip(snap.task_node, 0, N - 1)
+        ].add(vreq)
+        if preempt:
+            cap = per_qn                      # same-queue victims (own job
+            #                                   over-counted; corrected in
+            #                                   the shared victim selection)
+        else:
+            cap = tot_v[None] - per_qn        # cross-queue victims
+
+        # ---- bids ----------------------------------------------------
+        # feasible[t, n] iff claimant t's InitResreq fits cap[queue_t, n].
+        # Each claimant's queue-specific capacity row is gathered with a
+        # one-hot matmul over the queue axis ([T,Q]@[Q,N] on the MXU, one
+        # per resource dim): compile cost and kernel count stay flat as the
+        # queue bucket grows, unlike the unrolled per-queue fits pass this
+        # replaces (Q=128 would mean 128 full [T,N] passes). The one-hot
+        # contraction selects exactly one row, so it is exact, not a sum.
+        onehot_q = (task_queue[:, None] == jnp.arange(Q)[None, :]).astype(
+            jnp.float32
+        )                                                            # [T, Q]
+        # a queue index outside [0, Q) gathers an all-zero capacity row from
+        # the one-hot contraction; a near-zero request could still pass the
+        # epsilon compare against it — make such tasks categorically
+        # infeasible rather than relying on claimant_ok to exclude them
+        feas = static_ok & claimant_ok[:, None]
+        feas &= ((task_queue >= 0) & (task_queue < Q))[:, None]
+        for r in range(R):  # R is the small static resource dim
+            # HIGHEST precision: TPU default matmul truncates the f32
+            # capacity operand to bf16 (~2^-8 relative), which at byte-unit
+            # memory magnitudes (~1e11) dwarfs the 10 MiB quantum the
+            # epsilon compare below relies on — exact f32 keeps the one-hot
+            # contraction a true row selection
+            # kbt: allow[KBT005] trace-time unroll over the small static
+            # resource dim R inside jit — R fused matmuls in the compiled
+            # graph, zero per-iteration host dispatch
+            cap_tr = jnp.matmul(
+                onehot_q, cap[:, :, r], precision=jax.lax.Precision.HIGHEST
+            )                                                        # [T, N]
+            feas &= snap.task_req[:, r, None] <= cap_tr + snap.quanta[r]
+        masked = jnp.where(feas, score, NEG)
+        # tie-hash spread: without it every equal-score claimant bids the
+        # same argmax node and only one claim lands per round
+        return _best_node(masked, tie_hash)
+
+    return bids
+
+
+def local_idle_fit_any(snap: DeviceSnapshot):
+    """[T] bool — task fits some schedulable node's cycle-start Idle (the
+    reclaim idle gate's [T, N] probe; the shard_map path computes it
+    blockwise with a psum over the node shards)."""
+    return jnp.any(
+        fits(snap.task_req, snap.node_idle, snap.quanta)
+        & static_predicates(snap),
+        axis=1,
+    )
+
+
+def evict_rounds(
+    snap: DeviceSnapshot,
+    config: EvictConfig,
+    bids_fn,
+    fits_idle_any=None,
+    n_nodes=None,
+) -> EvictResult:
+    """The eviction machinery shared by every solve path: victim/claimant
+    eligibility, ranks, winner-per-node selection, victim picking, global
+    caps, coverage, and the commit gate — everything that reads only the
+    task/job/queue-axis vectors (replicated under shard_map).  The [T, N]-
+    scale bids come from ``bids_fn``; ``fits_idle_any`` is the idle-gate
+    probe (required iff ``config.idle_gate`` on reclaim).  ``n_nodes``
+    overrides the GLOBAL node count when ``snap``'s node arrays are
+    shard-local blocks (the shard_map body)."""
+    T, R = snap.task_req.shape
+    N = n_nodes if n_nodes is not None else snap.node_alloc.shape[0]
     J = snap.job_min_avail.shape[0]
     Q = snap.queue_weight.shape[0]
     preempt = config.mode == "preempt"
@@ -109,9 +202,6 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
         & (snap.task_node >= 0)
         & snap.job_valid[snap.task_job]
     )
-    static_ok = static_predicates(snap)
-    score = score_matrix(snap, config.weights)
-    tie_hash = _tie_break_hash(T, N)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
     # victims pop in reverse task order (!TaskOrderFn, preempt.go:219-224)
     victim_rank = ordering.multisort_ranks([snap.task_prio, -snap.task_creation])
@@ -147,10 +237,6 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
         # is approximate — allocate's host re-check might reject the node
         # and strand them).  Preempt never gates: it runs after allocate,
         # so its claimants already failed idle placement this cycle.
-        fits_idle_any = jnp.any(
-            fits(snap.task_req, snap.node_idle, snap.quanta) & static_ok,
-            axis=1,
-        )
         claimant_base &= ~(fits_idle_any & ~snap.task_needs_host)
 
     def round_body(state):
@@ -232,53 +318,8 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
             proportion_enabled=config.proportion,
         )
 
-        # ---- per-(queue, node) evictable capacity --------------------
-        vreq = jnp.where(victim_ok[:, None], snap.task_resreq, 0.0)
-        vnode = jnp.where(victim_ok, snap.task_node, N)
-        tot_v = jax.ops.segment_sum(vreq, vnode, num_segments=N + 1)[:N]  # [N, R]
-        per_qn = jnp.zeros((Q, N, R), jnp.float32).at[
-            task_queue, jnp.clip(snap.task_node, 0, N - 1)
-        ].add(vreq)
-        if preempt:
-            cap = per_qn                      # same-queue victims (own job
-            #                                   over-counted; corrected below)
-        else:
-            cap = tot_v[None] - per_qn        # cross-queue victims
-
-        # ---- bids ----------------------------------------------------
-        # feasible[t, n] iff claimant t's InitResreq fits cap[queue_t, n].
-        # Each claimant's queue-specific capacity row is gathered with a
-        # one-hot matmul over the queue axis ([T,Q]@[Q,N] on the MXU, one
-        # per resource dim): compile cost and kernel count stay flat as the
-        # queue bucket grows, unlike the unrolled per-queue fits pass this
-        # replaces (Q=128 would mean 128 full [T,N] passes). The one-hot
-        # contraction selects exactly one row, so it is exact, not a sum.
-        onehot_q = (task_queue[:, None] == jnp.arange(Q)[None, :]).astype(
-            jnp.float32
-        )                                                            # [T, Q]
-        # a queue index outside [0, Q) gathers an all-zero capacity row from
-        # the one-hot contraction; a near-zero request could still pass the
-        # epsilon compare against it — make such tasks categorically
-        # infeasible rather than relying on claimant_ok to exclude them
-        feas = static_ok & claimant_ok[:, None]
-        feas &= ((task_queue >= 0) & (task_queue < Q))[:, None]
-        for r in range(R):  # R is the small static resource dim
-            # HIGHEST precision: TPU default matmul truncates the f32
-            # capacity operand to bf16 (~2^-8 relative), which at byte-unit
-            # memory magnitudes (~1e11) dwarfs the 10 MiB quantum the
-            # epsilon compare below relies on — exact f32 keeps the one-hot
-            # contraction a true row selection
-            # kbt: allow[KBT005] trace-time unroll over the small static
-            # resource dim R inside jit — R fused matmuls in the compiled
-            # graph, zero per-iteration host dispatch
-            cap_tr = jnp.matmul(
-                onehot_q, cap[:, :, r], precision=jax.lax.Precision.HIGHEST
-            )                                                        # [T, N]
-            feas &= snap.task_req[:, r, None] <= cap_tr + snap.quanta[r]
-        masked = jnp.where(feas, score, NEG)
-        # tie-hash spread: without it every equal-score claimant bids the
-        # same argmax node and only one claim lands per round
-        best, has = _best_node(masked, tie_hash)
+        # ---- victim-capacity bids ([T, N]-scale, path-specific head) -
+        best, has = bids_fn(victim_ok, claimant_ok)
         has &= claimant_ok
 
         # ---- one winner per node: lowest claimant rank ---------------
@@ -415,3 +456,11 @@ def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
     return EvictResult(
         claim_node=claim_node, evicted=evicted, victim_claimant=victim_claimant
     )
+
+
+@partial(jax.jit, static_argnames=("config",))
+def evict_solve(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
+    fia = None
+    if config.idle_gate and config.mode != "preempt":
+        fia = local_idle_fit_any(snap)
+    return evict_rounds(snap, config, local_evict_bids(snap, config), fia)

@@ -50,7 +50,8 @@ NODE_TILE = 512
 
 
 def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
-            quanta_ref, best_ref, val_ref, hash_ref, chose_idle_ref):
+            quanta_ref, offs_ref, best_ref, val_ref, hash_ref,
+            chose_idle_ref):
     TM = score_ref.shape[0]
     TN = score_ref.shape[1]
     R = req_ref.shape[1]
@@ -76,14 +77,21 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
 
     # two-key argmax within this node tile: exact max score, then the
     # per-(task, node) hash among ties (ops/assignment._tie_break_hash —
-    # same constants, same int32 wrapping arithmetic)
+    # same constants, same int32 wrapping arithmetic).  offs_ref carries
+    # the (task, node) GLOBAL offsets of this invocation's matrix block —
+    # zero on the single-program path; the shard_map round head passes its
+    # shard's origin so the hash (and therefore every tie-break) matches
+    # the full-matrix program bit-for-bit
     from kube_batch_tpu.ops.assignment import _H1, _H2, _H3
 
     ti = (
         jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 0)
-        + pl.program_id(0) * TM
+        + pl.program_id(0) * TM + offs_ref[0, 0]
     )
-    ni = jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1) + j * TN
+    ni = (
+        jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1)
+        + j * TN + offs_ref[0, 1]
+    )
     h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
     h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
     # Mosaic's argmax lowering is f32-only; the 16 hash bits are exactly
@@ -128,7 +136,7 @@ def _kernel(score_ref, static_ref, req_ref, idle_ref, rel_ref, pending_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def masked_best_node(
+def masked_best_node_raw(
     score: jnp.ndarray,       # [T, N] f32
     static_ok: jnp.ndarray,   # [T, N] bool
     task_req: jnp.ndarray,    # [T, R] f32 — InitResreq
@@ -136,19 +144,27 @@ def masked_best_node(
     releasing: jnp.ndarray,   # [N, R] f32
     pending: jnp.ndarray,     # [T] bool
     quanta: jnp.ndarray,      # [R] f32
+    t0=0,                     # global task offset of this block (i32)
+    n0=0,                     # global node offset of this block (i32)
     interpret: bool = False,
 ):
-    """(best [T] i32, has [T] bool, chose_idle [T] bool) — the fused round
-    head. T must be a multiple of the task tile and N of the node tile
-    (snapshot buckets guarantee both at scale; callers pad otherwise)."""
+    """(best [T] i32, val [T] f32, hash [T] f32, chose_idle [T] bool) — the
+    fused round head with the winner's (score, tie-hash) key exposed.  The
+    shard_map head needs the raw key to run the cross-shard two-key argmax
+    reduction; ``t0``/``n0`` are the block's global matrix origin (the
+    tie-hash is a function of GLOBAL coordinates).  T must be a multiple of
+    the task tile and N of the node tile (snapshot buckets guarantee both
+    at scale; callers pad otherwise).  ``best`` stays block-local (callers
+    add their node offset)."""
     T, N = score.shape
     R = task_req.shape[1]
     tile_t = min(TASK_TILE, T)
     tile_n = min(NODE_TILE, N)
     grid = (T // tile_t, N // tile_n)
     q2 = quanta.reshape(1, R).astype(jnp.float32)
+    offs = jnp.asarray([t0, n0], jnp.int32).reshape(1, 2)
 
-    best, val, _, chose = pl.pallas_call(
+    best, val, hsh, chose = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
@@ -159,6 +175,7 @@ def masked_best_node(
             pl.BlockSpec((tile_n, R), lambda i, j: (j, 0)),       # releasing
             pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # pending
             pl.BlockSpec((1, R), lambda i, j: (0, 0)),            # quanta
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),            # offsets
         ],
         out_specs=[
             pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # best
@@ -181,5 +198,27 @@ def masked_best_node(
         releasing.astype(jnp.float32),
         pending.astype(jnp.float32)[:, None],
         q2,
+        offs,
     )
-    return best[:, 0], val[:, 0] > NEG, chose[:, 0] > 0.0
+    return best[:, 0], val[:, 0], hsh[:, 0], chose[:, 0] > 0.0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_best_node(
+    score: jnp.ndarray,       # [T, N] f32
+    static_ok: jnp.ndarray,   # [T, N] bool
+    task_req: jnp.ndarray,    # [T, R] f32 — InitResreq
+    idle: jnp.ndarray,        # [N, R] f32
+    releasing: jnp.ndarray,   # [N, R] f32
+    pending: jnp.ndarray,     # [T] bool
+    quanta: jnp.ndarray,      # [R] f32
+    interpret: bool = False,
+):
+    """(best [T] i32, has [T] bool, chose_idle [T] bool) — the fused round
+    head. T must be a multiple of the task tile and N of the node tile
+    (snapshot buckets guarantee both at scale; callers pad otherwise)."""
+    best, val, _, chose = masked_best_node_raw(
+        score, static_ok, task_req, idle, releasing, pending, quanta,
+        interpret=interpret,
+    )
+    return best, val > NEG, chose

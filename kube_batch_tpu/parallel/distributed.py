@@ -61,6 +61,7 @@ def initialize(
             return  # already initialized
     elif _initialized:
         return  # module-level fallback guard (no is_initialized probe)
+    _enable_cpu_collectives()
     if coordinator is None and num_processes is None:
         try:
             jax.distributed.initialize()
@@ -74,6 +75,25 @@ def initialize(
         process_id=process_id,
     )
     _initialized = True
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo cross-process collectives implementation for
+    multi-process CPU backends.  The pjit path's GSPMD programs happened
+    to tolerate the default ("none") in the two-process smoke, but the
+    shard_map bodies' explicit psum/pmax/pmin/all_gather dispatch fails
+    there with "Multiprocess computations aren't implemented on the CPU
+    backend" unless a real collectives impl is registered.  Must run
+    BEFORE the CPU client is created; harmless on TPU/GPU backends (the
+    flag only affects make_cpu_client) and silently skipped on jaxlib
+    builds without gloo."""
+    try:
+        from jax._src.lib import xla_client
+
+        if hasattr(xla_client._xla, "make_gloo_tcp_collectives"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — best-effort, version-dependent
+        pass
 
 
 def global_mesh():

@@ -11,10 +11,19 @@ pairs, and the post-conflict budget updates stay node-local — the only
 cross-chip traffic per round is O(T) "who won", never O(T × N) — riding ICI,
 with DCN reserved for host↔cluster-API traffic.
 
-This module expresses shardings declaratively via NamedSharding on the
-snapshot pytree and jit's in_shardings/out_shardings; no manual collectives —
-compiler-inserted, profile-guided (the scaling-book recipe: pick a mesh,
-annotate, let XLA insert collectives)."""
+Two implementations share the mesh and the snapshot shardings:
+
+- **shard_map (default)** — parallel/shard_solve.py: the solves run as
+  ``shard_map`` bodies with AUTHORED collectives; per-round cross-host
+  traffic is the explicit O(tasks) pmax/pmin/psum reductions of the
+  winner vectors, auditable via ``collective_stats``.
+- **pjit (KB_SHARD_MAP=0)** — the original declarative path: NamedSharding
+  on the snapshot pytree and jit's in_shardings/out_shardings, collectives
+  compiler-inserted by GSPMD.  Kept as the bit-exactness oracle.
+
+A second mesh dim shards the TASK axis too (KB_TASK_SHARDS=k or
+``make_mesh(task_shards=k)``) for when node-axis sharding alone no longer
+fits the [T, N] round intermediates in HBM (shard_map path only)."""
 
 from __future__ import annotations
 
@@ -29,23 +38,66 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kube_batch_tpu.api.snapshot import DeviceSnapshot
 from kube_batch_tpu.ops.assignment import AllocateConfig, AllocateResult, allocate_solve
 from kube_batch_tpu.ops.eviction import EvictConfig, EvictResult, evict_solve
+from kube_batch_tpu.utils import jitstats
 
 NODE_AXIS = "nodes"
+TASK_AXIS = "tasks"
 
 # below this padded node-axis size a single chip wins: the per-round
 # cross-chip argmax reduction costs more than the sharded [T, N] work saves
 SHARD_MIN_NODES = 256
 
-_default_mesh = None
+_default_mesh: dict = {}
+_bad_task_shards: set = set()  # warn once per bad KB_TASK_SHARDS value
+
+
+def _env_off(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in (
+        "0", "false", "off", "no"
+    )
+
+
+def shard_map_enabled() -> bool:
+    """KB_SHARD_MAP=0 selects the pjit oracle path; default is the
+    explicit-collective shard_map path."""
+    return not _env_off("KB_SHARD_MAP")
+
+
+def task_shards() -> int:
+    """KB_TASK_SHARDS=k splits the mesh into a (tasks=k, nodes=d/k) grid —
+    the HBM escape hatch for cycles whose [T, N] round intermediates no
+    longer fit when only the node axis shards.  Default 1 (node-only)."""
+    try:
+        return max(1, int(os.environ.get("KB_TASK_SHARDS", "1")))
+    except ValueError:
+        return 1
 
 
 def default_mesh() -> Optional[Mesh]:
     """The production mesh over every visible device — None on single-chip
-    parts.  Cached: the device list is fixed for the process lifetime."""
-    global _default_mesh
-    if _default_mesh is None:
-        _default_mesh = make_mesh() if len(jax.devices()) > 1 else False
-    return _default_mesh or None
+    parts.  Cached per task-shard count: the device list is fixed for the
+    process lifetime, but KB_TASK_SHARDS may select a different grid.  A
+    KB_TASK_SHARDS that does not divide the device count falls back to the
+    1-D node mesh WITH a warning — it must degrade the grid, never
+    silently disable sharding wholesale."""
+    ts = task_shards()
+    n_dev = len(jax.devices())
+    if n_dev <= 1:
+        return None
+    if ts > 1 and n_dev % ts:
+        if ts not in _bad_task_shards:
+            _bad_task_shards.add(ts)
+            import logging
+
+            logging.getLogger("kube_batch_tpu").warning(
+                "KB_TASK_SHARDS=%d does not divide the %d-device count; "
+                "falling back to the 1-D node mesh", ts, n_dev,
+            )
+        ts = 1
+    mesh = _default_mesh.get(ts)
+    if mesh is None:
+        mesh = _default_mesh[ts] = make_mesh(task_shards=ts)
+    return mesh
 
 
 def should_shard(n_nodes_padded: int) -> bool:
@@ -54,19 +106,24 @@ def should_shard(n_nodes_padded: int) -> bool:
     16-worker fan-out is always on, scheduler_helper.go:34-64; here the
     analog turns on with the hardware).  KB_SHARD=0 forces the single-chip
     path (the sharded-vs-single equivalence tests' knob)."""
-    if os.environ.get("KB_SHARD", "").strip().lower() in (
-        "0", "false", "off", "no"
-    ):
+    if _env_off("KB_SHARD"):
         return False
     return n_nodes_padded >= SHARD_MIN_NODES and default_mesh() is not None
 
 
-def make_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """1-D mesh over the node axis. Multi-host: pass the global device list
-    order; ICI rings form along the axis automatically."""
+def make_mesh(n_devices: Optional[int] = None, task_shards: int = 1) -> Mesh:
+    """Mesh over the node axis — 1-D by default; ``task_shards`` > 1 folds
+    the device list into a (tasks, nodes) grid whose node axis carries the
+    ICI-contiguous fast dim.  Multi-host: pass the global device list
+    order; ICI rings form along the axes automatically."""
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
+    if task_shards > 1:
+        arr = np.asarray(devices).reshape(
+            task_shards, len(devices) // task_shards
+        )
+        return Mesh(arr, (TASK_AXIS, NODE_AXIS))
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
@@ -126,36 +183,53 @@ def snapshot_shardings(mesh: Mesh) -> DeviceSnapshot:
     )
 
 
-# jitted solve per (mesh, config) — a fresh jax.jit wrapper per call would
-# retrace and recompile the whole solve every scheduling cycle
+# jitted solve per (mesh, config, impl) — a fresh jax.jit wrapper per call
+# would retrace and recompile the whole solve every scheduling cycle
 _jit_cache: dict = {}
 
 
-def allocate_solve_fn(mesh: Mesh, config: AllocateConfig):
-    """The memoized jitted allocate solve for (mesh, config) — the dispatch
-    below calls it; the jaxpr audit (analysis/jaxpr_audit.py) traces it
-    abstractly so KBT101-104 cover the sharded variant in tier-1."""
-    key = (mesh, config)
+def _impl(impl: Optional[str]) -> str:
+    """Resolve the sharded-solve implementation: explicit override, else
+    the KB_SHARD_MAP knob (shard_map by default, pjit as the oracle)."""
+    if impl is not None:
+        return impl
+    return "shard_map" if shard_map_enabled() else "pjit"
+
+
+def allocate_solve_fn(mesh: Mesh, config: AllocateConfig,
+                      impl: Optional[str] = None):
+    """The memoized jitted allocate solve for (mesh, config, impl) — the
+    dispatch below calls it; the jaxpr audit (analysis/jaxpr_audit.py)
+    traces BOTH impls abstractly so KBT101-104 cover the sharded variants
+    in tier-1."""
+    impl = _impl(impl)
+    key = (mesh, config, impl)
     fn = _jit_cache.get(key)
     if fn is None:
-        in_shardings = snapshot_shardings(mesh)
-        node2 = NamedSharding(mesh, P(NODE_AXIS, None))
-        repl = NamedSharding(mesh, P())
-        out_shardings = AllocateResult(
-            assigned=repl,
-            pipelined=repl,
-            committed=repl,
-            node_idle=node2,
-            node_releasing=node2,
-            node_used=node2,
-            deserved=repl,
-            rounds_run=repl,
-        )
-        fn = jax.jit(
-            partial(_solve, config=config),
-            in_shardings=(in_shardings,),
-            out_shardings=out_shardings,
-        )
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.allocate_shard_map(mesh, config)
+        else:
+            in_shardings = snapshot_shardings(mesh)
+            node2 = NamedSharding(mesh, P(NODE_AXIS, None))
+            repl = NamedSharding(mesh, P())
+            out_shardings = AllocateResult(
+                assigned=repl,
+                pipelined=repl,
+                committed=repl,
+                node_idle=node2,
+                node_releasing=node2,
+                node_used=node2,
+                deserved=repl,
+                rounds_run=repl,
+            )
+            fn = jax.jit(
+                partial(_solve, config=config),
+                in_shardings=(in_shardings,),
+                out_shardings=out_shardings,
+            )
+        jitstats.register(f"sharded_allocate_solve[{impl}]", fn)
         _jit_cache[key] = fn
     return fn
 
@@ -174,48 +248,64 @@ def _solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
     return allocate_solve(snap, config)
 
 
-def failure_histogram_fn(mesh: Mesh):
+def failure_histogram_fn(mesh: Mesh, impl: Optional[str] = None):
     """Memoized jitted sharded fit-error histogram for `mesh` (dispatch +
     jaxpr-audit entry point)."""
     from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
-    key = (mesh, "fail_hist")
+    impl = _impl(impl)
+    key = (mesh, "fail_hist", impl)
     fn = _jit_cache.get(key)
     if fn is None:
-        fn = jax.jit(
-            failure_histogram_solve.__wrapped__,
-            in_shardings=(snapshot_shardings(mesh),),
-            out_shardings=NamedSharding(mesh, P()),
-        )
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.failure_histogram_shard_map(mesh)
+        else:
+            fn = jax.jit(
+                failure_histogram_solve.__wrapped__,
+                in_shardings=(snapshot_shardings(mesh),),
+                out_shardings=NamedSharding(mesh, P()),
+            )
+        jitstats.register(f"sharded_failure_histogram[{impl}]", fn)
         _jit_cache[key] = fn
     return fn
 
 
 def sharded_failure_histogram(snap: DeviceSnapshot, mesh: Mesh):
     """The lazy fit-error histogram over the mesh: [T, N]-scale predicate
-    masks shard along the node axis, the per-reason node counts all-reduce
-    into the replicated [T, N_REASONS] result."""
+    masks shard along the node axis, the per-reason node counts reduce
+    (an explicit psum on the shard_map path) into the replicated
+    [T, N_REASONS] result."""
     fn = failure_histogram_fn(mesh)
     with mesh:
         return fn(snap)
 
 
-def evict_solve_fn(mesh: Mesh, config: EvictConfig):
-    """Memoized jitted sharded eviction solve for (mesh, config) (dispatch
-    + jaxpr-audit entry point)."""
-    key = (mesh, config, "evict")
+def evict_solve_fn(mesh: Mesh, config: EvictConfig,
+                   impl: Optional[str] = None):
+    """Memoized jitted sharded eviction solve for (mesh, config, impl)
+    (dispatch + jaxpr-audit entry point)."""
+    impl = _impl(impl)
+    key = (mesh, config, "evict", impl)
     fn = _jit_cache.get(key)
     if fn is None:
-        in_shardings = snapshot_shardings(mesh)
-        repl = NamedSharding(mesh, P())
-        out_shardings = EvictResult(
-            claim_node=repl, evicted=repl, victim_claimant=repl
-        )
-        fn = jax.jit(
-            partial(_evict, config=config),
-            in_shardings=(in_shardings,),
-            out_shardings=out_shardings,
-        )
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.evict_shard_map(mesh, config)
+        else:
+            in_shardings = snapshot_shardings(mesh)
+            repl = NamedSharding(mesh, P())
+            out_shardings = EvictResult(
+                claim_node=repl, evicted=repl, victim_claimant=repl
+            )
+            fn = jax.jit(
+                partial(_evict, config=config),
+                in_shardings=(in_shardings,),
+                out_shardings=out_shardings,
+            )
+        jitstats.register(f"sharded_evict_solve[{config.mode},{impl}]", fn)
         _jit_cache[key] = fn
     return fn
 
@@ -233,3 +323,55 @@ def sharded_evict_solve(
 
 def _evict(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
     return evict_solve(snap, config)
+
+
+def enqueue_gate_solve_fn(mesh: Mesh):
+    """Memoized mesh-replicated enqueue admission scan (the shard_map
+    wrapper around ops.admission.gate_scan — zero cross-shard bytes; see
+    shard_solve.enqueue_gate_shard_map for why it exists)."""
+    key = (mesh, "enqueue_gate")
+    fn = _jit_cache.get(key)
+    if fn is None:
+        from kube_batch_tpu.parallel import shard_solve
+
+        fn = shard_solve.enqueue_gate_shard_map(mesh)
+        jitstats.register("sharded_enqueue_gate", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def dispatch_enqueue_gate(min_res, cand, idle0, quanta, n_nodes_padded: int):
+    """The enqueue action's gate dispatch: ride the mesh (replicated
+    shard_map) when the cycle's solves shard and the shard_map path is on,
+    else the single-device jitted scan.  Verdicts are bit-equal either way
+    (both trace ops.admission.gate_scan)."""
+    if should_shard(n_nodes_padded) and shard_map_enabled():
+        mesh = default_mesh()
+        with mesh:
+            return enqueue_gate_solve_fn(mesh)(min_res, cand, idle0, quanta)
+    from kube_batch_tpu.ops.admission import enqueue_gate_solve
+
+    return enqueue_gate_solve(min_res, cand, idle0, quanta)
+
+
+def collective_stats(mesh: Mesh, config: Optional[AllocateConfig] = None,
+                     snap=None) -> dict:
+    """Traced collective inventory of the shard_map allocate solve on
+    `mesh` — the per-round / per-solve cross-shard byte accounting
+    (utils/jitstats.collective_inventory) of the program XLA actually
+    compiles, at the abstract shapes of ``snap`` (defaults to the audit's
+    small shapes).  The bench and the sim report this next to the measured
+    round counts, so the O(tasks) comms claim is checked against the real
+    traced program, not asserted in a comment."""
+    if snap is None:
+        from kube_batch_tpu.analysis.jaxpr_audit import abstract_snapshot
+
+        snap = abstract_snapshot()
+    fn = allocate_solve_fn(mesh, config or AllocateConfig(),
+                           impl="shard_map")
+    traced = fn.trace(snap)
+    stats = jitstats.collective_inventory(traced.jaxpr)
+    stats["mesh"] = {k: int(v) for k, v in dict(mesh.shape).items()}
+    stats["task_bucket"] = int(snap.task_req.shape[0])
+    stats["node_bucket"] = int(snap.node_idle.shape[0])
+    return stats

@@ -1,0 +1,439 @@
+"""Explicit-collective shard_map solve bodies over the device mesh.
+
+The pjit path (parallel/mesh.py) shards the node axis declaratively and
+lets XLA's SPMD partitioner insert collectives — correct, but the
+cross-host traffic is whatever GSPMD decides, and nothing bounds it as the
+mesh grows to multi-host ICI+DCN.  This module rewrites the sharded solves
+as ``shard_map`` bodies in which every cross-shard byte is AUTHORED:
+
+- each shard computes its local block of the [T, N]-scale round head
+  (feasibility, score, masked two-key argmax) over its node shard (and,
+  on a 2-D ``(tasks, nodes)`` mesh, its task block);
+- per round the shards reduce the TASK-SIZED winner vectors with explicit
+  ``pmax``/``pmin``/``psum`` collectives (the two-key argmax decomposes
+  into three O(T) reductions) — only O(tasks) crosses hosts per round,
+  never O(tasks × nodes) or O(nodes);
+- the node ledgers are all-gathered ONCE per solve (O(N·R) per cycle, not
+  per round) so the conflict-resolution / gang-commit tail runs as
+  replicated compute — literally the same :func:`ops.assignment.
+  allocate_rounds` / :func:`ops.eviction.evict_rounds` machinery the
+  single-device solve runs, which is what makes the shard_map path
+  bit-exact against the pjit path by construction.
+
+Collective inventory per allocate round (see utils/jitstats.
+collective_inventory, which derives this from the traced program rather
+than trusting this comment):
+
+  pmax [T] f32   — global max score per task
+  pmax [T] i32   — max tie-hash among max-score shards
+  pmin [T] i32   — lowest global node index among (score, hash) ties
+  psum [T] i32   — the winning shard contributes chose_idle
+  (+ all_gather [T_blk] → [T] ×3 over the task axis when it is sharded)
+
+Task-axis sharding (the second mesh dim): the [T, N] intermediates are
+the HBM hogs at the 500k×50k north star (~2.5e10 elements); sharding the
+task axis too divides them by the task-shard count.  The body slices its
+task block out of the replicated task columns (no extra inputs), computes
+[T_blk, N_loc] matrices, and reassembles the O(T) winner vectors with one
+tiled ``all_gather`` per round over the task axis.  The replicated tail
+is unchanged — its arrays are O(T) and O(N), never O(T × N).
+
+Exactness notes (why bit-equal, not just equivalent):
+- every [T_blk, N_loc] matrix element is computed by the same scalar
+  expression as the corresponding element of the full matrix (the block
+  view slices inputs; the tie-hash takes global offsets);
+- the two-key argmax decomposition (max value → max hash among value
+  ties → min global index among (value, hash) ties) reproduces
+  ``jnp.argmax``'s first-max-index semantics exactly — integer and exact
+  f32 comparisons only, no arithmetic on the reduced values;
+- per-node accumulations (victim capacity) sum the same values in the
+  same task order per node as the global program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kube_batch_tpu.ops import assignment as asg
+from kube_batch_tpu.ops import eviction as evi
+from kube_batch_tpu.ops.admission import gate_scan
+from kube_batch_tpu.ops.feasibility import (
+    FeasibilityMasks,
+    failure_histogram,
+    fits,
+    static_predicates,
+)
+from kube_batch_tpu.ops.scoring import score_matrix
+
+NEG = asg.NEG
+BIG = jnp.int32(1 << 30)
+
+# axis names live in parallel.mesh (shard_solve is imported lazily from
+# there, so this import is acyclic at module load)
+from kube_batch_tpu.parallel.mesh import NODE_AXIS, TASK_AXIS  # noqa: E402
+
+
+def _axis_sizes(mesh):
+    shape = dict(mesh.shape)
+    return shape.get(TASK_AXIS, 1), shape[NODE_AXIS]
+
+
+def _gather_tasks(x, task_shards):
+    """Reassemble a [T_blk, ...] per-task-shard vector into the full [T]
+    vector (tiled all_gather over the task axis; identity when the task
+    axis is unsharded)."""
+    if task_shards == 1:
+        return x
+    return jax.lax.all_gather(x, TASK_AXIS, axis=0, tiled=True)
+
+
+def _gather_nodes(x, node_shards):
+    """One-per-solve reassembly of a node-sharded [N_loc, ...] column into
+    the replicated global [N, ...] array the solve tail consumes."""
+    if node_shards == 1:
+        return x
+    return jax.lax.all_gather(x, NODE_AXIS, axis=0, tiled=True)
+
+
+def _block_view(snap, t0, T_blk, task_shards):
+    """``snap`` restricted to this shard's task block.  Node-axis arrays
+    arrive shard-local under shard_map and pass through; task-axis arrays
+    are sliced to [t0, t0+T_blk); the sparse affinity/preference row
+    indices are remapped into block coordinates (out-of-block rows park at
+    -1, which their consumers treat as padding).  Per-element math over
+    the view equals the same elements of the global matrices — the
+    bit-exactness contract of the SPMD round head."""
+    if task_shards == 1:
+        return snap
+    ts = partial(jax.lax.dynamic_slice_in_dim, start_index=t0,
+                 slice_size=T_blk, axis=0)
+    aff = snap.task_aff_idx
+    aff_l = jnp.where((aff >= t0) & (aff < t0 + T_blk), aff - t0, -1)
+    pref = snap.task_pref_idx
+    pref_l = jnp.where((pref >= t0) & (pref < t0 + T_blk), pref - t0, -1)
+    return snap._replace(
+        task_req=ts(snap.task_req),
+        task_resreq=ts(snap.task_resreq),
+        task_job=ts(snap.task_job),
+        task_prio=ts(snap.task_prio),
+        task_creation=ts(snap.task_creation),
+        task_status=ts(snap.task_status),
+        task_valid=ts(snap.task_valid),
+        task_pending=ts(snap.task_pending),
+        task_best_effort=ts(snap.task_best_effort),
+        task_sel_bits=ts(snap.task_sel_bits),
+        task_sel_impossible=ts(snap.task_sel_impossible),
+        task_tol_bits=ts(snap.task_tol_bits),
+        task_node=ts(snap.task_node),
+        task_critical=ts(snap.task_critical),
+        task_needs_host=ts(snap.task_needs_host),
+        task_aff_idx=aff_l,
+        task_pref_idx=pref_l,
+    )
+
+
+def _local_best(masked, tie_blk, n0):
+    """Per-shard two-key winner triple: (lval, lkey, lidx_global) with the
+    EXACT semantics of ops.assignment._best_node restricted to this block
+    — max score, then max tie-hash among score ties, first index among
+    (score, hash) ties (jnp.argmax first-max semantics)."""
+    lval = jnp.max(masked, axis=1)
+    cand = jnp.where(masked >= lval[:, None], tie_blk, -1)
+    pick = jnp.argmax(cand, axis=1).astype(jnp.int32)
+    lkey = jnp.max(cand, axis=1)
+    return lval, lkey, pick, pick + n0
+
+
+def _combine_best(lval, lkey, lidx, lextra=None):
+    """The cross-shard two-key argmax: three explicit O(T) collectives over
+    the node axis (pmax value, pmax key among value ties, pmin global index
+    among (value, key) ties) — equivalent to running jnp.argmax over the
+    concatenated node axis.  ``lextra`` optionally rides with the unique
+    winner via a one-hot psum (a fourth O(T) collective)."""
+    vmax = jax.lax.pmax(lval, NODE_AXIS)
+    eq = lval == vmax
+    kmax = jax.lax.pmax(
+        jnp.where(eq, lkey, jnp.asarray(-1, lkey.dtype)), NODE_AXIS
+    )
+    eqk = eq & (lkey == kmax)
+    imin = jax.lax.pmin(jnp.where(eqk, lidx, BIG), NODE_AXIS)
+    if lextra is None:
+        return vmax, imin
+    mine = eqk & (lidx == imin)
+    extra = jax.lax.psum(jnp.where(mine, lextra, 0), NODE_AXIS)
+    return vmax, imin, extra
+
+
+# --------------------------------------------------------------------------
+# allocate
+# --------------------------------------------------------------------------
+
+
+def _allocate_body(snap, *, config, node_shards, task_shards):
+    N_loc = snap.node_idle.shape[0]
+    T = snap.task_req.shape[0]
+    T_blk = T // task_shards
+    n0 = jax.lax.axis_index(NODE_AXIS) * N_loc
+    t0 = (
+        jax.lax.axis_index(TASK_AXIS) * T_blk if task_shards > 1
+        else 0
+    )
+    view = _block_view(snap, t0, T_blk, task_shards)
+    # the loop-invariant [T_blk, N_loc] blocks, computed once per solve
+    static_ok = static_predicates(view)
+    score = score_matrix(view, config.weights)
+    score_static = jnp.where(static_ok, score, NEG)
+    tie_blk = asg._tie_break_hash(T_blk, N_loc, t0=t0, n0=n0)
+    req_blk = view.task_req
+    quanta = snap.quanta
+
+    def head(idle_g, releasing_g, pending):
+        idle_b = jax.lax.dynamic_slice_in_dim(idle_g, n0, N_loc, axis=0)
+        rel_b = jax.lax.dynamic_slice_in_dim(releasing_g, n0, N_loc, axis=0)
+        pending_b = (
+            pending if task_shards == 1
+            else jax.lax.dynamic_slice_in_dim(pending, t0, T_blk, axis=0)
+        )
+        if config.use_pallas:
+            from kube_batch_tpu.ops.pallas_kernels import masked_best_node_raw
+
+            pick, lval, lkey, lchose = masked_best_node_raw(
+                score, static_ok, req_blk, idle_b, rel_b, pending_b,
+                quanta, t0=t0, n0=n0,
+                interpret=jax.default_backend() != "tpu",
+            )
+            lidx = pick + n0
+        else:
+            fit_idle = fits(req_blk, idle_b, quanta)
+            # per-shard zero-releasing skip: exact for solver outputs (see
+            # local_round_head), and finer-grained than the global test —
+            # a shard with no releasing budget skips its block fit alone
+            fit_rel = jax.lax.cond(
+                jnp.any(rel_b > 0.0),
+                lambda rel: fits(req_blk, rel, quanta),
+                lambda rel: jnp.zeros_like(fit_idle),
+                rel_b,
+            )
+            masked = jnp.where(
+                (fit_idle | fit_rel) & pending_b[:, None], score_static, NEG
+            )
+            lval, lkey, pick, lidx = _local_best(masked, tie_blk, n0)
+            lchose = jnp.take_along_axis(fit_idle, pick[:, None], axis=1)[:, 0]
+        vmax, best_b, chose_b = _combine_best(
+            lval, lkey, lidx, lchose.astype(jnp.int32)
+        )
+        best = _gather_tasks(best_b, task_shards)
+        has = _gather_tasks(vmax > NEG, task_shards)
+        chose = _gather_tasks(chose_b > 0, task_shards)
+        return best, has, chose
+
+    # the conflict/gang tail runs replicated on the explicitly gathered
+    # ledgers — one O(N·R) all_gather per solve, zero per-round node bytes
+    idle0 = _gather_nodes(snap.node_idle, node_shards)
+    rel0 = _gather_nodes(snap.node_releasing, node_shards)
+    used0 = _gather_nodes(snap.node_used, node_shards)
+    res = asg.allocate_rounds(snap, config, head, idle0, rel0, used0)
+    # emit the node ledgers as this shard's local blocks (out_specs
+    # reassemble the node-sharded placement the pjit path produces)
+    sl = partial(jax.lax.dynamic_slice_in_dim, start_index=n0,
+                 slice_size=N_loc, axis=0)
+    return res._replace(
+        node_idle=sl(res.node_idle),
+        node_releasing=sl(res.node_releasing),
+        node_used=sl(res.node_used),
+    )
+
+
+# --------------------------------------------------------------------------
+# evict (reclaim / preempt)
+# --------------------------------------------------------------------------
+
+
+def _evict_body(snap, *, config, node_shards, task_shards):
+    N_loc = snap.node_alloc.shape[0]
+    N = N_loc * node_shards
+    T = snap.task_req.shape[0]
+    T_blk = T // task_shards
+    R = snap.task_req.shape[1]
+    Q = snap.queue_weight.shape[0]
+    preempt = config.mode == "preempt"
+    n0 = jax.lax.axis_index(NODE_AXIS) * N_loc
+    t0 = (
+        jax.lax.axis_index(TASK_AXIS) * T_blk if task_shards > 1
+        else 0
+    )
+    view = _block_view(snap, t0, T_blk, task_shards)
+    static_ok = static_predicates(view)
+    score = score_matrix(view, config.weights)
+    tie_blk = asg._tie_break_hash(T_blk, N_loc, t0=t0, n0=n0)
+    task_queue = snap.job_queue[snap.task_job]          # [T] replicated
+    tq_blk = view.job_queue[view.task_job]              # [T_blk]
+
+    def tslice(x):
+        if task_shards == 1:
+            return x
+        return jax.lax.dynamic_slice_in_dim(x, t0, T_blk, axis=0)
+
+    def bids(victim_ok, claimant_ok):
+        # ---- per-(queue, local-node) evictable capacity --------------
+        # built from the REPLICATED task vectors, restricted to victims
+        # resident on this shard's nodes: same values in the same task
+        # order per (queue, node) cell as the global scatter
+        vreq = jnp.where(victim_ok[:, None], snap.task_resreq, 0.0)
+        vnode_l = snap.task_node - n0
+        in_shard = (vnode_l >= 0) & (vnode_l < N_loc)
+        vreq_l = jnp.where(in_shard[:, None], vreq, 0.0)
+        tot_v = jax.ops.segment_sum(
+            vreq_l,
+            jnp.where(victim_ok & in_shard, vnode_l, N_loc),
+            num_segments=N_loc + 1,
+        )[:N_loc]                                        # [N_loc, R]
+        per_qn = jnp.zeros((Q, N_loc, R), jnp.float32).at[
+            task_queue, jnp.clip(vnode_l, 0, N_loc - 1)
+        ].add(vreq_l)
+        if preempt:
+            cap = per_qn                  # same-queue victims
+        else:
+            cap = tot_v[None] - per_qn    # cross-queue victims
+
+        # ---- block bids (one-hot queue gather, exact f32 matmul) -----
+        co_b = tslice(claimant_ok)
+        onehot_q = (tq_blk[:, None] == jnp.arange(Q)[None, :]).astype(
+            jnp.float32
+        )
+        feas = static_ok & co_b[:, None]
+        feas &= ((tq_blk >= 0) & (tq_blk < Q))[:, None]
+        for r in range(R):
+            # kbt: allow[KBT005] trace-time unroll over the small static
+            # resource dim R inside jit (same rationale as the single path)
+            cap_tr = jnp.matmul(
+                onehot_q, cap[:, :, r], precision=jax.lax.Precision.HIGHEST
+            )                                            # [T_blk, N_loc]
+            feas &= view.task_req[:, r, None] <= cap_tr + snap.quanta[r]
+        masked = jnp.where(feas, score, NEG)
+        lval, lkey, _pick, lidx = _local_best(masked, tie_blk, n0)
+        vmax, best_b = _combine_best(lval, lkey, lidx)
+        best = _gather_tasks(best_b, task_shards)
+        has = _gather_tasks(vmax > NEG, task_shards)
+        return best, has
+
+    fia = None
+    if config.idle_gate and not preempt:
+        any_l = jnp.any(
+            fits(view.task_req, snap.node_idle, snap.quanta) & static_ok,
+            axis=1,
+        )
+        any_g = jax.lax.psum(any_l.astype(jnp.int32), NODE_AXIS) > 0
+        fia = _gather_tasks(any_g, task_shards)
+    return evi.evict_rounds(snap, config, bids, fia, n_nodes=N)
+
+
+# --------------------------------------------------------------------------
+# fit-error histogram
+# --------------------------------------------------------------------------
+
+
+def _histogram_body(snap, *, node_shards, task_shards):
+    T = snap.task_req.shape[0]
+    T_blk = T // task_shards
+    t0 = (
+        jax.lax.axis_index(TASK_AXIS) * T_blk if task_shards > 1
+        else 0
+    )
+    view = _block_view(snap, t0, T_blk, task_shards)
+    static_ok = static_predicates(view)
+    fit_i = fits(view.task_req, snap.node_idle, snap.quanta)
+    fit_r = fits(view.task_req, snap.node_releasing, snap.quanta)
+    h = failure_histogram(
+        view,
+        FeasibilityMasks(static_ok, fit_i, fit_r,
+                         static_ok & (fit_i | fit_r)),
+    )
+    # every histogram column is an integer count over nodes — one exact
+    # O(T × N_REASONS) psum reduces the per-shard partial counts
+    h = jax.lax.psum(h, NODE_AXIS)
+    return _gather_tasks(h, task_shards)
+
+
+# --------------------------------------------------------------------------
+# builders — jitted shard_map wrappers (memoized by parallel.mesh)
+# --------------------------------------------------------------------------
+
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    from jax.experimental.shard_map import shard_map
+
+    try:
+        mapped = shard_map(body, mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
+    except TypeError:  # newer jax: check_rep renamed/removed
+        mapped = shard_map(body, mesh, in_specs=in_specs,
+                           out_specs=out_specs)
+    return jax.jit(mapped)
+
+
+def _snapshot_specs(mesh):
+    from kube_batch_tpu.parallel.mesh import snapshot_shardings
+
+    return jax.tree.map(lambda s: s.spec, snapshot_shardings(mesh))
+
+
+def allocate_shard_map(mesh, config):
+    """jitted shard_map allocate solve for (mesh, config) — node-axis
+    inputs consumed shard-local, task/job/queue inputs replicated, node
+    ledgers emitted node-sharded, task vectors replicated."""
+    from kube_batch_tpu.ops.assignment import AllocateResult
+
+    task_shards, node_shards = _axis_sizes(mesh)
+    node2 = P(NODE_AXIS, None)
+    out_specs = AllocateResult(
+        assigned=P(), pipelined=P(), committed=P(),
+        node_idle=node2, node_releasing=node2, node_used=node2,
+        deserved=P(), rounds_run=P(),
+    )
+    body = partial(_allocate_body, config=config,
+                   node_shards=node_shards, task_shards=task_shards)
+    return _shard_map(body, mesh, (_snapshot_specs(mesh),), out_specs)
+
+
+def evict_shard_map(mesh, config):
+    """jitted shard_map eviction solve — every EvictResult field is
+    task-axis, so all outputs replicate."""
+    from kube_batch_tpu.ops.eviction import EvictResult
+
+    task_shards, node_shards = _axis_sizes(mesh)
+    out_specs = EvictResult(
+        claim_node=P(), evicted=P(), victim_claimant=P()
+    )
+    body = partial(_evict_body, config=config,
+                   node_shards=node_shards, task_shards=task_shards)
+    return _shard_map(body, mesh, (_snapshot_specs(mesh),), out_specs)
+
+
+def failure_histogram_shard_map(mesh):
+    """jitted shard_map fit-error histogram: per-shard partial counts, one
+    psum over the node shards, replicated [T, N_REASONS] out."""
+    task_shards, node_shards = _axis_sizes(mesh)
+    body = partial(_histogram_body,
+                   node_shards=node_shards, task_shards=task_shards)
+    return _shard_map(body, mesh, (_snapshot_specs(mesh),), P())
+
+
+def enqueue_gate_shard_map(mesh):
+    """jitted mesh-replicated enqueue admission scan: the scan is
+    sequentially dependent (each admission shrinks the idle the next
+    candidate sees), so it cannot decompose across shards — instead every
+    device runs the identical ``gate_scan`` program on replicated inputs
+    and ZERO bytes cross shards.  The point on a multi-host mesh is
+    placement consistency: every process computes the same admitted mask
+    from the same replicated operands, so the multi-controller cycle never
+    diverges on admission."""
+    repl = P()
+    return _shard_map(
+        gate_scan, mesh,
+        (repl, repl, repl, repl), repl,
+    )

@@ -541,11 +541,41 @@ class SimRunner:
         from kube_batch_tpu.api.resident import scatter_summary
 
         scatter = scatter_summary(self.cache.columns.resident_counters())
+        # sharded runs carry the traced per-round collective-bytes
+        # inventory next to the scatter counters (the longitudinal twin of
+        # the bench's collectives section); single-part sims skip it
+        solve_collectives = None
+        if "sharded" in scatter:
+            try:
+                from kube_batch_tpu.analysis.jaxpr_audit import (
+                    abstract_snapshot,
+                )
+                from kube_batch_tpu.parallel.mesh import (
+                    collective_stats,
+                    default_mesh,
+                    shard_map_enabled,
+                )
+
+                mesh = default_mesh()
+                if mesh is not None and shard_map_enabled():
+                    cols = self.cache.columns
+                    solve_collectives = collective_stats(
+                        mesh,
+                        snap=abstract_snapshot(
+                            T=cols.tasks.cap, N=cols.nodes.cap,
+                            J=cols.jobs.cap, Q=cols.queues.cap,
+                            R=cols.R,
+                        ),
+                    )
+            except Exception:  # noqa: BLE001 — report must still land
+                solve_collectives = {"error": "collective trace failed"}
         report.update({
             "unit": "virtual_seconds",
             "seed": cfg.seed,
             "cycles_run": cycles_run,
             "resident_scatter": scatter,
+            **({"solve_collectives": solve_collectives}
+               if solve_collectives is not None else {}),
             # fault-hardening evidence: bind integrity (no lost/duplicate
             # binds), the egress breaker's life, the repair queue's story
             "bind_integrity": {

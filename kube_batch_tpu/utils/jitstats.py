@@ -47,3 +47,75 @@ def compile_counts() -> Dict[str, int]:
 
 def total_compiles() -> int:
     return sum(_size(fn) for _, fn in _TRACKED)
+
+
+# --------------------------------------------------------------------------
+# collective-bytes inventory (the shard_map comms counter)
+# --------------------------------------------------------------------------
+
+#: cross-device communication primitives as they appear in jaxprs
+COLLECTIVE_PRIMS = (
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "psum_scatter",
+)
+_LOOP_PRIMS = ("while", "scan")
+
+
+def _aval_bytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def collective_inventory(closed_jaxpr) -> Dict:
+    """Walk a traced program (a ClosedJaxpr, e.g. ``fn.trace(...).jaxpr``)
+    and account every collective primitive's result bytes, split into
+    per-ROUND (inside a while/scan body — paid every bidding round) and
+    per-SOLVE (outside the loops — e.g. the one-time node-ledger gather).
+
+    This is the evidence behind the "O(tasks) cross-host bytes per round"
+    claim: the numbers come from the program XLA compiles, so a regression
+    that smuggles an O(nodes) or O(tasks × nodes) collective into the
+    round loop shows up as a bytes jump, not a silent slowdown.  Bytes are
+    the collective RESULT sizes — a uniform proxy for payload (an
+    all-reduce moves ~result-size per hop; an all_gather's result already
+    includes the axis-size factor)."""
+    per: Dict[str, Dict[str, Dict[str, int]]] = {
+        "per_round": {}, "per_solve": {},
+    }
+
+    def walk(jaxpr, in_loop: bool) -> None:
+        for eqn in jaxpr.eqns:
+            prim = str(eqn.primitive)
+            if prim in COLLECTIVE_PRIMS:
+                bucket = per["per_round" if in_loop else "per_solve"]
+                rec = bucket.setdefault(prim, {"count": 0, "bytes": 0})
+                rec["count"] += 1
+                rec["bytes"] += sum(_aval_bytes(v) for v in eqn.outvars)
+            inner_loop = in_loop or prim in _LOOP_PRIMS
+            for param in eqn.params.values():
+                vals = param if isinstance(param, (list, tuple)) else [param]
+                for sub in vals:
+                    inner = getattr(sub, "jaxpr", None)
+                    if inner is not None and hasattr(inner, "eqns"):
+                        walk(inner, inner_loop)
+                    elif hasattr(sub, "eqns"):
+                        walk(sub, inner_loop)
+
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    walk(jaxpr, False)
+    return {
+        "per_round_bytes": sum(
+            r["bytes"] for r in per["per_round"].values()
+        ),
+        "per_solve_bytes": sum(
+            r["bytes"] for r in per["per_solve"].values()
+        ),
+        "ops": per,
+    }

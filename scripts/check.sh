@@ -10,8 +10,10 @@
 # hangs on) an accelerator tunnel — tracing is abstract, the backend only
 # matters for the donation table, and CPU is the declared-() baseline.
 # A forced host-platform device count gives the audit a virtual mesh so the
-# SHARDED solve variants trace too (KBT101-104 over the sharded path,
-# without a multi-device CI mesh); an explicit count in XLA_FLAGS wins.
+# SHARDED solve variants trace too — both the shard_map bodies (incl. the
+# 2-D tasks×nodes mesh variant and the mesh enqueue gate) and the pjit
+# oracle (KBT101-104 over every sharded path, without a multi-device CI
+# mesh); an explicit count in XLA_FLAGS wins.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then

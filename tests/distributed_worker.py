@@ -78,6 +78,40 @@ def main() -> None:
         gsnap = jax.tree.map(distribute, snap, shardings)
         result = sharded_allocate_solve(gsnap, config, mesh)
         dist = jax.device_get(result.assigned)  # replicated output
+
+        # BOTH sharded implementations, explicitly: the shard_map body's
+        # authored collectives must cross the real two-process boundary
+        # (ICI within a rank, DCN between) and still match the pjit oracle
+        # and the local solve bit-for-bit
+        from kube_batch_tpu.parallel.mesh import allocate_solve_fn
+
+        with mesh:
+            sm = jax.device_get(
+                allocate_solve_fn(mesh, config, impl="shard_map")(gsnap)
+                .assigned
+            )
+            pj = jax.device_get(
+                allocate_solve_fn(mesh, config, impl="pjit")(gsnap).assigned
+            )
+
+        # per-host sharded residency: each process diffs the full host
+        # column but SHIPS only its own shards' rows (the
+        # make_array_from_callback path) — the scatter-refreshed device
+        # columns must round-trip bit-exact on every host's local shards
+        from kube_batch_tpu.api.resident import ShardedPerCycleDeviceCache
+
+        rc = ShardedPerCycleDeviceCache(mesh)
+        with mesh:
+            rc.swap(snap)
+            host = np.asarray(snap.node_idle).copy()
+            host[5] += 1.0
+            host[257] += 2.0  # a row on the other process's shard
+            snap2 = snap._replace(node_idle=host)
+            sw2 = rc.swap(snap2)
+        resident_ok = rc.scatter_updates > 0
+        for s in sw2.node_idle.addressable_shards:
+            if not np.array_equal(np.asarray(s.data), host[s.index]):
+                resident_ok = False
     finally:
         close_session(ssn)
 
@@ -85,6 +119,15 @@ def main() -> None:
         diff = int((local != dist).sum())
         print(f"MISMATCH rank={rank} differing={diff}", flush=True)
         sys.exit(1)
+    if not (np.array_equal(local, sm) and np.array_equal(local, pj)):
+        print(f"IMPL MISMATCH rank={rank}"
+              f" shard_map={np.array_equal(local, sm)}"
+              f" pjit={np.array_equal(local, pj)}", flush=True)
+        sys.exit(1)
+    if not resident_ok:
+        print(f"RESIDENT MISMATCH rank={rank}", flush=True)
+        sys.exit(1)
+    print("RESIDENT OK", flush=True)
     placed = int((dist >= 0).sum())
     assert placed > 0
     print(f"MATCH placed={placed}", flush=True)

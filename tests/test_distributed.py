@@ -61,6 +61,8 @@ def test_two_process_global_mesh_solve_matches_single():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert "MATCH placed=" in out, f"rank {rank} output:\n{out[-4000:]}"
+        # the shard_map impl + per-host resident scatter round-trip ran too
+        assert "RESIDENT OK" in out, f"rank {rank} output:\n{out[-4000:]}"
 
 
 def test_initialize_reinit_guard_without_is_initialized(monkeypatch):
