@@ -485,6 +485,106 @@ def sharded_multicycle(conf, n_tasks, n_nodes, cycles=6):
     return rep
 
 
+def whatif_serving_bench(conf, n_tasks=20_000, n_nodes=2_000,
+                         n_clients=16, requests_per_client=25):
+    """The serve/ query-plane bench (ISSUE 8): N concurrent what-if
+    clients against a 20k×2k snapshot, driven straight at
+    ``QueryPlane.submit`` (the HTTP hop is constant per request and
+    covered by the check.sh smoke — this section measures the batcher +
+    probe dispatch).  Reports p50/p99 request latency, achieved QPS, mean
+    batch size, and dispatches per 100 requests; the amortization claim is
+    dispatch counter < requests (many requests per device dispatch) with
+    ZERO probe retraces after warmup across varying batch fill."""
+    import threading
+
+    import numpy as np
+
+    from kube_batch_tpu.serve.plane import QueryPlane
+    from kube_batch_tpu.utils import jitstats
+
+    cache = synthetic_cluster(
+        n_tasks=n_tasks, n_nodes=n_nodes, gang_size=4, n_queues=3
+    )
+    qp = QueryPlane(cache, max_batch=32, window_s=0.002, start_thread=True)
+    try:
+        one_cycle(conf, cache)  # the cycle publishes the snapshot lease
+        gib = float(2 ** 30)
+
+        def ask(count, cpu):
+            return {"queue": "q0", "count": count,
+                    "requests": {"cpu": cpu, "memory": gib}}
+
+        def probe_compiles():
+            # every probe path — single-device "probe_solve" AND the
+            # per-mesh "sharded_probe_solve[impl]" registrations — so the
+            # zero-retrace claim measures whichever path serving took
+            return sum(v for k, v in jitstats.compile_counts().items()
+                       if "probe_solve" in k)
+
+        # warmup: compile the probe at the serving (B, G) buckets
+        for count in (1, 3, 8):
+            qp.submit(ask(count, 500.0)).result(timeout=300)
+        compiles0 = probe_compiles()
+        req0, disp0 = qp.requests_served, qp.dispatches
+
+        lat: list = []
+        errors: list = []
+        lock = threading.Lock()
+
+        def client(k):
+            rng = np.random.default_rng(k)
+            mine = []
+            try:
+                for _ in range(requests_per_client):
+                    body = ask(int(rng.integers(1, 9)),
+                               float(rng.choice([250.0, 1000.0, 4000.0])))
+                    t0 = time.perf_counter()
+                    resp = qp.submit(body).result(timeout=300)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                    assert "feasible" in resp
+            except Exception as e:  # noqa: BLE001 — surface, don't hang
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+        total = qp.requests_served - req0
+        dispatches = qp.dispatches - disp0
+        retraces = probe_compiles() - compiles0
+        out = {
+            "n_tasks": n_tasks,
+            "n_nodes": n_nodes,
+            "clients": n_clients,
+            "requests": total,
+            "whatif_p50_ms": round(_pct(lat, 50), 2) if lat else None,
+            "whatif_p99_ms": round(_pct(lat, 99), 2) if lat else None,
+            "qps": round(total / elapsed, 1) if elapsed > 0 else None,
+            "device_dispatches": dispatches,
+            "mean_batch_size": round(total / dispatches, 2) if dispatches else None,
+            "dispatches_per_100_requests": (
+                round(100.0 * dispatches / total, 1) if total else None
+            ),
+            # the acceptance pair: amortized (≫1 request per dispatch) and
+            # no steady-state retraces across varying batch fill
+            "amortized": bool(total > dispatches > 0),
+            "retraces_after_warmup": retraces,
+        }
+        if errors:
+            out["client_errors"] = errors[:3]
+        return out
+    finally:
+        qp.close()
+
+
 def main() -> None:
     if os.environ.get("KB_BENCH_SHARDED_CHILD") == "1":
         # forced-host-device child (CPU fallback's sharded evidence): a
@@ -568,6 +668,12 @@ def main() -> None:
                 "multicycle_sharded"]
         except Exception as e:  # noqa: BLE001
             result["multicycle_sharded_error"] = f"{type(e).__name__}: {e}"
+        # serving evidence is backend-independent (amortization + retrace
+        # counters, not absolute latency) — run the full 20k×2k section
+        try:
+            result["whatif_serving"] = whatif_serving_bench(conf)
+        except Exception as e:  # noqa: BLE001
+            result["whatif_serving_error"] = f"{type(e).__name__}: {e}"
         # the go-loop denominators are CPU measurements — valid evidence
         # even on a wedged tunnel; the meaningful ratio is against the last
         # committed TPU capture's cycle, not this fallback run's
@@ -630,6 +736,13 @@ def main() -> None:
             result["multicycle_sharded"] = sharded_multicycle(
                 conf, N_TASKS, N_NODES
             )
+
+    # ---- the serve/ query plane: concurrent what-if clients against a
+    # 20k×2k snapshot — request latency, QPS, and the amortization proof
+    # (dispatches ≪ requests, zero retraces across varying batch fill)
+    if section("whatif_serving", margin_s=120):
+        with guarded("whatif_serving"):
+            result["whatif_serving"] = whatif_serving_bench(conf)
 
     # ---- ≥10×-vs-Go-loop target (BASELINE.md): time the faithful
     # sequential re-creation of the reference's allocate loop over the same
@@ -803,7 +916,8 @@ def _emit(result: dict, tpu_capture_note: bool) -> None:
         capture.pop("sections_missing", None)
         missing = [
             s for s in ("go_loop_ms", "pallas_roundhead", "pipeline5_ms",
-                        "het30_ms", "multicycle", "multicycle_sharded")
+                        "het30_ms", "multicycle", "multicycle_sharded",
+                        "whatif_serving")
             if s not in capture
         ]
         # the matrix is complete only when every build_cases() config has a

@@ -117,6 +117,39 @@ def dispatch_allocate_solve(snap, config, cols=None):
     return allocate_solve(resident_snap(cols, snap), config), "single"
 
 
+def republish_query_lease(ssn, snap=None, meta=None, build=None) -> None:
+    """THE guarded what-if lease publish — every publish path (allocate's
+    solve and idle/empty cycles, reclaim/backfill/preempt's post-swap
+    re-arms) goes through here, so the gate, the version-token source, and
+    the failure policy live once.
+
+    On donating backends EVERY resident swap retires the published lease
+    (serve/lease.py) — and reclaim, backfill, and preempt all swap after
+    allocate's publish, so without the post-dispatch re-arms the query
+    plane would sit leaseless from the last swap until the NEXT cycle's
+    allocate: the whole schedule period, exactly on the hardware serving
+    targets.  ``resident_snap`` is memoized on the exact ``snap`` object
+    the caller's dispatch used, so a re-arm is bookkeeping, not device
+    work.  ``build`` is the lazy (snap, meta) builder for the idle/empty
+    paths: the snapshot rebuild runs only when the publish is actually
+    owed (no plane attached, an isolated/object session, or a live lease
+    already covering the open's version — CPU: swaps never retire — all
+    skip it).  A publish failure degrades serving, never the cycle."""
+    qp = getattr(ssn.cache, "query_plane", None)
+    if qp is None or ssn.columns is None:
+        return
+    try:
+        if not qp.needs_publish(
+            int(getattr(ssn.cache, "last_open_version", 0))
+        ):
+            return
+        if build is not None:
+            snap, meta = build()
+        qp.publish_session(ssn, snap, meta)
+    except Exception:  # noqa: BLE001 — the write path outranks serving
+        logger.exception("whatif lease publication failed")
+
+
 class AllocateAction(Action):
     name = "allocate"
 
@@ -153,11 +186,17 @@ class AllocateAction(Action):
         # are included so fairness state (queue_alloc/job_allocated) counts
         # Pending-phase jobs' allocations; the Pending-phase gate
         # (allocate.go:50-52) is the snapshot's job_schedulable flag
+        cols = ssn.columns
         if not ssn.jobs or not ssn.nodes:
+            # an empty (or node-less) cluster still serves what-ifs:
+            # publish the lease so probes answer against the real — if
+            # vacuous — state instead of 503ing until first ingest
+            republish_query_lease(
+                ssn, build=lambda: build_session_snapshot(ssn)
+            )
             return
 
         t0 = telemetry.perf_counter()
-        cols = ssn.columns
         if cols is not None and not cols.has_schedulable_pending():
             # steady-state idle cycle: nothing schedulable anywhere — skip
             # the snapshot/solve/replay entirely (the reference's loop with
@@ -165,6 +204,15 @@ class AllocateAction(Action):
             # schedule period)
             self.last_phase_ms = {"snapshot_build": 0.0, "solve": 0.0,
                                   "fit_errors": 0.0, "replay": 0.0}
+            # serving deployments still need a lease for this state: an
+            # idle cluster is exactly when capacity-planning what-ifs
+            # arrive.  The snapshot build + resident swap run only when a
+            # query plane is attached AND ingest moved the version since
+            # the last publish — a steadily idle cluster pays for the
+            # rebuild once, not every schedule period.
+            republish_query_lease(
+                ssn, build=lambda: build_session_snapshot(ssn)
+            )
             return
         snap, meta = build_session_snapshot(ssn)
         t1 = telemetry.perf_counter()
@@ -174,6 +222,9 @@ class AllocateAction(Action):
         result, self.last_solve_mode = dispatch_allocate_solve(
             snap, session_allocate_config(ssn), cols=cols
         )
+        # the lease shares this dispatch's resident swap (memoized on the
+        # same snap object), so publication is bookkeeping-only
+        republish_query_lease(ssn, snap, meta)
         # kbt: allow[KBT010] THE sanctioned choke point: one blocking
         # transfer for everything the host replay reads
         assigned, pipelined, rounds_run = jax.device_get(

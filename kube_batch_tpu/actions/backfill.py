@@ -129,6 +129,15 @@ class BackfillAction(Action):
         result, _mode = dispatch_allocate_solve(
             snap, session_allocate_config(ssn), cols=cols
         )
+        # this swap retired the what-if lease on donating backends — re-arm
+        # it off the same (memoized) resident snapshot.  The gang-safe
+        # job_schedulable mask above is probe-invisible: a probe's task
+        # axis is ONLY the speculative gang (its appended job row is the
+        # sole j_sched consulted), so this snapshot is oracle-equivalent
+        # for serving
+        from kube_batch_tpu.actions.allocate import republish_query_lease
+
+        republish_query_lease(ssn, snap, meta)
         # kbt: allow[KBT010] the backfill pass's one sanctioned readback
         assigned, pipelined = jax.device_get((result.assigned, result.pipelined))
         assigned = assigned[: meta.n_tasks]

@@ -128,6 +128,12 @@ def solve_claims(ssn, mode: str):
         result = sharded_evict_solve(resident_snap(cols, snap, mesh), config, mesh)
     else:
         result = evict_solve(resident_snap(cols, snap), config)
+    # this swap retired the what-if lease on donating backends — re-arm it
+    # off the same (memoized) resident snapshot so serving doesn't stay
+    # dark until the next cycle's allocate
+    from kube_batch_tpu.actions.allocate import republish_query_lease
+
+    republish_query_lease(ssn, snap, meta)
     # kbt: allow[KBT010] the evict pass's ONE sanctioned readback — batched
     # (three per-field np.asarray reads were three blocking transfers;
     # flagged by KBT010's first dogfood run)

@@ -549,14 +549,18 @@ class TelemetryMisuseRule(Rule):
 # --------------------------------------------------------------------------
 
 #: calls whose results live on device (the PR 3 resident/solve surface,
-#: extended for the PR 5 sharded scatters + enqueue gate dispatch shapes)
+#: extended for the PR 5 sharded scatters + enqueue gate dispatch shapes
+#: and the PR 8 what-if probe — the query plane's outputs are device
+#: arrays until its one sanctioned batch readback)
 _DEVICE_SOURCES = {
     "kube_batch_tpu.ops.assignment.allocate_solve",
     "kube_batch_tpu.ops.assignment.failure_histogram_solve",
     "kube_batch_tpu.ops.eviction.evict_solve",
+    "kube_batch_tpu.ops.probe.probe_solve",
     "kube_batch_tpu.parallel.mesh.sharded_allocate_solve",
     "kube_batch_tpu.parallel.mesh.sharded_failure_histogram",
     "kube_batch_tpu.parallel.mesh.sharded_evict_solve",
+    "kube_batch_tpu.parallel.mesh.sharded_probe_solve",
     "kube_batch_tpu.api.columns.resident_snap",
     "kube_batch_tpu.ops.admission.enqueue_gate_solve",
     "jax.device_put",
@@ -582,7 +586,10 @@ class ResidentSyncRule(Rule):
 
     id = "KBT010"
     title = "host-device sync on a device-resident value"
-    scope = ("actions/", "api/resident.py")
+    # serve/ joined the scope with the query plane (PR 8): probe results
+    # are device-resident until the micro-batcher's one sanctioned
+    # per-window readback (serve/plane.py carries the allow annotation)
+    scope = ("actions/", "api/resident.py", "serve/")
 
     SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 

@@ -182,6 +182,39 @@ def _build_pallas_round_head():
     )
 
 
+def _abstract_probe_batch(B=2, G=4):
+    """A ProbeBatch of ShapeDtypeStructs + the [G] row oracle — the query
+    plane's serving shapes at audit scale."""
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.ops.probe import ProbeBatch
+
+    f32, i32, b, u32 = jnp.float32, jnp.int32, jnp.bool_, jnp.uint32
+    batch = ProbeBatch(
+        req=S((B, G, _R), f32), valid=S((B, G), b),
+        min_avail=S((B,), i32), queue=S((B,), i32), prio=S((B,), i32),
+        sel_bits=S((B, _W), u32), sel_impossible=S((B,), b),
+        tol_bits=S((B, _W), u32), min_res=S((B, _R), f32),
+        has_min_res=S((B,), b),
+    )
+    return batch, S((G,), i32)
+
+
+def _build_probe():
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.ops.probe import probe_solve
+
+    batch, rows = _abstract_probe_batch()
+    # with_evictions=True traces the superset program (head + admission +
+    # histogram + the eviction probe's while_loop)
+    return probe_solve, (
+        abstract_snapshot(), batch, rows, AllocateConfig(),
+        EvictConfig(mode="preempt"), True,
+    )
+
+
 def _scatter_donation() -> Dict[str, Tuple[int, ...]]:
     # the resident scatter donates the stale device buffer everywhere
     # donation is supported; CPU skips it (api/resident.py's own gate)
@@ -199,6 +232,7 @@ REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.admission.enqueue_gate", _build_enqueue_gate),
     EntryPoint("ops.pallas_kernels.masked_best_node",
                _build_pallas_round_head),
+    EntryPoint("ops.probe.probe_solve", _build_probe),
 )
 
 
@@ -233,6 +267,18 @@ def _build_sharded_evict(mesh, mode, impl):
 
     return evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl), (
         abstract_snapshot(),)
+
+
+def _build_sharded_probe(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.parallel.mesh import probe_solve_fn
+
+    batch, rows = _abstract_probe_batch()
+    fn = probe_solve_fn(
+        mesh, AllocateConfig(), EvictConfig(mode="preempt"), True, impl=impl
+    )
+    return fn, (abstract_snapshot(), batch, rows)
 
 
 def _build_sharded_gate(mesh):
@@ -313,6 +359,8 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
                        p(_build_sharded_evict, mesh, "reclaim", impl)),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[preempt]{tag}",
                        p(_build_sharded_evict, mesh, "preempt", impl)),
+            EntryPoint(f"parallel.mesh.sharded_probe_solve{tag}",
+                       p(_build_sharded_probe, mesh, impl)),
         ]
     entries += [
         EntryPoint("parallel.mesh.sharded_enqueue_gate",

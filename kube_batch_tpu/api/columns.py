@@ -122,6 +122,24 @@ class _Axis:
         self.n_live -= 1
         self._free.append(row)
 
+    def peek(self, k: int) -> List[int]:
+        """The rows the next k ``alloc()`` calls would hand out, WITHOUT
+        mutating the allocator — the query plane's tie-hash oracle: a gang
+        submitted against a frozen cache lands exactly on these rows
+        (alloc pops the free list LIFO; growth extends it so grown rows
+        hand out ascending from the old capacity)."""
+        out: List[int] = []
+        i = len(self._free) - 1
+        grown = self.cap
+        for _ in range(k):
+            if i >= 0:
+                out.append(self._free[i])
+                i -= 1
+            else:
+                out.append(grown)
+                grown += 1
+        return out
+
 
 class ColumnStore:
     def __init__(self, spec: ResourceSpec):
@@ -257,6 +275,13 @@ class ColumnStore:
         # old mesh's cache wholesale (the reshard/mesh-change fallback: the
         # fresh cache full-uploads once, then deltas resume).
         self._per_cycle_dev: Dict = {}
+        # serve/ query-plane seam: a context-manager factory the resident
+        # swap runs inside (serve/lease.LeaseBroker.swap_guard) — it
+        # serializes the swap's donating scatters against in-flight probe
+        # dispatches and retires the published lease whose buffers the
+        # donation would invalidate.  None (the default) is a no-op: the
+        # write path pays nothing until a query plane attaches.
+        self.resident_swap_guard = None
         # which path the most recent session row-sync took ("delta"|"full")
         # — surfaced in the bench JSON and the sim's longitudinal report
         self.last_snapshot_path = "full"
@@ -803,6 +828,17 @@ class ColumnStore:
     def has_schedulable_pending(self) -> bool:
         return bool(np.any(self.schedulable_pending_mask()))
 
+    def peek_task_rows(self, k: int) -> List[int]:
+        """The task rows the next k ingested pods would occupy (no
+        mutation) — the what-if probe's tie-hash oracle (ops/probe.py):
+        score ties in the solve break on a per-(task-row, node) hash, so a
+        probe that answers for rows the gang will NOT get could report a
+        different max-score node than the committed solve picks.  Exact
+        against a frozen cache; concurrent ingest shifts the allocator and
+        the probe's answer degrades to any-of-the-tied-nodes (the verdict
+        and score are row-independent)."""
+        return self.tasks.peek(k)
+
     def excluded_node_rows(self, ssn) -> List[int]:
         """Row indices of the session's excluded nodes (pressure gates) —
         the single fold every columnar placement path uses, so a new path
@@ -944,6 +980,14 @@ class ColumnStore:
             for stale in [k for k in self._per_cycle_dev if k is not mesh]:
                 del self._per_cycle_dev[stale]
             self._per_cycle_dev[mesh] = cache
+        guard = self.resident_swap_guard
+        if guard is not None:
+            # the swap's scatters DONATE the resident buffers a published
+            # lease may still reference — the guard (serve/lease.py)
+            # excludes probe dispatches for the swap's duration and retires
+            # the stale lease on donating backends
+            with guard():
+                return cache.swap(snap)
         return cache.swap(snap)
 
     def resident_counters(self) -> Dict[str, Dict[str, int]]:

@@ -169,6 +169,13 @@ class SchedulerCache:
         # diagnostics: which path the most recent open took, and its churn
         self.last_open_path = "full"
         self.last_churn = 0.0
+        # dirty-tracker version token of the most recent session open — the
+        # query plane's snapshot_version (serve/lease.py): a lease published
+        # for cycle N reports exactly the ingest state that open consumed
+        self.last_open_version = 0
+        # the serve/ query plane, when one is attached (QueryPlane.__init__
+        # sets it); the allocate action publishes its per-cycle lease here
+        self.query_plane = None
         # --priority-class toggle (options.go:30, consumed cache.go:352,378)
         self.resolve_priority = resolve_priority
         self.binder = binder if binder is not None else FakeBinder()
@@ -1385,7 +1392,9 @@ class SchedulerCache:
         ingest gate defers mutations, so no marks land mid-cycle except the
         cache's own status writebacks at close."""
         with self._lock:
-            return self.dirty.take()
+            delta = self.dirty.take()
+            self.last_open_version = delta.version
+            return delta
 
     def session_view_delta(self, delta) -> ClusterInfo:
         """session_view() by delta: refresh only the dirty jobs in the

@@ -18,6 +18,9 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
                                  Queue CRD status the CLI renders, list.go:51)
 - GET  /v1/jobs                — podgroup phases/conditions
 - GET  /v1/bindings            — pod→node decisions made so far
+- POST /v1/whatif              — batched what-if / admission probe against
+                                 the resident snapshot (serve/; README
+                                 "Query plane" for the schema)
 
 `Run` mirrors app.Run (server.go:76-151): build cache + scheduler, start the
 HTTP listener, then run the scheduling loop — optionally gated behind leader
@@ -27,6 +30,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -96,7 +100,7 @@ def _bindings(cache: SchedulerCache) -> list:
         return sorted(out, key=lambda r: r["pod"])
 
 
-def make_handler(cache: SchedulerCache):
+def make_handler(cache: SchedulerCache, query_plane=None):
     ingest = {
         # POST is add-or-update: update_pod is delete+add (event_handlers.go:116-130)
         "pods": (serialize.pod_from_dict, cache.update_pod, cache.delete_pod),
@@ -247,7 +251,50 @@ def make_handler(cache: SchedulerCache):
                 cache.mark_synced()
                 self._send(200, "{}")
                 return
+            if self.path == "/v1/whatif":
+                self._whatif()
+                return
             self._ingest(delete=False)
+
+        def _whatif(self):
+            """The query plane's serving endpoint: validate, enqueue into
+            the micro-batcher, block this handler thread on the per-request
+            future (ThreadingHTTPServer gives every request its own thread,
+            so concurrent handlers pile into ONE probe dispatch)."""
+            from concurrent.futures import TimeoutError as FutureTimeout
+
+            from kube_batch_tpu.serve.batcher import QueueFull
+            from kube_batch_tpu.serve.plane import WhatifError
+
+            if query_plane is None:
+                self._send(503, json.dumps(
+                    {"error": "query plane not enabled"}))
+                return
+            try:
+                body = self._body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, json.dumps({"error": str(e)}))
+                return
+            try:
+                fut = query_plane.submit(body)
+                resp = fut.result(timeout=query_plane.dispatch_timeout + 8)
+            except WhatifError as e:
+                self._send(e.status, json.dumps({"error": str(e)}))
+                return
+            except QueueFull as e:
+                self._send(503, json.dumps({"error": str(e)}))
+                return
+            except (FutureTimeout, TimeoutError):
+                # abandon the queued probe: a cancelled future is skipped
+                # at flush (no device time, no verdict counters for an
+                # answer nobody receives); cancel() failing means the
+                # flush is resolving it right now — the answer is simply
+                # discarded
+                fut.cancel()
+                self._send(503, json.dumps(
+                    {"error": "whatif probe timed out"}))
+                return
+            self._send(200, json.dumps(resp))
 
         def do_DELETE(self):
             self._ingest(delete=True)
@@ -256,10 +303,16 @@ def make_handler(cache: SchedulerCache):
 
 
 class AdminServer:
-    """The --listen-address listener (server.go:96-99)."""
+    """The --listen-address listener (server.go:96-99).  With a
+    ``query_plane`` the same listener serves ``POST /v1/whatif`` (the
+    serve/ read path) beside the admin/ingest API."""
 
-    def __init__(self, cache: SchedulerCache, host: str = "127.0.0.1", port: int = 0):
-        self.httpd = ThreadingHTTPServer((host, port), make_handler(cache))
+    def __init__(self, cache: SchedulerCache, host: str = "127.0.0.1",
+                 port: int = 0, query_plane=None):
+        self.query_plane = query_plane
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(cache, query_plane=query_plane)
+        )
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -460,10 +513,21 @@ def run(opt: ServerOption) -> None:
         schedule_period=opt.schedule_period,
         on_cycle_end=on_cycle_end,
     )
+    # the read-side query plane (serve/): /v1/whatif rides the same
+    # listener; KB_WHATIF=0 opts out (e.g. a memory-constrained part where
+    # the probe's compiled specializations are unwelcome)
+    query_plane = None
+    if os.environ.get("KB_WHATIF", "").strip().lower() not in (
+        "0", "false", "off", "no"
+    ):
+        from kube_batch_tpu.serve.plane import QueryPlane
+
+        query_plane = QueryPlane(cache, prewarm=True)
     host, port = opt.listen_host_port
-    admin = AdminServer(cache, host, port)
+    admin = AdminServer(cache, host, port, query_plane=query_plane)
     admin.start()
-    logger.info("admin/metrics listening on %s:%d", host, admin.port)
+    logger.info("admin/metrics listening on %s:%d (whatif %s)", host,
+                admin.port, "on" if query_plane is not None else "off")
     # Kubernetes front end (cache.go:256-339 informers): --master pointing
     # at an apiserver URL starts the list+watch adapter.  start() BLOCKS
     # until every resource finished its initial LIST and then marks the
@@ -512,4 +576,6 @@ def run(opt: ServerOption) -> None:
     finally:
         if watcher is not None:
             watcher.stop()
+        if query_plane is not None:
+            query_plane.close()
         admin.stop()

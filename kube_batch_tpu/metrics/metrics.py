@@ -65,6 +65,10 @@ class Histogram:
 
 
 class Counter:
+    #: Prometheus exposition type — Gauge overrides (a counter that goes
+    #: down reads as a reset to Prometheus clients)
+    prom_type = "counter"
+
     def __init__(self, name: str, help_text: str, labels: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_text
@@ -90,12 +94,22 @@ class Counter:
             self._values.pop(label_values, None)
 
     def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.prom_type}"]
         with self._lock:
             for labels, v in self._values.items():
                 base = ",".join(f'{n}="{val}"' for n, val in zip(self.label_names, labels))
                 lines.append(f"{self.name}{{{base}}} {v:g}")
         return "\n".join(lines)
+
+
+class Gauge(Counter):
+    """A settable series rendered with TYPE gauge (Counter already carries
+    set(); only the exposition type differs — Prometheus clients treat a
+    counter that goes down as a reset, so shares/versions must not render
+    as counters)."""
+
+    prom_type = "gauge"
 
 
 _SUBSYSTEM = "volcano"
@@ -200,6 +214,46 @@ LEADER_FAILOVER = Counter(
     "Leadership takeovers, by resident-cache outcome (warm|cold)",
     ("mode",),
 )
+# query-plane counters (serve/): the amortization story is readable straight
+# off /metrics — requests_total vs device_dispatches_total is the
+# requests-per-dispatch ratio the serving bench asserts
+WHATIF_REQUESTS = Counter(
+    f"{_SUBSYSTEM}_whatif_requests_total",
+    "What-if probe requests, by verdict (feasible|infeasible|error)",
+    ("verdict",),
+)
+WHATIF_DISPATCHES = Counter(
+    f"{_SUBSYSTEM}_whatif_device_dispatches_total",
+    "Batched probe device dispatches (one per flush window)",
+)
+WHATIF_BATCH_SIZE = Histogram(
+    f"{_SUBSYSTEM}_whatif_batch_size",
+    "Requests amortized into one probe dispatch",
+)
+WHATIF_QUEUE_DEPTH = Histogram(
+    f"{_SUBSYSTEM}_whatif_queue_depth",
+    "Whatif requests still queued at flush time",
+)
+WHATIF_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_whatif_request_latency_milliseconds",
+    "Whatif request latency (enqueue to verdict) in milliseconds",
+)
+WHATIF_SNAPSHOT_VERSION = Gauge(
+    f"{_SUBSYSTEM}_whatif_snapshot_version",
+    "Dirty-tracker version token of the published snapshot lease",
+)
+# longitudinal fairness surfaced live (sim runner + any caller with
+# per-queue share samples): dominant share vs weight entitlement per queue
+QUEUE_SHARE = Gauge(
+    f"{_SUBSYSTEM}_queue_dominant_share",
+    "Per-queue dominant share of cluster capacity (0..1)",
+    ("queue",),
+)
+QUEUE_ENTITLEMENT = Gauge(
+    f"{_SUBSYSTEM}_queue_share_entitlement",
+    "Per-queue weight entitlement (weight / Σ weights)",
+    ("queue",),
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -223,6 +277,14 @@ METRICS = [
     STATUS_WRITES_SHED,
     CYCLE_BUDGET_EXCEEDED,
     LEADER_FAILOVER,
+    WHATIF_REQUESTS,
+    WHATIF_DISPATCHES,
+    WHATIF_BATCH_SIZE,
+    WHATIF_QUEUE_DEPTH,
+    WHATIF_LATENCY,
+    WHATIF_SNAPSHOT_VERSION,
+    QUEUE_SHARE,
+    QUEUE_ENTITLEMENT,
 ]
 
 
@@ -322,6 +384,42 @@ def register_cycle_budget_exceeded() -> None:
 
 def register_leader_failover(mode: str) -> None:
     LEADER_FAILOVER.inc(mode)
+
+
+def register_whatif_request(verdict: str) -> None:
+    WHATIF_REQUESTS.inc(verdict)
+
+
+def register_whatif_dispatch() -> None:
+    WHATIF_DISPATCHES.inc()
+
+
+def observe_whatif_batch(size: int, queue_depth: int) -> None:
+    WHATIF_BATCH_SIZE.observe(float(size))
+    WHATIF_QUEUE_DEPTH.observe(float(queue_depth))
+
+
+def observe_whatif_latency(ms: float) -> None:
+    WHATIF_LATENCY.observe(ms)
+
+
+def set_whatif_snapshot_version(version: int) -> None:
+    WHATIF_SNAPSHOT_VERSION.set(float(version))
+
+
+def set_queue_shares(shares: dict) -> None:
+    """Export per-queue {share, entitlement} samples as live gauges — the
+    sim runner's longitudinal fairness series surfaced through /metrics
+    (and usable by any caller with the same sample shape).  Queues absent
+    from the sample are pruned: a deleted queue must not export a phantom
+    share forever."""
+    live = {(q,) for q in shares}
+    for gauge in (QUEUE_SHARE, QUEUE_ENTITLEMENT):
+        for stale in [k for k in list(gauge._values) if k not in live]:
+            gauge.remove(*stale)
+    for queue, s in shares.items():
+        QUEUE_SHARE.set(float(s.get("share", 0.0)), queue)
+        QUEUE_ENTITLEMENT.set(float(s.get("entitlement", 0.0)), queue)
 
 
 def render_prometheus() -> str:

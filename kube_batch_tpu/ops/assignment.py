@@ -52,6 +52,17 @@ _H2 = 0x85EBCA77 - (1 << 32)
 _H3 = 0xCA87C3EB - (1 << 32)
 
 
+def tie_break_hash_rows(ti: jnp.ndarray, ni: jnp.ndarray) -> jnp.ndarray:
+    """[len(ti), len(ni)] deterministic per-(task, node) hash in [0, 65535]
+    (i32) from explicit GLOBAL task/node indices.  The what-if probe
+    (ops/probe.py) hashes a speculative gang at the rows it WOULD occupy on
+    submission — sharing this one formula is what makes the probe's
+    tie-breaks bit-identical to the committed solve's."""
+    h = ti[:, None] * jnp.int32(_H1) + ni[None, :] * jnp.int32(_H2)
+    h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
+    return jax.lax.shift_right_logical(h, 16)
+
+
 def _tie_break_hash(T: int, N: int, t0=0, n0=0) -> jnp.ndarray:
     """[T, N] deterministic per-(task, node) hash in [0, 65535] (i32).
     Ordering is identical to the previous float form (a monotone rescale of
@@ -59,11 +70,10 @@ def _tie_break_hash(T: int, N: int, t0=0, n0=0) -> jnp.ndarray:
     indices to GLOBAL coordinates when (T, N) is a block of a larger matrix
     — the shard_map round head (parallel/shard_solve.py) computes the hash
     of its local block and must agree bit-for-bit with the full matrix."""
-    ti = (jnp.arange(T, dtype=jnp.int32) + t0)[:, None]
-    ni = (jnp.arange(N, dtype=jnp.int32) + n0)[None, :]
-    h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
-    h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
-    return jax.lax.shift_right_logical(h, 16)
+    return tie_break_hash_rows(
+        jnp.arange(T, dtype=jnp.int32) + t0,
+        jnp.arange(N, dtype=jnp.int32) + n0,
+    )
 
 
 def _best_node(masked: jnp.ndarray, tie_hash: jnp.ndarray):
@@ -211,20 +221,25 @@ def _resolve_conflicts(
     return accept, delta
 
 
-def local_round_head(snap: DeviceSnapshot, config: AllocateConfig):
-    """Build the single-program round head: ``head(idle, releasing,
-    pending) -> (best, has, chose_idle)`` computed from the full [T, N]
-    matrices in one logical program (on the pjit path GSPMD partitions it
-    implicitly).  The shard_map path substitutes the explicit-collective
-    block head (parallel/shard_solve.py); everything else in the solve is
-    the SHARED :func:`allocate_rounds` machinery, so the two paths can only
-    diverge in the head — which both compute bit-identically."""
+def round_head_parts(snap: DeviceSnapshot, config: AllocateConfig,
+                     tie_hash: jnp.ndarray = None):
+    """:func:`local_round_head` plus its intermediates: ``(head,
+    static_ok, score)``.  The what-if probe (ops/probe.py) calls this with
+    an explicit ``tie_hash`` — the hash at the GLOBAL rows a speculative
+    gang would occupy — and reuses static_ok/score for its eviction bids
+    and fit-error histogram; sharing ONE head body is what keeps probe
+    answers structurally bit-identical to the committed solve."""
+    if tie_hash is not None and config.use_pallas:
+        # the Pallas kernel computes its own (offset-parameterized) hash
+        # from arange rows — an explicit row override cannot route there
+        raise ValueError("tie_hash override requires use_pallas=False")
     static_ok = static_predicates(snap)           # [T, N]
     score = score_matrix(snap, config.weights)
     # static predicates folded into the score once — every round reuses it
     score_static = jnp.where(static_ok, score, NEG)
     T, N = score.shape
-    tie_hash = _tie_break_hash(T, N)
+    if tie_hash is None:
+        tie_hash = _tie_break_hash(T, N)
 
     def head(idle, releasing, pending):
         if config.use_pallas:
@@ -259,7 +274,18 @@ def local_round_head(snap: DeviceSnapshot, config: AllocateConfig):
         chose_idle = jnp.take_along_axis(fit_idle, best[:, None], axis=1)[:, 0]
         return best, has, chose_idle
 
-    return head
+    return head, static_ok, score
+
+
+def local_round_head(snap: DeviceSnapshot, config: AllocateConfig):
+    """Build the single-program round head: ``head(idle, releasing,
+    pending) -> (best, has, chose_idle)`` computed from the full [T, N]
+    matrices in one logical program (on the pjit path GSPMD partitions it
+    implicitly).  The shard_map path substitutes the explicit-collective
+    block head (parallel/shard_solve.py); everything else in the solve is
+    the SHARED :func:`allocate_rounds` machinery, so the two paths can only
+    diverge in the head — which both compute bit-identically."""
+    return round_head_parts(snap, config)[0]
 
 
 def allocate_rounds(

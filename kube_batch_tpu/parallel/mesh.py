@@ -325,6 +325,62 @@ def _evict(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
     return evict_solve(snap, config)
 
 
+def probe_solve_fn(mesh: Mesh, config: AllocateConfig,
+                   evict_config: EvictConfig, with_evictions: bool,
+                   impl: Optional[str] = None):
+    """Memoized jitted sharded what-if probe (ops/probe.py) for (mesh,
+    config, evict_config, with_evictions, impl) — the query plane's
+    dispatch on multi-device leases, and a jaxpr-audit entry point.  The
+    shard_map impl authors its collectives (parallel/shard_solve.py);
+    the pjit impl re-jits the single-device :func:`ops.probe.probe_body`
+    with mesh shardings — the bit-exactness oracle, same split as the
+    solves."""
+    impl = _impl(impl)
+    key = (mesh, config, evict_config, with_evictions, "probe", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.probe_shard_map(
+                mesh, config, evict_config, with_evictions
+            )
+        else:
+            from kube_batch_tpu.ops.probe import (
+                ProbeBatch,
+                ProbeResult,
+                probe_body,
+            )
+
+            repl = NamedSharding(mesh, P())
+            batch_shardings = ProbeBatch(
+                *([repl] * len(ProbeBatch._fields)))
+            out_shardings = ProbeResult(
+                *([repl] * len(ProbeResult._fields)))
+            fn = jax.jit(
+                partial(probe_body, config=config,
+                        evict_config=evict_config,
+                        with_evictions=with_evictions),
+                in_shardings=(snapshot_shardings(mesh), batch_shardings,
+                              repl),
+                out_shardings=out_shardings,
+            )
+        jitstats.register(f"sharded_probe_solve[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sharded_probe_solve(snap: DeviceSnapshot, batch, probe_rows, mesh: Mesh,
+                        config: AllocateConfig, evict_config: EvictConfig,
+                        with_evictions: bool = False):
+    """The batched what-if probe over the mesh: node-axis snapshot columns
+    stay sharded (the lease's resident placement), the B-gang batch and
+    row oracle replicate, every ProbeResult field comes back replicated."""
+    fn = probe_solve_fn(mesh, config, evict_config, with_evictions)
+    with mesh:
+        return fn(snap, batch, probe_rows)
+
+
 def enqueue_gate_solve_fn(mesh: Mesh):
     """Memoized mesh-replicated enqueue admission scan (the shard_map
     wrapper around ops.admission.gate_scan — zero cross-shard bytes; see

@@ -423,6 +423,124 @@ def failure_histogram_shard_map(mesh):
     return _shard_map(body, mesh, (_snapshot_specs(mesh),), P())
 
 
+def _probe_body(snap, batch, probe_rows, *, config, evict_config,
+                with_evictions, node_shards):
+    """The shard_map what-if probe (ops/probe.py): each shard computes the
+    [G, N_loc] blocks — gang-view static predicates, scores, per-round
+    fits, eviction bids, fit-error histogram partials — and the gang-sized
+    winner vectors reduce with the SAME two-key pargmax decomposition the
+    sharded allocate solve uses.  Everything downstream of the blocks is
+    :func:`ops.probe.probe_gang_core`, verbatim — the bit-exactness story
+    is the one the solves already proved.
+
+    The task axis of a 2-D mesh is untouched (a gang's G rows are tiny);
+    on such meshes every task-shard row computes identical replicated
+    results with zero task-axis collectives."""
+    from kube_batch_tpu.ops import probe as prb
+
+    N_loc = snap.node_idle.shape[0]
+    N = N_loc * node_shards
+    n0 = jax.lax.axis_index(NODE_AXIS) * N_loc
+    # the replicated ledgers for the allocate-rounds tail: one O(N·R)
+    # all_gather per DISPATCH (not per gang — hoisted out of the vmap),
+    # mirroring the sharded allocate body's once-per-solve gather
+    idle0 = _gather_nodes(snap.node_idle, node_shards)
+    rel0 = _gather_nodes(snap.node_releasing, node_shards)
+    used0 = _gather_nodes(snap.node_used, node_shards)
+    # admission budget: local used-sum + one O(R) psum
+    used_l = jnp.sum(
+        jnp.where(snap.node_valid[:, None], snap.node_used, 0.0), axis=0
+    )
+    used = jax.lax.psum(used_l, NODE_AXIS)
+    oc_idle = jnp.maximum(snap.total * prb.OVERCOMMIT_FACTOR - used, 0.0)
+
+    def one(g):
+        view = prb._gang_view(
+            snap, g.req, g.valid, g.min_avail, g.queue, g.prio,
+            g.sel_bits, g.sel_impossible, g.tol_bits,
+        )
+        static_ok = static_predicates(view)            # [G, N_loc]
+        score = score_matrix(view, config.weights)
+        score_static = jnp.where(static_ok, score, NEG)
+        tie_blk = asg.tie_break_hash_rows(
+            probe_rows, jnp.arange(N_loc, dtype=jnp.int32) + n0
+        )
+
+        def head(idle_g, releasing_g, pending):
+            idle_b = jax.lax.dynamic_slice_in_dim(idle_g, n0, N_loc, axis=0)
+            rel_b = jax.lax.dynamic_slice_in_dim(
+                releasing_g, n0, N_loc, axis=0
+            )
+            fit_idle = fits(view.task_req, idle_b, snap.quanta)
+            fit_rel = jax.lax.cond(
+                jnp.any(rel_b > 0.0),
+                lambda rel: fits(view.task_req, rel, snap.quanta),
+                lambda rel: jnp.zeros_like(fit_idle),
+                rel_b,
+            )
+            masked = jnp.where(
+                (fit_idle | fit_rel) & pending[:, None], score_static, NEG
+            )
+            lval, lkey, pick, lidx = _local_best(masked, tie_blk, n0)
+            lchose = jnp.take_along_axis(fit_idle, pick[:, None], axis=1)[:, 0]
+            vmax, best, chose = _combine_best(
+                lval, lkey, lidx, lchose.astype(jnp.int32)
+            )
+            return best, vmax > NEG, chose > 0
+
+        def bid_fn(claimant_ok, cap):
+            cap_b = jax.lax.dynamic_slice_in_dim(cap, n0, N_loc, axis=0)
+            feas = static_ok & claimant_ok[:, None]
+            feas &= jnp.all(
+                g.req[:, None, :] <= cap_b[None, :, :] + snap.quanta, axis=-1
+            )
+            masked = jnp.where(feas, score, NEG)
+            lval, lkey, _pick, lidx = _local_best(masked, tie_blk, n0)
+            vmax, best = _combine_best(lval, lkey, lidx)
+            return best, vmax > NEG
+
+        def hist_fn():
+            fit_idle0 = fits(view.task_req, snap.node_idle, snap.quanta)
+            fit_rel0 = fits(view.task_req, snap.node_releasing, snap.quanta)
+            h = failure_histogram(
+                view,
+                FeasibilityMasks(
+                    static_ok, fit_idle0, fit_rel0,
+                    static_ok & (fit_idle0 | fit_rel0),
+                ),
+            )
+            # every histogram column is an integer count over nodes — one
+            # exact psum reduces the per-shard partials (same argument as
+            # the sharded failure-histogram solve)
+            return jax.lax.psum(h, NODE_AXIS)
+
+        return prb.probe_gang_core(
+            snap, view, g, config, evict_config, with_evictions,
+            head=head, bid_fn=bid_fn, hist_fn=hist_fn, oc_idle=oc_idle,
+            idle0=idle0, rel0=rel0, used0=used0, n_nodes=N,
+        )
+
+    return jax.vmap(one)(batch)
+
+
+def probe_shard_map(mesh, config, evict_config, with_evictions):
+    """jitted shard_map what-if probe for (mesh, config, evict_config,
+    with_evictions) — node-axis snapshot columns consumed shard-local, the
+    probe batch and row oracle replicated, every ProbeResult field
+    replicated (all are B/G/T-axis)."""
+    from kube_batch_tpu.ops.probe import ProbeBatch, ProbeResult
+
+    _task_shards, node_shards = _axis_sizes(mesh)
+    repl = P()
+    batch_specs = ProbeBatch(*([repl] * len(ProbeBatch._fields)))
+    out_specs = ProbeResult(*([repl] * len(ProbeResult._fields)))
+    body = partial(_probe_body, config=config, evict_config=evict_config,
+                   with_evictions=with_evictions, node_shards=node_shards)
+    return _shard_map(
+        body, mesh, (_snapshot_specs(mesh), batch_specs, repl), out_specs
+    )
+
+
 def enqueue_gate_shard_map(mesh):
     """jitted mesh-replicated enqueue admission scan: the scan is
     sequentially dependent (each admission shrinks the idle the next

@@ -1,0 +1,30 @@
+"""serve/ — the read-side query plane over the resident snapshot.
+
+The write path (actions/, the scheduling cycle) COMMITS decisions; this
+package SERVES speculative ones at high QPS off the same compiled solve:
+``POST /v1/whatif`` answers "would this gang fit, where, and what would it
+evict?" without a Statement.
+
+Three layers:
+
+- :mod:`serve.lease` — ``SnapshotLease`` / ``LeaseBroker``: a consistent
+  read handle over the per-cycle device-resident columns (api/resident.py),
+  carrying the dirty-tracker version token.  Safe concurrent with the
+  cycle: probes answered against lease N report ``snapshot_version: N``
+  and never observe a half-applied scatter delta.
+- :mod:`serve.batcher` — ``MicroBatcher``: collects concurrent requests
+  into one probe dispatch per tick window (bounded queue, deadline-based
+  flush, per-request futures) — hundreds of speculative queries amortized
+  into one device dispatch.
+- :mod:`serve.plane` — ``QueryPlane``: request parsing/encoding against
+  the lease's meta, the batched :func:`ops.probe.probe_solve` dispatch
+  (shard_map variant on multi-device meshes), decode, and the
+  ``volcano_whatif_*`` metrics.
+
+Wired into cmd/server.py beside the admin API; ``python -m
+kube_batch_tpu.cli.whatif`` is the client, ``python scripts/whatif_smoke.py``
+the CI smoke (run by scripts/check.sh).
+"""
+
+from kube_batch_tpu.serve.lease import LeaseBroker, SnapshotLease  # noqa: F401
+from kube_batch_tpu.serve.plane import QueryPlane, WhatifError  # noqa: F401

@@ -1,0 +1,140 @@
+"""SnapshotLease — the query plane's consistent read handle.
+
+The per-cycle resident cache (api/resident.py) refreshes device columns
+with DONATING scatters: the cycle's swap invalidates the very buffers a
+concurrent reader might hold.  The broker makes reads safe anyway:
+
+- the cycle publishes a lease AFTER its swap completes (the snapshot the
+  solve consumed, whole — never a half-applied delta), stamped with the
+  dirty-tracker version token of the open that built it;
+- probe dispatches run inside :meth:`LeaseBroker.dispatch`, which counts
+  the dispatch as an in-flight READER for the device round-trip;
+- the cycle's swap runs inside :meth:`LeaseBroker.swap_guard`, which
+  excludes new dispatches for the swap's duration and — on donating
+  backends only — waits out in-flight readers before the scatters donate
+  the buffers they may still reference.  On CPU, where api/resident.py
+  skips donation, the old lease's arrays stay valid: the swap neither
+  waits for readers nor retires the lease, and serving continues right
+  through the cycle.
+
+The broker's condition lock is held only for bookkeeping — never across a
+device round-trip or a probe compile — so the cycle's publish path cannot
+stall behind a cold dispatch.  (A COLD probe shape compiling inside a
+dispatch still delays a donating swap that arrives mid-compile: the swap
+must wait for the reader either way.  Steady-state shapes are jit-stable,
+so this is a first-request cost per (B, G, evictions) bucket, not a
+recurring one.)
+
+Version tokens are monotonic: a query answered against lease N reports
+``snapshot_version: N``, and N never decreases across responses.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, NamedTuple, Optional
+
+
+class SnapshotLease(NamedTuple):
+    """One published read handle — everything a probe dispatch needs."""
+
+    snap: object          # DeviceSnapshot — per-cycle RESIDENT device columns
+    meta: object          # SnapshotMeta — decode tables (names, bit maps)
+    version: int          # dirty-tracker version token at the open
+    config: object        # AllocateConfig the session implies
+    evict_config: object  # EvictConfig (preempt) for the eviction probe
+    mesh: object          # the solve mesh (None = single-device)
+    probe_rows: tuple     # next-free task rows (the tie-hash oracle)
+    queue_rows: Dict[str, int]  # queue name → row
+
+
+def _donation_active() -> bool:
+    """api/resident.py donates the stale resident buffers everywhere but
+    CPU — mirror its gate, so the broker retires leases and waits out
+    readers exactly when a swap would invalidate their buffers."""
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+class LeaseBroker:
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._lease: Optional[SnapshotLease] = None
+        self._readers = 0       # in-flight probe dispatches
+        self._swapping = False  # a resident swap holds exclusivity
+        self.published = 0   # publish count (diagnostics)
+        self.retired = 0     # swap-guard retirements (donating backends)
+
+    # ---- write side (the cycle) -----------------------------------------
+    def publish(self, lease: SnapshotLease) -> None:
+        """Install a new lease.  Version must not regress — the dirty
+        tracker is monotonic, so a regression means a stale publisher."""
+        with self._cond:
+            if self._lease is not None and lease.version < self._lease.version:
+                return  # stale publisher (e.g. a re-entrant idle publish)
+            self._lease = lease
+            self.published += 1
+            self._cond.notify_all()
+
+    @contextmanager
+    def swap_guard(self):
+        """The resident swap's exclusion region (wired through
+        ``ColumnStore.resident_swap_guard``): new probe dispatches park
+        for the swap's duration, and on donating backends the swap first
+        waits out in-flight readers and retires the published lease whose
+        buffers the scatters are about to invalidate (republished by the
+        cycle after its solve dispatch)."""
+        with self._cond:
+            self._cond.wait_for(lambda: not self._swapping)
+            self._swapping = True
+            if _donation_active():
+                # readers may hold the very buffers the swap donates
+                self._cond.wait_for(lambda: self._readers == 0)
+                if self._lease is not None:
+                    self._lease = None
+                    self.retired += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._swapping = False
+                self._cond.notify_all()
+
+    # ---- read side (the batcher's flush) --------------------------------
+    def current(self, timeout: Optional[float] = None) -> Optional[SnapshotLease]:
+        """The live lease, waiting up to ``timeout`` for one to be
+        published (None on timeout — the server maps it to 503)."""
+        with self._cond:
+            if self._lease is None and timeout:
+                self._cond.wait_for(lambda: self._lease is not None,
+                                    timeout=timeout)
+            return self._lease
+
+    @contextmanager
+    def dispatch(self, timeout: Optional[float] = None):
+        """Probe-dispatch region: yields the lease (or None on timeout)
+        registered as an in-flight reader, so a concurrent swap cannot
+        donate the buffers mid-read.  The broker lock itself is NOT held
+        across the device round-trip — publish() and other dispatches
+        proceed concurrently."""
+        with self._cond:
+            if timeout:
+                self._cond.wait_for(
+                    lambda: self._lease is not None and not self._swapping,
+                    timeout=timeout,
+                )
+            # a swap in flight parks the dispatch regardless of timeout —
+            # the pre-rewrite lock gave exactly this unconditional wait
+            self._cond.wait_for(lambda: not self._swapping)
+            lease = self._lease
+            if lease is not None:
+                self._readers += 1
+        try:
+            yield lease
+        finally:
+            if lease is not None:
+                with self._cond:
+                    self._readers -= 1
+                    self._cond.notify_all()

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kube_batch_tpu import metrics as prom_metrics
 from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue
 from kube_batch_tpu.api.types import PodPhase, TaskStatus, is_allocated
 from kube_batch_tpu.cache.cache import SchedulerCache
@@ -473,8 +474,14 @@ class SimRunner:
             self.scheduler.run_once()  # flushes async binds at its end
             self._drain_kubelet(now)
             pending, running = self._task_counts()
+            shares = self._queue_shares()
+            # surface the longitudinal fairness series live: the same
+            # per-queue share/entitlement samples the report aggregates are
+            # exported as volcano_queue_* gauges, so a /metrics scrape of a
+            # sim-driven (or production) process sees the current window
+            prom_metrics.set_queue_shares(shares)
             self.metrics.note_cycle(
-                now, self._queue_shares(), pending, running,
+                now, shares, pending, running,
                 snapshot_path=(
                     f"{self.cache.last_open_path}"
                     f"/{self.cache.columns.last_snapshot_path}"

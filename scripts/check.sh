@@ -30,3 +30,9 @@ env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
 env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
   --preset leader-failover --seed 5 --no-fairness-series >/dev/null
 echo "kbt-check: chaos smoke clean"
+
+# whatif smoke: the serve/ query plane end to end — loopback AdminServer,
+# mixed feasible/infeasible gangs via the kb-ctl whatif CLI, verdict +
+# Prometheus-counter + amortization assertions (scripts/whatif_smoke.py)
+echo "kbt-check: whatif smoke (query plane)"
+env JAX_PLATFORMS=cpu python scripts/whatif_smoke.py

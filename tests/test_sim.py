@@ -37,6 +37,18 @@ class TestSimSmoke:
         for q, rec in series[-1]["queues"].items():
             assert 0.0 <= rec["share"] <= 1.0
             assert 0.0 < rec["entitlement"] < 1.0
+        # ... and the same samples are surfaced live through /metrics as
+        # volcano_queue_* gauges (the last cycle's window)
+        from kube_batch_tpu.metrics import metrics as M
+
+        rendered = M.render_prometheus()
+        for q, rec in series[-1]["queues"].items():
+            assert f'volcano_queue_dominant_share{{queue="{q}"}}' in rendered
+            assert f'volcano_queue_share_entitlement{{queue="{q}"}}' in rendered
+            # the gauge carries the most recent run's window — a valid
+            # share in [0, 1] and the exact (run-invariant) entitlement
+            assert 0.0 <= M.QUEUE_SHARE._values[(q,)] <= 1.0
+            assert M.QUEUE_ENTITLEMENT._values[(q,)] == rec["entitlement"]
 
     def test_trace_replay_reproduces_run(self, tmp_path):
         """A recorded trace's JOB_ARRIVAL events re-drive an identical run

@@ -1,0 +1,1057 @@
+"""Query plane (serve/ + ops/probe.py): oracle exactness vs the committed
+solves, lease consistency under a concurrently mutating cycle, micro-batcher
+deadline/overflow behavior under a stubbed clock, sharded-probe bit
+equivalence, and the /v1/whatif HTTP surface.
+
+The oracle tests are the subsystem's contract: a gang the probe reports
+feasible at nodes X on a frozen snapshot must bind to EXACTLY X when
+actually submitted (same rows, same tie-breaks, same machinery), and an
+infeasible verdict must carry the same fit-error histogram the committed
+cycle would record."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod, PodGroup, Queue
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.framework.interface import get_action
+from kube_batch_tpu.framework.session import close_session, open_session
+from kube_batch_tpu.serve.batcher import MicroBatcher, QueueFull
+from kube_batch_tpu.serve.lease import LeaseBroker, SnapshotLease
+from kube_batch_tpu.serve.plane import QueryPlane, WhatifError
+
+from fixtures import GiB, build_cache, build_node, build_pod
+
+CONF = load_scheduler_conf(None)
+
+
+def _run(cache, names=("allocate",)):
+    ssn = open_session(cache, CONF.tiers)
+    try:
+        for name in names:
+            get_action(name).execute(ssn)
+    finally:
+        close_session(ssn)
+    cache.flush_binds()
+
+
+def _probe(qp: QueryPlane, body: dict) -> dict:
+    """Submit one request and drive the flush synchronously (the test
+    planes run with start_thread=False)."""
+    fut = qp.submit(body)
+    qp.batcher.tick(now=qp.batcher.clock.monotonic() + 1e6)
+    return fut.result(timeout=60)
+
+
+@pytest.fixture
+def plane_factory():
+    planes = []
+
+    def make(cache, **kw):
+        kw.setdefault("start_thread", False)
+        qp = QueryPlane(cache, **kw)
+        planes.append(qp)
+        return qp
+
+    yield make
+    for qp in planes:
+        qp.close()
+
+
+# ==========================================================================
+# oracle exactness: frozen snapshot — probe answers vs the committed solve
+# ==========================================================================
+
+
+class TestWhatifOracle:
+    def _heterogeneous_cache(self):
+        """Nodes of varied size with varied running load — scores differ
+        per node, so placement is a real decision, not a degenerate tie."""
+        nodes = [
+            build_node("n0", cpu=8000, mem=16 * GiB),
+            build_node("n1", cpu=4000, mem=8 * GiB),
+            build_node("n2", cpu=16000, mem=32 * GiB),
+            build_node("n3", cpu=8000, mem=16 * GiB),
+            build_node("n4", cpu=2000, mem=4 * GiB),
+        ]
+        pods = [
+            build_pod("c1", "r0", "n0", PodPhase.RUNNING,
+                      {"cpu": 6000, "memory": 4 * GiB}, group_name="run0"),
+            build_pod("c1", "r1", "n2", PodPhase.RUNNING,
+                      {"cpu": 2000, "memory": 2 * GiB}, group_name="run0"),
+            build_pod("c1", "r2", "n3", PodPhase.RUNNING,
+                      {"cpu": 7000, "memory": GiB}, group_name="run1"),
+        ]
+        return build_cache(
+            queues=[Queue(name="default", weight=1)],
+            pod_groups=[
+                PodGroup(name="run0", namespace="c1", min_member=1,
+                         queue="default"),
+                PodGroup(name="run1", namespace="c1", min_member=1,
+                         queue="default"),
+            ],
+            nodes=nodes,
+            pods=pods,
+        )
+
+    def _submit_gang(self, cache, count, requests, *, priority=0,
+                     selector=None, min_member=None):
+        cache.add_pod_group(PodGroup(
+            name="probe-pg", namespace="c1",
+            min_member=min_member if min_member is not None else count,
+            queue="default",
+        ))
+        for i in range(count):
+            cache.add_pod(build_pod(
+                "c1", f"probe-{i}", None, PodPhase.PENDING, dict(requests),
+                group_name="probe-pg", priority=priority,
+                node_selector=selector or {},
+            ))
+
+    def test_feasible_gang_binds_exactly_at_probed_nodes(self, plane_factory):
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        _run(cache)  # publishes the lease for the frozen state
+        resp = _probe(qp, {
+            "queue": "default", "count": 3,
+            "requests": {"cpu": 1500, "memory": 2 * GiB},
+        })
+        assert resp["feasible"] and resp["committed"]
+        assert all(n is not None for n in resp["nodes"])
+
+        # now ACTUALLY submit the same gang and run the real allocate path
+        self._submit_gang(cache, 3, {"cpu": 1500, "memory": 2 * GiB})
+        _run(cache)
+        binds = dict(cache.binder.binds)
+        got = [binds[f"c1/probe-{i}"] for i in range(3)]
+        assert got == resp["nodes"], (
+            "probe promised member->node placement must bind verbatim"
+        )
+
+    def test_min_available_above_count_cannot_commit(self, plane_factory):
+        """min_available > count is a gang that can never reach readiness:
+        the commit gate must see the REAL value (no clamp to count), so
+        committed is false — matching the real gang discard, which reverts
+        exactly such placements and binds nothing."""
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 2, "min_available": 5,
+            "requests": {"cpu": 500, "memory": GiB},
+        })
+        assert not resp["committed"], (
+            "a 2-member gang with minAvailable=5 must never probe committed"
+        )
+        # oracle: the real submission's gang discard binds nothing
+        self._submit_gang(cache, 2, {"cpu": 500, "memory": GiB},
+                          min_member=5)
+        _run(cache)
+        assert not any(k.startswith("c1/probe-")
+                       for k in dict(cache.binder.binds)), (
+            "committed gang discard must revert the under-min placement"
+        )
+
+    def test_pure_tie_break_case_matches(self, plane_factory):
+        """Identical nodes: placement is decided ENTIRELY by the per-(row,
+        node) tie hash — the peek_task_rows row oracle is what makes the
+        probe land on the committed solve's nodes."""
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node(f"t{i}", cpu=8000, mem=16 * GiB)
+                   for i in range(6)],
+        )
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 4,
+            "requests": {"cpu": 1000, "memory": GiB},
+        })
+        assert resp["feasible"]
+        self._submit_gang(cache, 4, {"cpu": 1000, "memory": GiB})
+        _run(cache)
+        binds = dict(cache.binder.binds)
+        assert [binds[f"c1/probe-{i}"] for i in range(4)] == resp["nodes"]
+
+    def test_infeasible_reason_matches_committed_fit_errors(
+            self, plane_factory):
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 1,
+            "requests": {"cpu": 1000, "memory": GiB},
+            "node_selector": {"zone": "nowhere"},
+        })
+        assert not resp["feasible"]
+        assert resp["unplaced"] == 1
+
+        self._submit_gang(cache, 1, {"cpu": 1000, "memory": GiB},
+                          selector={"zone": "nowhere"})
+        _run(cache)
+        assert "c1/probe-0" not in dict(cache.binder.binds)
+        job = next(j for j in cache.jobs.values() if j.name == "probe-pg")
+        (fe,) = job.nodes_fit_errors.values()
+        committed = dict(fe._hist)
+        assert resp["fit_errors"] == committed
+
+    def test_resource_infeasible_reason_matches(self, plane_factory):
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 1,
+            "requests": {"cpu": 64000, "memory": GiB},
+        })
+        assert not resp["feasible"]
+        self._submit_gang(cache, 1, {"cpu": 64000, "memory": GiB})
+        _run(cache)
+        job = next(j for j in cache.jobs.values() if j.name == "probe-pg")
+        (fe,) = job.nodes_fit_errors.values()
+        committed = dict(fe._hist)
+        assert resp["fit_errors"] == committed
+
+    def test_eviction_probe_matches_committed_preempt(self, plane_factory):
+        """The high-priority starved-gang scenario (TestPreemptAction):
+        the probe's hypothetical eviction set must equal what the real
+        preempt action then evicts, and the claim node must match."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=1,
+                         queue="default"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+            ],
+        )
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 1, "priority": 100,
+            "requests": {"cpu": 1000, "memory": GiB},
+            "evictions": True,
+        })
+        assert not resp["feasible"]  # node is full — no idle placement
+        ev = resp["evictions"]
+        assert ev["covered"]
+        assert ev["claim_nodes"] == ["n1"]
+        assert len(ev["victims"]) == 1 and ev["victims"][0].startswith("c1/low-")
+
+        self._submit_gang(cache, 1, {"cpu": 1000, "memory": GiB},
+                          priority=100)
+        _run(cache, names=("allocate", "preempt"))
+        assert sorted(cache.evictor.evicts) == ev["victims"]
+
+    def test_no_eviction_when_gang_would_break(self, plane_factory):
+        """gang slack: victims below their job's minAvailable are off
+        limits — probe and committed preempt agree on the refusal."""
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=2,
+                         queue="default"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+            ],
+        )
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {
+            "queue": "default", "count": 1, "priority": 100,
+            "requests": {"cpu": 1000, "memory": GiB},
+            "evictions": True,
+        })
+        assert resp["evictions"]["victims"] == []
+        assert not resp["evictions"]["covered"]
+
+        self._submit_gang(cache, 1, {"cpu": 1000, "memory": GiB},
+                          priority=100)
+        _run(cache, names=("allocate", "preempt"))
+        assert cache.evictor.evicts == []
+
+    def test_admission_verdict_mirrors_enqueue_capability(
+            self, plane_factory):
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        ok = _probe(qp, {
+            "queue": "default", "count": 1,
+            "requests": {"cpu": 100, "memory": GiB},
+            "min_resources": {"cpu": 2000, "memory": 2 * GiB},
+        })
+        assert ok["enqueue_admitted"]
+        # cluster total cpu = 38000, ×1.2 = 45600; used = 15000 → idle 30600
+        too_big = _probe(qp, {
+            "queue": "default", "count": 1,
+            "requests": {"cpu": 100, "memory": GiB},
+            "min_resources": {"cpu": 99000},
+        })
+        assert not too_big["enqueue_admitted"]
+
+    def test_idle_and_empty_cluster_still_serve(self, plane_factory):
+        """Serving deployments publish a lease even when the cycle has
+        nothing to solve — an idle cluster is exactly when capacity
+        planning what-ifs arrive."""
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node("i0", cpu=4000, mem=8 * GiB)],
+        )
+        qp = plane_factory(cache)
+        _run(cache)  # no jobs at all
+        resp = _probe(qp, {"queue": "default", "count": 1,
+                           "requests": {"cpu": 1000, "memory": GiB}})
+        assert resp["feasible"] and resp["nodes"] == ["i0"]
+        # a steadily idle cluster republishes only when ingest moves the
+        # version — the snapshot rebuild is paid once, not every period
+        published = qp.broker.published
+        _run(cache)
+        assert qp.broker.published == published
+        again = _probe(qp, {"queue": "default", "count": 1,
+                            "requests": {"cpu": 1000, "memory": GiB}})
+        assert again["nodes"] == ["i0"]
+        assert again["snapshot_version"] == resp["snapshot_version"]
+
+    def test_request_validation(self, plane_factory):
+        cache = self._heterogeneous_cache()
+        qp = plane_factory(cache)
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 0})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 10_000})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "requests": "not-a-map"})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "requests": {"cpu": "abc"}})
+        # malformed per-request fields must 400 at submit — never inside
+        # the batch flush where they would fail the whole window
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "priority": "high"})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "tolerations": "not-a-list"})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "tolerations": [{"bogus": 1}]})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "min_resources": {"cpu": "abc"}})
+        # i32-overflowing integers must 400 here too — inside the flush
+        # they would OverflowError the batch encode and 500 the window
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "min_available": 2**40})
+        with pytest.raises(WhatifError):
+            qp.submit({"count": 1, "priority": 2**40})
+
+
+class TestPeekTaskRows:
+    def test_peek_matches_alloc_order_across_free_and_growth(self):
+        """peek(k) must predict alloc() exactly — free-list LIFO first,
+        then ascending grown rows — or the probe's tie-hash oracle drifts
+        from the rows a submitted gang actually lands on."""
+        from kube_batch_tpu.api.columns import _Axis
+
+        ax = _Axis(floor=4)
+        for _ in range(2):
+            ax.alloc()
+        ax.free(0)  # freed row returns LIFO
+        want = ax.peek(8)  # crosses the growth boundary (cap=4)
+        got = []
+        for _ in range(8):
+            row = ax.alloc()
+            if row is None:  # the ColumnStore growth path
+                ax.on_grown(ax.grown_cap())
+                row = ax.alloc()
+            got.append(row)
+        assert want == got
+
+
+# ==========================================================================
+# lease consistency — concurrent with a mutating cycle
+# ==========================================================================
+
+
+def _mk_lease(version, snap="snap"):
+    return SnapshotLease(
+        snap=snap, meta=None, version=version, config=None,
+        evict_config=None, mesh=None, probe_rows=(), queue_rows={},
+    )
+
+
+class TestLeaseBroker:
+    def test_stale_publish_ignored(self):
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(5))
+        broker.publish(_mk_lease(3))  # stale publisher — dropped
+        assert broker.current().version == 5
+        broker.publish(_mk_lease(6))
+        assert broker.current().version == 6
+
+    def test_current_times_out_without_publisher(self):
+        broker = LeaseBroker()
+        t0 = time.monotonic()
+        assert broker.current(timeout=0.05) is None
+        assert time.monotonic() - t0 < 5
+
+    def test_swap_guard_excludes_dispatch(self):
+        """A probe dispatch must never overlap the resident swap — the
+        no-torn-read guarantee on donating backends."""
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(1))
+        order = []
+        in_swap = threading.Event()
+        release = threading.Event()
+
+        def swapper():
+            with broker.swap_guard():
+                order.append("swap_start")
+                in_swap.set()
+                release.wait(timeout=5)
+                order.append("swap_end")
+
+        t = threading.Thread(target=swapper)
+        t.start()
+        assert in_swap.wait(timeout=5)
+        threading.Timer(0.05, release.set).start()
+        with broker.dispatch(timeout=5):
+            order.append("dispatch")
+        t.join(timeout=5)
+        assert order == ["swap_start", "swap_end", "dispatch"]
+
+    def test_swap_guard_retires_lease_on_donating_backends(self, monkeypatch):
+        from kube_batch_tpu.serve import lease as lease_mod
+
+        monkeypatch.setattr(lease_mod, "_donation_active", lambda: True)
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(1))
+        with broker.swap_guard():
+            assert broker.current() is None  # buffers about to be donated
+        assert broker.retired == 1
+        broker.publish(_mk_lease(2))
+        assert broker.current().version == 2
+
+    def test_swap_guard_keeps_lease_on_cpu(self, monkeypatch):
+        from kube_batch_tpu.serve import lease as lease_mod
+
+        monkeypatch.setattr(lease_mod, "_donation_active", lambda: False)
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(1))
+        with broker.swap_guard():
+            pass
+        assert broker.current().version == 1
+        assert broker.retired == 0
+
+    def test_donating_swap_waits_for_inflight_dispatch(self, monkeypatch):
+        """A dispatch's device round-trip counts as an in-flight READER:
+        a donating swap must wait it out before invalidating the buffers
+        (the lock itself is no longer held across the round-trip)."""
+        from kube_batch_tpu.serve import lease as lease_mod
+
+        monkeypatch.setattr(lease_mod, "_donation_active", lambda: True)
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(1))
+        order = []
+        reading = threading.Event()
+        release = threading.Event()
+
+        def reader():
+            with broker.dispatch(timeout=5) as lease:
+                assert lease is not None
+                order.append("read_start")
+                reading.set()
+                release.wait(timeout=5)
+                order.append("read_end")
+
+        t = threading.Thread(target=reader)
+        t.start()
+        assert reading.wait(timeout=5)
+        threading.Timer(0.05, release.set).start()
+        with broker.swap_guard():
+            order.append("swap")
+        t.join(timeout=5)
+        assert order == ["read_start", "read_end", "swap"]
+
+    def test_publish_never_blocks_behind_dispatch(self):
+        """The broker lock is bookkeeping-only: a publish lands while a
+        dispatch's (slow) device round-trip is still in flight."""
+        broker = LeaseBroker()
+        broker.publish(_mk_lease(1))
+        in_read = threading.Event()
+        release = threading.Event()
+
+        def reader():
+            with broker.dispatch(timeout=5):
+                in_read.set()
+                release.wait(timeout=5)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        assert in_read.wait(timeout=5)
+        broker.publish(_mk_lease(2))  # must not deadlock behind the reader
+        assert broker.current().version == 2
+        release.set()
+        t.join(timeout=5)
+
+
+class TestLeaseUnderChurn:
+    def test_versions_monotonic_and_answers_valid_under_live_cycles(self):
+        """Whatifs served WHILE cycles mutate the cache: every answer
+        carries a valid version token, tokens never regress, and every
+        response decodes cleanly (no torn snapshot)."""
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node(f"c{i}", cpu=8000, mem=16 * GiB)
+                   for i in range(8)],
+        )
+        qp = QueryPlane(cache, max_batch=4, window_s=0.001,
+                        start_thread=True)
+        try:
+            _run(cache)
+            stop = threading.Event()
+            seen: list = []
+            errors: list = []
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        fut = qp.submit({
+                            "queue": "default", "count": 2,
+                            "requests": {"cpu": 500, "memory": GiB},
+                        })
+                        resp = fut.result(timeout=30)
+                        assert isinstance(resp["feasible"], bool)
+                        assert len(resp["nodes"]) == 2
+                        seen.append(resp["snapshot_version"])
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+                        return
+
+            threads = [threading.Thread(target=client) for _ in range(3)]
+            for t in threads:
+                t.start()
+            serial = itertools.count()
+            for _ in range(6):  # churning cycles concurrent with serving
+                j = next(serial)
+                cache.add_pod_group(PodGroup(
+                    name=f"churn{j}", namespace="w", min_member=1,
+                    queue="default"))
+                cache.add_pod(Pod(
+                    name=f"churn{j}-0", namespace="w",
+                    requests={"cpu": 250.0, "memory": float(GiB)},
+                    annotations={GROUP_NAME_ANNOTATION: f"churn{j}"},
+                    phase=PodPhase.PENDING, creation_index=50_000 + j,
+                ))
+                _run(cache)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert seen, "clients never got an answer"
+            published = qp.broker.current().version
+            assert max(seen) <= published
+            # within each client the token sequence is non-decreasing —
+            # interleave-safe because each client appends its own results
+            # sequentially; global max-so-far must also never regress
+            hi = 0
+            for v in seen:
+                assert v >= 0
+                hi = max(hi, v)
+            assert hi == max(seen)
+        finally:
+            qp.close()
+
+    def test_publish_failure_degrades_serving_not_cycle(self, monkeypatch):
+        """A broken query plane must never take the scheduling cycle down
+        (the write path outranks serving)."""
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node("d0", cpu=4000, mem=8 * GiB)],
+        )
+        qp = QueryPlane(cache, start_thread=False)
+        try:
+            monkeypatch.setattr(
+                qp, "publish_session",
+                lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+            )
+            cache.add_pod_group(PodGroup(
+                name="pg", namespace="c1", min_member=1, queue="default"))
+            cache.add_pod(build_pod(
+                "c1", "p0", None, PodPhase.PENDING,
+                {"cpu": 1000, "memory": GiB}, group_name="pg"))
+            _run(cache)  # must not raise
+            assert dict(cache.binder.binds)["c1/p0"] == "d0"
+        finally:
+            qp.close()
+
+    def test_swapping_actions_republish_retired_lease(
+            self, plane_factory, monkeypatch):
+        """On donating backends EVERY resident swap retires the lease —
+        and reclaim/backfill/preempt all swap AFTER allocate publishes.
+        Each swapping action must republish right after its dispatch, so a
+        full pipeline cycle ends with a LIVE lease instead of leaving
+        serving dark until the next cycle's allocate."""
+        from kube_batch_tpu.serve import lease as lease_mod
+
+        monkeypatch.setattr(lease_mod, "_donation_active", lambda: True)
+        # full node of low-priority RUNNING work + a starved high-priority
+        # gang: allocate can't place it, so preempt dispatches its solve
+        # (a second resident swap after allocate's publish)
+        cache = build_cache(
+            queues=["default"],
+            pod_groups=[
+                PodGroup(name="low", namespace="c1", min_member=1,
+                         queue="default"),
+                PodGroup(name="hi", namespace="c1", min_member=1,
+                         queue="default"),
+            ],
+            nodes=[build_node("n1", cpu=2000, mem=4 * GiB, pods=10)],
+            pods=[
+                build_pod("c1", "low-1", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "low-2", "n1", PodPhase.RUNNING,
+                          {"cpu": 1000, "memory": GiB}, group_name="low"),
+                build_pod("c1", "hi-0", None, PodPhase.PENDING,
+                          {"cpu": 1000, "memory": GiB}, group_name="hi",
+                          priority=100),
+            ],
+        )
+        qp = plane_factory(cache)
+        _run(cache, names=("enqueue", "reclaim", "allocate", "preempt"))
+        # preempt's swap retired allocate's publish... and republished
+        assert qp.broker.retired >= 1, "scenario never exercised retirement"
+        lease = qp.broker.current()
+        assert lease is not None, (
+            "query plane left leaseless after the cycle's last swap"
+        )
+        # ...and the republished lease actually serves (CPU buffers are
+        # still valid — only the broker's donation gate was patched)
+        resp = _probe(qp, {"queue": "default", "count": 1,
+                           "requests": {"cpu": 1000, "memory": GiB}})
+        assert resp["snapshot_version"] == lease.version
+
+
+# ==========================================================================
+# micro-batcher — stubbed clock
+# ==========================================================================
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self):
+        return self.t
+
+
+class TestMicroBatcher:
+    def _mk(self, flushed, **kw):
+        clock = FakeClock()
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("window_s", 0.010)
+        kw.setdefault("max_queue", 8)
+        b = MicroBatcher(lambda batch: flushed.append(batch), clock=clock,
+                        start_thread=False, **kw)
+        return b, clock
+
+    def test_deadline_flush(self):
+        flushed = []
+        b, clock = self._mk(flushed)
+        b.submit("r1")
+        assert b.tick() == 0          # window not elapsed
+        clock.t = 0.009
+        assert b.tick() == 0
+        clock.t = 0.010               # deadline from FIRST enqueue
+        assert b.tick() == 1
+        assert [r for r, _f in flushed[0]] == ["r1"]
+
+    def test_bucket_fill_flushes_immediately(self):
+        flushed = []
+        b, clock = self._mk(flushed)
+        for i in range(4):
+            b.submit(f"r{i}")
+        assert b.tick() == 4          # bucket full — no window wait
+        assert b.depth() == 0
+
+    def test_oversize_burst_drains_in_buckets(self):
+        flushed = []
+        b, clock = self._mk(flushed)
+        for i in range(7):
+            b.submit(f"r{i}")
+        assert b.tick() == 4
+        clock.t = 1.0
+        assert b.tick() == 3
+        assert [len(x) for x in flushed] == [4, 3]
+
+    def test_overflow_rejects_immediately(self):
+        flushed = []
+        b, clock = self._mk(flushed, max_queue=2)
+        f1, f2 = b.submit("a"), b.submit("b")
+        f3 = b.submit("c")            # over capacity — shed, don't buffer
+        assert isinstance(f3.exception(timeout=1), QueueFull)
+        assert b.rejected == 1
+        assert not f1.done() and not f2.done()  # accepted, still pending
+        clock.t = 1.0
+        assert b.tick() == 2
+
+    def test_flush_failure_fails_that_batch_only(self):
+        calls = []
+
+        def flaky(batch):
+            calls.append(batch)
+            if len(calls) == 1:
+                raise RuntimeError("dispatch exploded")
+
+        clock = FakeClock()
+        b = MicroBatcher(flaky, max_batch=2, window_s=0.01, max_queue=8,
+                        clock=clock, start_thread=False)
+        f1 = b.submit("a")
+        clock.t = 1.0
+        b.tick()
+        assert isinstance(f1.exception(timeout=1), RuntimeError)
+        f2 = b.submit("b")
+        clock.t = 2.0
+        b.tick()
+        assert len(calls) == 2  # the batcher kept serving
+
+    def test_stop_drains_pending_futures(self):
+        flushed = []
+        clock = FakeClock()
+        b = MicroBatcher(lambda batch: flushed.append(batch), max_batch=4,
+                        window_s=10.0, max_queue=8, clock=clock,
+                        start_thread=True)
+        fut = b.submit("late")
+        b.stop()
+        assert isinstance(fut.exception(timeout=5), QueueFull)
+        assert b.submit("after-stop").exception(timeout=1) is not None
+
+
+# ==========================================================================
+# sharded probe — bit-exact vs single device, both impls
+# ==========================================================================
+
+
+class TestShardedProbe:
+    @pytest.fixture(scope="class")
+    def frozen(self):
+        """A nearly-full cluster with RUNNING load: one allocate cycle
+        binds the synthetic gangs, the binds are promoted to RUNNING, and
+        the running podgroups relax to min_member=1 so victims carry gang
+        slack — without it every gang sits exactly at minAvailable and the
+        eviction probe (correctly) refuses every victim."""
+        import dataclasses
+
+        from kube_batch_tpu.actions.allocate import (
+            build_session_snapshot,
+            session_allocate_config,
+        )
+        from kube_batch_tpu.testing.synthetic import synthetic_cluster
+
+        cache = synthetic_cluster(n_tasks=400, n_nodes=16, gang_size=4,
+                                  n_queues=2, seed=11)
+        _run(cache)
+        for key, node in sorted(cache.binder.binds.items()):
+            cache.update_pod(dataclasses.replace(
+                cache.pods[key], phase=PodPhase.RUNNING, node_name=node))
+        for _uid, job in sorted(cache.jobs.items()):
+            if job.pod_group is not None:
+                cache.update_pod_group(
+                    dataclasses.replace(job.pod_group, min_member=1))
+        ssn = open_session(cache, CONF.tiers)
+        try:
+            snap, meta = build_session_snapshot(ssn)
+            config = session_allocate_config(ssn)._replace(use_pallas=False)
+        finally:
+            close_session(ssn)
+        return snap, config
+
+    def _batch(self, snap, seed=0):
+        from kube_batch_tpu.ops.probe import ProbeBatch
+
+        rng = np.random.default_rng(seed)
+        T, R = snap.task_req.shape
+        W = snap.task_sel_bits.shape[1]
+        Wt = snap.task_tol_bits.shape[1]
+        B, G = 6, 8
+        req = np.zeros((B, G, R), np.float32)
+        valid = np.zeros((B, G), bool)
+        for b in range(B):
+            n = int(rng.integers(1, G + 1))
+            valid[b, :n] = True
+            # mix: small (feasible), large (infeasible), and node-filling
+            # (feasible only via eviction) asks
+            req[b, :n, 0] = float(rng.choice([250.0, 3000.0, 7500.0]))
+            req[b, :n, 1] = float(2 ** 30)
+        batch = ProbeBatch(
+            req=req, valid=valid,
+            min_avail=np.maximum(valid.sum(1), 1).astype(np.int32),
+            queue=(np.arange(B) % 2).astype(np.int32),
+            prio=np.full(B, 50, np.int32),
+            sel_bits=np.zeros((B, W), np.uint32),
+            sel_impossible=np.zeros(B, bool),
+            tol_bits=np.zeros((B, Wt), np.uint32),
+            min_res=np.zeros((B, R), np.float32),
+            has_min_res=np.zeros(B, bool),
+        )
+        rows = np.arange(T, T + G, dtype=np.int32)
+        return batch, rows
+
+    @pytest.mark.slow
+    def test_sharded_probe_bit_exact_both_impls(self, frozen):
+        import jax
+
+        from kube_batch_tpu.ops.eviction import EvictConfig
+        from kube_batch_tpu.ops.probe import probe_solve
+        from kube_batch_tpu.parallel.mesh import (
+            make_mesh,
+            probe_solve_fn,
+            snapshot_shardings,
+        )
+
+        snap, config = frozen
+        batch, rows = self._batch(snap)
+        evc = EvictConfig(mode="preempt", victim_gang=True,
+                          victim_conformance=True)
+        single = probe_solve(snap, batch, rows, config, evc, True)
+        assert bool(np.asarray(single.victims).any()), (
+            "fixture must exercise the eviction probe"
+        )
+        mesh = make_mesh(len(jax.devices()))
+        dev = jax.device_put(snap, snapshot_shardings(mesh))
+        for impl in ("shard_map", "pjit"):
+            fn = probe_solve_fn(mesh, config, evc, True, impl=impl)
+            with mesh:
+                res = fn(dev, batch, rows)
+            for f in single._fields:
+                assert np.array_equal(
+                    np.asarray(getattr(single, f)),
+                    np.asarray(getattr(res, f)),
+                ), (impl, f)
+
+    @pytest.mark.slow
+    def test_no_retrace_across_batch_fill(self, frozen):
+        from kube_batch_tpu.ops.eviction import EvictConfig
+        from kube_batch_tpu.ops.probe import probe_solve
+        from kube_batch_tpu.utils import jitstats
+
+        snap, config = frozen
+        evc = EvictConfig(mode="preempt")
+        b1, rows = self._batch(snap, seed=1)
+        probe_solve(snap, b1, rows, config, evc, False)  # warmup
+        before = jitstats.compile_counts().get("probe_solve", 0)
+        for seed in (2, 3, 4):  # varying fill, same (B, G) buckets
+            bn, rows = self._batch(snap, seed=seed)
+            probe_solve(snap, bn, rows, config, evc, False)
+        after = jitstats.compile_counts().get("probe_solve", 0)
+        assert after == before, "probe retraced across batch fill"
+
+
+# ==========================================================================
+# flush partitioning + pre-warm (serving-latency hygiene)
+# ==========================================================================
+
+
+class TestFlushPartitionAndPrewarm:
+    def _cache(self):
+        return build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node(f"p{i}", cpu=8000, mem=16 * GiB)
+                   for i in range(4)],
+        )
+
+    def test_mixed_window_splits_by_evictions_flag(self, plane_factory):
+        """One --evictions request in a window must not run the eviction
+        program for the co-batched plain probes: the flush partitions the
+        window into (plain, evictions) sub-dispatches against the SAME
+        lease."""
+        cache = self._cache()
+        qp = plane_factory(cache, max_batch=8)
+        _run(cache)
+        plain = qp.submit({"queue": "default", "count": 1,
+                           "requests": {"cpu": 500, "memory": GiB}})
+        evict = qp.submit({"queue": "default", "count": 1,
+                           "requests": {"cpu": 500, "memory": GiB},
+                           "evictions": True})
+        d0 = qp.dispatches
+        qp.batcher.tick(now=qp.batcher.clock.monotonic() + 1e6)
+        r_plain = plain.result(timeout=120)
+        r_evict = evict.result(timeout=120)
+        assert qp.dispatches == d0 + 2, (
+            "mixed window must split into exactly two dispatches"
+        )
+        assert "evictions" not in r_plain
+        assert "evictions" in r_evict
+        # both halves answered against the same lease
+        assert r_plain["snapshot_version"] == r_evict["snapshot_version"]
+
+    def test_uniform_window_stays_one_dispatch(self, plane_factory):
+        cache = self._cache()
+        qp = plane_factory(cache, max_batch=8)
+        _run(cache)
+        futs = [qp.submit({"queue": "default", "count": 1,
+                           "requests": {"cpu": 250, "memory": GiB}})
+                for _ in range(4)]
+        d0 = qp.dispatches
+        qp.batcher.tick(now=qp.batcher.clock.monotonic() + 1e6)
+        for f in futs:
+            assert f.result(timeout=120)["feasible"]
+        assert qp.dispatches == d0 + 1
+
+    def test_cancelled_futures_skipped_at_flush(self, plane_factory):
+        """A handler that times out cancels its future (cmd/server.py):
+        the flush must not spend a dispatch on a fully-abandoned window,
+        and a partially-abandoned one must not count the abandoned request
+        in the verdict counters (it would mask an outage as successes)."""
+        cache = self._cache()
+        qp = plane_factory(cache, max_batch=8)
+        _run(cache)
+        # fully abandoned window: no dispatch at all
+        f0 = qp.submit({"queue": "default", "count": 1,
+                        "requests": {"cpu": 500, "memory": GiB}})
+        assert f0.cancel()
+        d0 = qp.dispatches
+        qp.batcher.tick(now=qp.batcher.clock.monotonic() + 1e6)
+        assert qp.dispatches == d0, "abandoned window must not dispatch"
+        # partially abandoned: live request served, abandoned one uncounted
+        gone = qp.submit({"queue": "default", "count": 1,
+                          "requests": {"cpu": 500, "memory": GiB}})
+        live = qp.submit({"queue": "default", "count": 1,
+                          "requests": {"cpu": 500, "memory": GiB}})
+        assert gone.cancel()
+        served0 = qp.requests_served
+        qp.batcher.tick(now=qp.batcher.clock.monotonic() + 1e6)
+        assert live.result(timeout=120)["feasible"]
+        assert qp.requests_served == served0 + 1
+
+    def test_prewarm_compiles_floor_bucket_off_request_path(
+            self, plane_factory):
+        from kube_batch_tpu.utils import jitstats
+
+        cache = self._cache()
+        qp = plane_factory(cache, prewarm=True)
+        _run(cache)  # publish kicks the warm thread
+        assert qp._warm_threads, "publish must kick a pre-warm thread"
+        for t in qp._warm_threads:
+            t.join(timeout=300)
+        # the warm dispatch compiled the serving floor bucket but stayed
+        # out of the serving counters
+        assert qp.dispatches == 0
+        compiles0 = jitstats.compile_counts().get("probe_solve", 0)
+        assert compiles0 >= 1
+        # first REAL request rides the warm cache: no retrace
+        resp = _probe(qp, {"queue": "default", "count": 2,
+                           "requests": {"cpu": 500, "memory": GiB}})
+        assert resp["feasible"]
+        assert jitstats.compile_counts().get("probe_solve", 0) == compiles0
+        # a republish of the same lease shape must not warm again
+        lease = qp.broker.current()
+        qp._maybe_prewarm(lease)
+        assert len(qp._warm_threads) == 1
+
+
+# ==========================================================================
+# HTTP surface — POST /v1/whatif + metrics counters
+# ==========================================================================
+
+
+class TestWhatifHTTP:
+    def _post(self, port, body, path="/v1/whatif"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def test_end_to_end_with_metrics(self):
+        from urllib.error import HTTPError
+
+        from kube_batch_tpu.cmd.server import AdminServer
+        from kube_batch_tpu.metrics import metrics as M
+
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node(f"h{i}", cpu=8000, mem=16 * GiB)
+                   for i in range(4)],
+        )
+        # generous dispatch timeout: the handler's future wait is keyed to
+        # it, and the FIRST probe at this (B, G) bucket pays a cold compile
+        qp = QueryPlane(cache, max_batch=8, window_s=0.002,
+                        dispatch_timeout=90, start_thread=True)
+        srv = AdminServer(cache, port=0, query_plane=qp)
+        srv.start()
+        try:
+            _run(cache)
+            req0 = sum(M.WHATIF_REQUESTS._values.values())
+            disp0 = sum(M.WHATIF_DISPATCHES._values.values())
+            ok = self._post(srv.port, {
+                "queue": "default", "count": 2,
+                "requests": {"cpu": 1000, "memory": GiB},
+            })
+            assert ok["feasible"] and len(ok["nodes"]) == 2
+            bad = self._post(srv.port, {
+                "queue": "default", "count": 2,
+                "requests": {"cpu": 990000, "memory": GiB},
+            })
+            assert not bad["feasible"] and bad["fit_errors"]
+            assert ok["snapshot_version"] == bad["snapshot_version"]
+
+            with pytest.raises(HTTPError) as err:
+                self._post(srv.port, {"count": -2})
+            assert err.value.code == 400
+
+            assert sum(M.WHATIF_REQUESTS._values.values()) == req0 + 2
+            assert sum(M.WHATIF_DISPATCHES._values.values()) > disp0
+            rendered = M.render_prometheus()
+            assert "volcano_whatif_requests_total" in rendered
+            assert "volcano_whatif_batch_size" in rendered
+        finally:
+            srv.stop()
+            qp.close()
+
+    def test_503_when_plane_missing_or_cold(self):
+        from urllib.error import HTTPError
+
+        from kube_batch_tpu.cmd.server import AdminServer
+
+        cache = build_cache(
+            queues=[Queue(name="default", weight=1)],
+            nodes=[build_node("x0", cpu=4000, mem=8 * GiB)],
+        )
+        srv = AdminServer(cache, port=0)  # no query plane wired
+        srv.start()
+        try:
+            with pytest.raises(HTTPError) as err:
+                self._post(srv.port, {"count": 1, "requests": {"cpu": 1}})
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+
+        qp = QueryPlane(cache, start_thread=True, dispatch_timeout=0.05)
+        srv = AdminServer(cache, port=0, query_plane=qp)
+        srv.start()
+        try:
+            # no cycle has run — no lease published yet
+            with pytest.raises(HTTPError) as err:
+                self._post(srv.port, {"count": 1, "requests": {"cpu": 1}})
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+            qp.close()
