@@ -253,8 +253,11 @@ class AllocateAction(Action):
         # (allocate.go:151-155 builds FitErrors only for failing tasks).
         # It is DISPATCHED here but read back only after the host replay:
         # jax dispatch is async, so the device grinds the histogram while
-        # the host replays the assignment — the solve/replay overlap that
-        # extends the async-binder seam one stage earlier into the cycle.
+        # the host replays the assignment.  This is the IN-CYCLE instance
+        # of the cycle pipeline's general stage-overlap mechanism (the
+        # scheduler module's staged loop overlaps the close-time status
+        # flush and the binder drain with the NEXT cycle the same way) —
+        # the async-binder seam extended one stage earlier into the cycle.
         # Timed under its own key (dispatch + post-replay readback) so
         # failure cycles don't read as a replay-phase regression.
         t_fit0 = telemetry.perf_counter()

@@ -55,6 +55,10 @@ DEFAULT_MODULE_PREFIXES = (
     "kube_batch_tpu.cmd.server",
     "kube_batch_tpu.k8s.watch",
     "kube_batch_tpu.metrics",
+    # the pipelined loop's locks (the CycleTrigger condition guard): the
+    # dirty-advance hook notifies UNDER the cache's big lock, so the
+    # big→trigger edge — and any future reverse nesting — must be observed
+    "kube_batch_tpu.scheduler",
 )
 
 _REAL_LOCK = threading.Lock

@@ -38,6 +38,7 @@ from kube_batch_tpu.cache.fake import (
     FakeVolumeBinder,
 )
 from kube_batch_tpu.k8s.transport import CircuitOpenError
+from kube_batch_tpu.utils import telemetry
 from kube_batch_tpu.utils.assertions import graft_assert
 
 logger = logging.getLogger("kube_batch_tpu")
@@ -107,6 +108,28 @@ class EventLog:
 
     def __bool__(self) -> bool:
         return bool(self._entries)
+
+
+class StatusFlush:
+    """One cycle's staged status egress — the value-snapshotted handoff
+    between the close-derive stage and the writeback stage (see
+    SchedulerCache.stage_status_flush / run_status_flush).  Carries no live
+    session or job references by construction: PodGroup CLONES to write,
+    pre-rendered event/condition ops, decided queue writes, the queue shed
+    count, and the degraded verdict taken at stage time."""
+
+    __slots__ = ("to_write", "ops", "qwrites", "shed_queues", "degraded")
+
+    def __init__(self, to_write, ops, qwrites, shed_queues, degraded):
+        self.to_write = to_write
+        self.ops = ops
+        self.qwrites = qwrites
+        self.shed_queues = shed_queues
+        self.degraded = degraded
+
+    def __bool__(self) -> bool:
+        return bool(self.to_write or self.ops or self.qwrites
+                    or self.shed_queues)
 
 
 class _ScheduledBatch:
@@ -218,6 +241,10 @@ class SchedulerCache:
         # the scheduling cycle; failures re-enter via resync_task
         self._dispatch_pool = None
         self._dispatch_futures: List = []
+        # leaf mutex over the futures list: the writeback worker's
+        # flush_binds races the cycle thread's _dispatch_async in the
+        # pipelined loop (never held across a join or a binder call)
+        self._dispatch_mu = threading.Lock()
         # close-time status-writeback pool (jobUpdater's 16 workers,
         # job_updater.go:18) — created lazily for parallel-safe updaters
         self._status_pool = None
@@ -232,6 +259,45 @@ class SchedulerCache:
         # informer snapshot has, without paying the deep clone
         self._session_active = False
         self._deferred: List = []
+        # read-side ingest staging (the pipelined loop's ingest stage): when
+        # enabled, the public ingest surface appends (fn, args) under a small
+        # LEAF lock instead of contending on the big lock, so a watch/ingest
+        # thread never stalls behind a snapshot or replay in progress; the
+        # cycle applies the whole buffer under ONE big-lock acquisition at
+        # its ingest stage (drain_staged_ingest)
+        self._ingest_lock = threading.Lock()
+        self._ingest_staged: List = []
+        self.ingest_staging = False
+        # thread idents currently applying ingest DIRECTLY (the staged
+        # drain, a batched apply): their re-entrant handler calls must
+        # not re-stage.  A SET, not a single slot — the cycle's drain
+        # and a /v1 batch apply can overlap, and a shared slot's
+        # save/restore would clobber the other thread's marker (the
+        # drain would then re-stage its own events and apply nothing).
+        # Adds/discards of own ident only; reads are GIL-atomic.
+        self._direct_apply_threads: set = set()
+        # threads inside the cycle's staged-ingest DRAIN specifically:
+        # their dirty advances must not re-wake the trigger (see
+        # _dirty_advanced) — a subset of the direct appliers
+        self._cycle_drain_threads: set = set()
+        # the event-driven cycle trigger's wake callback (pipeline.py
+        # CycleTrigger.notify): fired on staged ingest arrival and on dirty
+        # version advances that happen outside a session (repair rebuilds,
+        # deferred-ingest application) — never on the cycle's own close-time
+        # status bookkeeping, which would re-trigger every cycle
+        self._ingest_listener = None
+        self.dirty.on_advance = self._dirty_advanced
+        # binder dispatches in flight (pod key → hostname), staged when the
+        # async dispatcher takes a batch and cleared by its ack/failure:
+        # update_pod consults it so a client update arriving between the
+        # dispatch and the ack cannot clobber the in-flight binding (the
+        # pipelined loop overlaps the binder drain with the next cycle's
+        # ingest, which widens that window from ~0 to a whole stage)
+        self._inflight_bind_hosts: Dict[str, str] = {}
+        # pod-arrival timestamps (key → perf_counter) for the arrival→
+        # bind-decision latency histogram; stamped at ingest for pending
+        # unbound owned pods, popped at the bind decision or pod deletion
+        self._arrival_ts: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # exclusive-session gate (no-clone session mode)
@@ -260,6 +326,149 @@ class SchedulerCache:
             self._deferred.append((fn, args))
             return True
         return False
+
+    # ------------------------------------------------------------------
+    # ingest staging + event trigger (the pipelined loop's ingest stage)
+    # ------------------------------------------------------------------
+    def _dirty_advanced(self) -> None:
+        """DirtyTracker version-advance hook: wake the cycle trigger for
+        out-of-session churn (ingest, repair rebuilds, deferred events).
+        In-session advances are the cycle's own bookkeeping — the deferred
+        events that carry real churn re-stamp when they apply at close.
+        The cycle's OWN staged-ingest drain is suppressed too: the session
+        about to open consumes exactly that churn, and re-waking would
+        schedule a guaranteed no-op follow-up cycle after every burst.  A
+        direct batch apply (ingest_batch with staging off) is NOT a drain
+        — its one coalesced advance must wake the loop."""
+        if self._session_active:
+            return
+        if threading.get_ident() in self._cycle_drain_threads:
+            return
+        fn = self._ingest_listener
+        if fn is not None:
+            fn()
+
+    def set_ingest_signal(self, fn) -> None:
+        """Register (or clear, fn=None) the event-trigger wake callback.
+        Must never block: it runs under the cache's big lock from dirty
+        stamps and under the ingest staging lock from _stage."""
+        self._ingest_listener = fn
+
+    def enable_ingest_staging(self) -> None:
+        with self._ingest_lock:
+            self.ingest_staging = True
+
+    def disable_ingest_staging(self) -> None:
+        with self._ingest_lock:
+            self.ingest_staging = False
+        self.drain_staged_ingest()
+
+    def _stage(self, fn, *args) -> bool:
+        """Stage an ingest mutation instead of applying it (True when
+        staged).  OFF by default (one attribute read); the drain thread
+        itself always applies directly (its re-entrant calls must not
+        re-stage).  The wake signal fires OUTSIDE the staging lock so the
+        trigger's condition lock stays unordered against it."""
+        if not self.ingest_staging:
+            return False
+        if threading.get_ident() in self._direct_apply_threads:
+            return False
+        with self._ingest_lock:
+            if not self.ingest_staging:
+                return False
+            self._ingest_staged.append((fn, args))
+        fn2 = self._ingest_listener
+        if fn2 is not None:
+            fn2()
+        return True
+
+    def _note_staged_arrival(self, obj) -> None:
+        """Arrival→decision clocks start at TRUE ingest: a staged pending
+        pod is stamped when it lands in the staging buffer, not when the
+        next cycle's drain applies it — otherwise the latency metric
+        undercounts the stage→drain wait in exactly the mode it exists to
+        measure.  The apply-time stamp in _add_task is conditional on the
+        key being absent, so this earlier stamp survives the drain.
+        Setdefault on a plain dict is GIL-atomic; non-pod kinds no-op."""
+        if isinstance(obj, Pod) and obj.node_name is None:
+            self._arrival_ts.setdefault(obj.key(), telemetry.perf_counter())
+
+    def drain_staged_ingest(self) -> int:
+        """Apply every staged ingest event under ONE big-lock acquisition —
+        the pipeline's ingest stage.  Events apply in arrival order; a bad
+        event logs and is skipped (informer handler semantics)."""
+        with self._ingest_lock:
+            staged, self._ingest_staged = self._ingest_staged, []
+        if not staged:
+            return 0
+        ident = threading.get_ident()
+        nested = ident in self._direct_apply_threads
+        self._direct_apply_threads.add(ident)
+        self._cycle_drain_threads.add(ident)
+        try:
+            with self._lock:
+                for fn, args in staged:
+                    try:
+                        fn(*args)
+                    except Exception:  # noqa: BLE001 — one bad event
+                        logger.exception("staged ingest event failed")
+        finally:
+            self._cycle_drain_threads.discard(ident)
+            if not nested:
+                self._direct_apply_threads.discard(ident)
+        return len(staged)
+
+    def ingest_batch(self, ops) -> int:
+        """Apply ``[(fn, obj)]`` ingest operations under one lock
+        acquisition and ONE dirty-version advance (the batched ``/v1/*``
+        ingest path: high-QPS clients pay a single lock round-trip per
+        batch, and the lease/delta version token moves once).  With
+        staging enabled the whole batch stages under one staging-lock
+        acquisition + one wake instead.
+
+        Returns the number of operations APPLIED (staging: accepted for the
+        next cycle's drain).  A handler that raises drops only its own
+        element — callers compare against ``len(ops)`` to detect partial
+        failure."""
+        if not ops:
+            return 0
+        if (self.ingest_staging
+                and threading.get_ident() not in self._direct_apply_threads):
+            with self._ingest_lock:
+                if self.ingest_staging:
+                    self._ingest_staged.extend(
+                        (fn, (obj,)) for fn, obj in ops
+                    )
+                    staged = True
+                else:
+                    staged = False
+            if staged:
+                for _fn, obj in ops:
+                    self._note_staged_arrival(obj)
+                fn2 = self._ingest_listener
+                if fn2 is not None:
+                    fn2()
+                return len(ops)
+        with self._lock:
+            # mark this thread as a direct applier so a handler re-entered
+            # here never re-stages (staging could flip on mid-batch)
+            ident = threading.get_ident()
+            nested = ident in self._direct_apply_threads
+            self._direct_apply_threads.add(ident)
+            self.dirty.hold_version()
+            applied = 0
+            try:
+                for fn, obj in ops:
+                    try:
+                        fn(obj)
+                        applied += 1
+                    except Exception:  # noqa: BLE001 — one bad event
+                        logger.exception("batched ingest event failed")
+            finally:
+                self.dirty.release_version()
+                if not nested:
+                    self._direct_apply_threads.discard(ident)
+        return applied
 
     # ------------------------------------------------------------------
     # background repair loops (cache.go:342-384)
@@ -366,6 +575,9 @@ class SchedulerCache:
         return job
 
     def add_pod(self, pod: Pod) -> None:
+        if self._stage(self.add_pod, pod):
+            self._note_staged_arrival(pod)
+            return
         with self._lock:
             if self._gate(self.add_pod, pod):
                 return
@@ -389,6 +601,10 @@ class SchedulerCache:
         job = self._get_or_create_job(task, pod)
         self.dirty.note_pod(task._key)
         self.dirty.note_job(job.uid)
+        if task.node_name is None and task._key not in self._arrival_ts:
+            # arrival→bind-decision latency clock starts at first ingest of
+            # an unbound pod; kubelet status replays keep the original stamp
+            self._arrival_ts[task._key] = telemetry.perf_counter()
         job.add_task(task)
         self.columns.bind_task(task, job)
         if task.node_name:
@@ -411,15 +627,29 @@ class SchedulerCache:
         without this, a client update raced against the scheduler's own bind
         (or deferred past it by the exclusive-session gate) would clobber the
         placement and the next cycle would double-bind the pod."""
+        if self._stage(self.update_pod, pod):
+            self._note_staged_arrival(pod)
+            return
         with self._lock:
             if self._gate(self.update_pod, pod):
                 return
             stored = self.pods.get(pod.key())
-            if stored is not None and stored.node_name and not pod.node_name:
-                pod.node_name = stored.node_name
+            if stored is not None and not pod.node_name:
+                # an UNACKED async bind counts as a binding too: the
+                # pipelined loop drains the binder behind the next cycle's
+                # ingest, so an update landing in that window must keep the
+                # dispatched placement (the ack or the failure handler
+                # settles it); _dispatch_async clears a failed dispatch's
+                # optimistic stamp
+                pod.node_name = (stored.node_name
+                                 or self._inflight_bind_hosts.get(pod.key()))
             # an external change to a QUARANTINED pod releases it back into
             # the ordinary flow — the rebuild below IS its fresh resync
             self.resync.release(pod.key())
+            # the arrival→decision clock starts at FIRST ingest: a status
+            # replay on a still-pending pod must not reset it through the
+            # delete+add rebuild below
+            t_arr = self._arrival_ts.get(pod.key())
             # the add below would immediately recreate a placeholder the
             # delete retired — keep it alive across an update, or every
             # status event for such a pod flushes the node feature cache
@@ -428,8 +658,12 @@ class SchedulerCache:
                 self._resolve_pod_priority(pod)
                 self.pods[pod.key()] = pod
                 self._add_task(TaskInfo(pod, self.spec), pod)
+                if t_arr is not None and pod.key() in self._arrival_ts:
+                    self._arrival_ts[pod.key()] = t_arr
 
     def delete_pod(self, pod: Pod) -> None:
+        if self._stage(self.delete_pod, pod):
+            return
         with self._lock:
             if self._gate(self.delete_pod, pod):
                 return
@@ -439,6 +673,8 @@ class SchedulerCache:
                            forget_resync: bool = True) -> None:
         self.pods.pop(pod.key(), None)
         self.pod_conditions.pop(pod.key(), None)  # fresh pod ⇒ fresh dedup
+        self._arrival_ts.pop(pod.key(), None)
+        self._inflight_bind_hosts.pop(pod.key(), None)
         if forget_resync:
             # external change/delete: all repair bookkeeping (incl. the
             # quarantine) starts over. The resync pass's OWN delete+add
@@ -488,6 +724,8 @@ class SchedulerCache:
     # ingest: nodes (event_handlers.go:261-360)
     # ------------------------------------------------------------------
     def add_node(self, node: Node) -> None:
+        if self._stage(self.add_node, node):
+            return
         with self._lock:
             if self._gate(self.add_node, node):
                 return
@@ -509,6 +747,8 @@ class SchedulerCache:
         self.add_node(node)
 
     def delete_node(self, name: str) -> None:
+        if self._stage(self.delete_node, name):
+            return
         with self._lock:
             if self._gate(self.delete_node, name):
                 return
@@ -537,6 +777,8 @@ class SchedulerCache:
     # ingest: podgroups (event_handlers.go:362-481)
     # ------------------------------------------------------------------
     def add_pod_group(self, pg: PodGroup) -> None:
+        if self._stage(self.add_pod_group, pg):
+            return
         with self._lock:
             if self._gate(self.add_pod_group, pg):
                 return
@@ -555,6 +797,8 @@ class SchedulerCache:
         self.add_pod_group(pg)
 
     def delete_pod_group(self, key: str) -> None:
+        if self._stage(self.delete_pod_group, key):
+            return
         with self._lock:
             if self._gate(self.delete_pod_group, key):
                 return
@@ -580,6 +824,8 @@ class SchedulerCache:
             logger.error("PodDisruptionBudget %s has no controller; ignored",
                          pdb.name)
             return
+        if self._stage(self.add_pdb, pdb):
+            return
         with self._lock:
             if self._gate(self.add_pdb, pdb):
                 return
@@ -604,6 +850,8 @@ class SchedulerCache:
 
     def delete_pdb(self, pdb) -> None:
         if not pdb.owner:
+            return
+        if self._stage(self.delete_pdb, pdb):
             return
         with self._lock:
             if self._gate(self.delete_pdb, pdb):
@@ -633,6 +881,8 @@ class SchedulerCache:
     # ingest: queues / priority classes (event_handlers.go:597-785)
     # ------------------------------------------------------------------
     def add_queue(self, queue: Queue) -> None:
+        if self._stage(self.add_queue, queue):
+            return
         with self._lock:
             if self._gate(self.add_queue, queue):
                 return
@@ -645,6 +895,8 @@ class SchedulerCache:
         self.add_queue(queue)
 
     def delete_queue(self, name: str) -> None:
+        if self._stage(self.delete_queue, name):
+            return
         with self._lock:
             if self._gate(self.delete_queue, name):
                 return
@@ -658,6 +910,8 @@ class SchedulerCache:
     def add_priority_class(self, pc: PriorityClass) -> None:
         if not self.resolve_priority:
             return  # informer not wired when disabled (cache.go:352,378)
+        if self._stage(self.add_priority_class, pc):
+            return
         with self._lock:
             if self._gate(self.add_priority_class, pc):
                 return
@@ -667,6 +921,8 @@ class SchedulerCache:
                 self.default_priority = pc.value
 
     def delete_priority_class(self, name: str) -> None:
+        if self._stage(self.delete_priority_class, name):
+            return
         with self._lock:
             if self._gate(self.delete_priority_class, name):
                 return
@@ -700,6 +956,14 @@ class SchedulerCache:
             # the right state; the caller (Statement/dispatch) finishes the
             # BINDING transition itself
             pod = self.pods.get(task.key())
+            t0 = (self._arrival_ts.pop(task.key(), None)
+                  if pod is not None else None)
+        if t0 is not None:
+            from kube_batch_tpu import metrics
+
+            metrics.observe_decision_latencies(
+                [(telemetry.perf_counter() - t0) * 1e3]
+            )
         try:
             if pod is not None:
                 self.binder.bind(pod, hostname)
@@ -748,7 +1012,59 @@ class SchedulerCache:
                 staged = [(t, h, t.pod) for t, h in tasks_hosts]
             else:
                 staged = self._bulk_bind_locked(tasks_hosts, job_sums, node_sums)
+            lat_ms = self._note_bind_decisions_locked(staged)
+        if lat_ms:
+            from kube_batch_tpu import metrics
+
+            metrics.observe_decision_latencies(lat_ms)
         self._dispatch_async(staged)
+
+    def _note_bind_decisions_locked(self, staged) -> list:
+        """Mark every staged dispatch in flight (update_pod's unacked-bind
+        guard) and close the arrival→decision latency clocks; returns the
+        ms latencies for the histogram (observed outside the lock)."""
+        now = telemetry.perf_counter()
+        pop_ts = self._arrival_ts.pop
+        inflight = self._inflight_bind_hosts
+        lat_ms = []
+        for task, hostname, pod in staged:
+            if pod is None:
+                continue
+            inflight[task._key] = hostname
+            t0 = pop_ts(task._key, None)
+            if t0 is not None:
+                lat_ms.append((now - t0) * 1e3)
+        return lat_ms
+
+    def _settle_inflight(self, entries, bound: bool) -> None:
+        """Clear in-flight bind markers once the dispatcher settled them.
+        ``entries`` is [(key, pod, hostname)].  For FAILED dispatches, an
+        optimistic stamp that update_pod copied onto a REPLACEMENT pod
+        object is rolled back (the apiserver never bound it) and the pod is
+        marked dirty so the repair rebuild re-derives it as Pending."""
+        from kube_batch_tpu.api.task_info import job_id_for_pod as _jid
+
+        now = telemetry.perf_counter()
+        with self._lock:
+            for key, pod, hostname in entries:
+                if self._inflight_bind_hosts.get(key) == hostname:
+                    del self._inflight_bind_hosts[key]
+                if not bound:
+                    cur = self.pods.get(key)
+                    # the failed pod's original arrival clock was closed at
+                    # its (failed) decision — re-arm it at settle time so
+                    # the repair path's eventual re-decision produces a
+                    # latency sample instead of silently undercounting
+                    # exactly the slow retried binds.  Only for pods still
+                    # IN the store: a pod deleted while its dispatch was in
+                    # flight must not leak a never-popped entry.
+                    if cur is not None:
+                        self._arrival_ts.setdefault(key, now)
+                    if (cur is not None and cur is not pod
+                            and cur.node_name == hostname):
+                        cur.node_name = None
+                        self.dirty.note_pod(key)
+                        self.dirty.note_job(_jid(cur))
 
     def _bulk_bind_locked(self, tasks_hosts, job_sums, node_sums) -> list:
         """The non-exclusive bulk_bind body: apply job/node accounting under
@@ -847,6 +1163,9 @@ class SchedulerCache:
                     # resync/rebuild and stale client updates now see it
                     for pod, hostname in pairs:
                         pod.node_name = hostname
+                    self._settle_inflight(
+                        [(pod.key(), pod, h) for pod, h in pairs], bound=True
+                    )
                     self.events.append_scheduled_batch(staged)
                     if self.resync.has_history():
                         with self._lock:
@@ -860,6 +1179,9 @@ class SchedulerCache:
                     logger.warning(
                         "binder breaker open; parking %d binds for resync",
                         len(pairs))
+                    self._settle_inflight(
+                        [(pod.key(), pod, h) for pod, h in pairs], bound=False
+                    )
                     for task, hostname, pod in staged:
                         if pod is not None:
                             self.resync_task(task, reason="breaker-open")
@@ -867,21 +1189,29 @@ class SchedulerCache:
                 except Exception:  # noqa: BLE001 — retry per-task below
                     logger.exception("bind_many failed; retrying per task")
             breaker_parked = 0
+            acked, failed = [], []
             for task, hostname, pod in staged:
                 try:
                     if pod is not None:
                         self.binder.bind(pod, hostname)
                         pod.node_name = hostname  # binding ack (see above)
+                        acked.append((task._key, pod, hostname))
                         self.events.append(("Scheduled", task._key, hostname))
                         if self.resync.has_history():
                             with self._lock:
                                 self.resync.note_success(task._key)
                 except CircuitOpenError:
                     breaker_parked += 1
+                    failed.append((task._key, pod, hostname))
                     self.resync_task(task, reason="breaker-open")
                 except Exception as e:  # noqa: BLE001 — resyncTask repair path
                     logger.error("bind of %s to %s failed: %s", task._key, hostname, e)
+                    failed.append((task._key, pod, hostname))
                     self.resync_task(task)
+            if acked:
+                self._settle_inflight(acked, bound=True)
+            if failed:
+                self._settle_inflight(failed, bound=False)
             if breaker_parked:
                 logger.warning("binder breaker open; parked %d binds for "
                                "resync", breaker_parked)
@@ -892,15 +1222,31 @@ class SchedulerCache:
             self._dispatch_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="kb-dispatch"
             )
-        self._dispatch_futures = [f for f in self._dispatch_futures if not f.done()]
-        self._dispatch_futures.append(self._dispatch_pool.submit(run))
+        # submit OUTSIDE the mutex: the pool's first submit spawns its
+        # worker thread, and Thread.start blocks on the thread's started
+        # event — a blocking call no lock may be held across (lockdep)
+        fut = self._dispatch_pool.submit(run)
+        with self._dispatch_mu:
+            # leaf mutex: the pipelined loop's writeback worker drains binds
+            # (flush_binds) concurrently with the cycle thread staging the
+            # NEXT cycle's dispatch — an unguarded prune/rebind here could
+            # drop a freshly appended future from tracking
+            self._dispatch_futures = [
+                f for f in self._dispatch_futures if not f.done()
+            ]
+            self._dispatch_futures.append(fut)
 
     def flush_binds(self, timeout: Optional[float] = None) -> None:
         """Wait for every in-flight async binder call — tests and the bench
         use this to observe a deterministic post-cycle state."""
-        for f in list(self._dispatch_futures):
+        with self._dispatch_mu:
+            pending = list(self._dispatch_futures)
+        for f in pending:
             f.result(timeout=timeout)
-        self._dispatch_futures = [f for f in self._dispatch_futures if not f.done()]
+        with self._dispatch_mu:
+            self._dispatch_futures = [
+                f for f in self._dispatch_futures if not f.done()
+            ]
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """(cache.go:404-444)"""
@@ -1106,18 +1452,26 @@ class SchedulerCache:
         """PodScheduled=False condition + FailedScheduling event for one task
         (cache.go:500-525), deduplicated like podConditionHaveUpdate
         (cache.go:151-173)."""
+        self._task_unschedulable_key(task.key(), message)
+
+    def _task_unschedulable_key(self, key: str, message: str,
+                                require_pod: bool = False) -> None:
+        """task_unschedulable by pod key.  ``require_pod=True`` (the
+        pipelined writeback stage) skips the dedup record when the pod has
+        since left the store — a staged condition must not plant a stale
+        dedup entry that would suppress a recreated pod's first write."""
         cond = {
             "type": "PodScheduled",
             "status": "False",
             "reason": "Unschedulable",
             "message": message,
         }
-        key = task.key()
         with self._lock:
             if self.pod_conditions.get(key) == cond:
                 return  # no-op update suppressed
-            self.pod_conditions[key] = cond
             pod = self.pods.get(key)
+            if pod is not None or not require_pod:
+                self.pod_conditions[key] = cond
         if pod is not None:
             self.status_updater.update_pod_condition(pod, cond)
         self.events.append(("FailedScheduling", key, message))
@@ -1128,6 +1482,15 @@ class SchedulerCache:
         PDB job with Pending tasks) + fit-error conditions for Allocated and
         Pending tasks (cache.go:704-719). Called once per job at session
         close via update_job_status / the PDB events-only path."""
+        self._apply_status_ops(self._render_job_status_ops(job))
+
+    def _render_job_status_ops(self, job: JobInfo) -> list:
+        """record_job_status_event's effects as VALUE-snapshotted ops
+        (("event", tuple) / ("cond", key, message)) — the pipelined close
+        renders them while the session's fit diagnostics are still live and
+        hands the list across the stage boundary; applying them later reads
+        no session state.  record_job_status_event == render + apply, so
+        serial and staged closes share one rendering."""
         pg = job.pod_group
         shadow = pg is not None and pg.shadow
         pg_unsched = (
@@ -1141,14 +1504,24 @@ class SchedulerCache:
         has_stuck = job.task_status_index.get(TaskStatus.ALLOCATED) or \
             job.task_status_index.get(TaskStatus.PENDING)
         if not (pg_unsched or pdb_unsched or has_stuck):
-            return  # nothing to report — skip the fit-error rendering
+            return []  # nothing to report — skip the fit-error rendering
         base = job.job_fit_errors or job.fit_error()
+        ops = []
         if pg_unsched or pdb_unsched:
-            self.events.append(("Unschedulable", job.uid, base))
+            ops.append(("event", ("Unschedulable", job.uid, base)))
         for status in (TaskStatus.ALLOCATED, TaskStatus.PENDING):
             for task in job.task_status_index.get(status, {}).values():
                 fe = job.nodes_fit_errors.get(task.uid)
-                self.task_unschedulable(task, fe.error() if fe is not None else base)
+                ops.append(("cond", task.key(),
+                            fe.error() if fe is not None else base))
+        return ops
+
+    def _apply_status_ops(self, ops, staged: bool = False) -> None:
+        for op in ops:
+            if op[0] == "event":
+                self.events.append(op[1])
+            else:
+                self._task_unschedulable_key(op[1], op[2], require_pod=staged)
 
     def update_job_status(self, job: JobInfo, prev_status=None) -> None:
         """Write the session's derived PodGroup status back to the
@@ -1220,14 +1593,30 @@ class SchedulerCache:
         per-job path is a no-op here and only the rate-limit bookkeeping,
         the updater call, and event recording remain.
 
-        The rate-limit jitter (60s + U[0,30), job_updater.go:20-31) is drawn
-        as one numpy batch, and network-backed updaters fan the writes over
-        the 16-worker pool the reference's jobUpdater uses
-        (job_updater.go:18,51-53) — each write is an independent REST call."""
+        Implemented as stage + run back-to-back: the pipelined close runs
+        the same two halves with a stage boundary between them, so serial
+        and overlapped writeback are one code path by construction."""
+        self.run_status_flush(self.stage_status_flush(updates))
+
+    def stage_status_flush(self, updates, qcounts=None) -> "StatusFlush":
+        """The synchronous half of the close-time status pass — the
+        double-buffer handoff for the pipelined cycle.  EVERYTHING the next
+        session open depends on happens here, before the cycle ends: the
+        dirty stamps for changed jobs (the delta open re-reads exactly
+        them), the rate-limit window bookkeeping, the queue-status delta
+        decisions, and the degraded verdict.  What crosses the stage
+        boundary is value-snapshotted: PodGroup status CLONES (the live
+        object mutates again next cycle; the reference's jobUpdater writes
+        an informer copy the same way), pre-rendered event/condition ops,
+        and the decided queue writes — run_status_flush reads no session
+        or live-job state.
+
+        The rate-limit jitter (60s + U[0,30), job_updater.go:20-31) is
+        drawn as one numpy batch."""
         import time as _time
 
         to_write = []
-        to_record = []
+        ops: List = []
         with self._lock:
             # kbt: allow[KBT001] same wall-clock rate-limit cadence as
             # update_job_status above — write-stream pacing, not scenario time
@@ -1245,19 +1634,31 @@ class SchedulerCache:
                     # open re-reads exactly these jobs' open-state
                     note_job(job.uid)
                 if need_record:
-                    to_record.append(job)
+                    ops.extend(self._render_job_status_ops(job))
                 if not changed and now < next_write.get(job.uid, 0.0):
                     continue  # condition-only churn, rate-limited
                 next_write[job.uid] = now + jitter[i]
-                to_write.append(pg)
+                to_write.append(pg.clone())
+            qwrites, shed_queues = self._stage_queue_statuses_locked(qcounts)
+        return StatusFlush(to_write, ops, qwrites, shed_queues,
+                           self._status_degraded())
+
+    def run_status_flush(self, flush: "StatusFlush") -> None:
+        """The egress half: pod-group writes, rendered events/conditions,
+        then the queue-status writes — the serial close's order.  Runs on
+        the cycle thread (serial) or the pipeline's writeback worker
+        (overlapped); either way it touches only the flush's snapshots plus
+        the updater/event seams.
+
+        Degraded cycles (soft budget elapsed / writeback breaker open at
+        stage time) shed the flush — async pool for parallel-safe updaters,
+        skip otherwise.  Status writes are re-derived every close, so the
+        next healthy cycle converges; what matters now is that the
+        scheduling loop keeps ticking instead of stalling in egress."""
         updater = self.status_updater
+        to_write = flush.to_write
         parallel_safe = getattr(updater, "parallel_safe", False)
-        if to_write and self._status_degraded():
-            # degraded cycle (soft budget elapsed / writeback breaker open):
-            # shed the flush — async pool for parallel-safe updaters, skip
-            # otherwise. Status writes are re-derived every close, so the
-            # next healthy cycle converges; what matters now is that the
-            # scheduling loop keeps ticking instead of stalling in egress.
+        if to_write and flush.degraded:
             from kube_batch_tpu import metrics
 
             metrics.register_status_writes_shed(len(to_write))
@@ -1267,12 +1668,67 @@ class SchedulerCache:
             if parallel_safe:
                 self._update_pod_groups_pooled(to_write, wait=False)
         elif len(to_write) > 16 and parallel_safe:
-            self._update_pod_groups_pooled(to_write)
+            try:
+                self._update_pod_groups_pooled(to_write)
+            except Exception:  # noqa: BLE001 — re-derived next close
+                logger.exception("pooled podgroup status writes failed")
         else:
             for pg in to_write:
-                updater.update_pod_group(pg)
-        for job in to_record:
-            self.record_job_status_event(job)
+                # per-write guard: one failing updater call must not abort
+                # the remaining writes, the rendered event/condition ops, or
+                # the queue writes below — the stage already recorded those
+                # queue deltas as written, so skipping them here would
+                # suppress the external QueueStatus until the counts change
+                try:
+                    updater.update_pod_group(pg)
+                except Exception:  # noqa: BLE001 — re-derived next close
+                    logger.exception("podgroup status write failed")
+        self._apply_status_ops(flush.ops, staged=True)
+        if flush.shed_queues:
+            from kube_batch_tpu import metrics
+
+            metrics.register_status_writes_shed(flush.shed_queues)
+        write = getattr(updater, "update_queue_status", None)
+        for name, c in flush.qwrites:
+            try:
+                write(name, c)
+            except Exception as e:  # noqa: BLE001 — next close re-derives
+                logger.error("queue status write %s failed: %s", name, e)
+                with self._lock:
+                    # un-record so the next close retries the delta
+                    # kbt: allow[KBT002] dict .get on the delta-record map
+                    # (the "queue" in its name is QueueStatus, not a Queue)
+                    if self._queue_status_written.get(name) == c:
+                        del self._queue_status_written[name]
+
+    def _stage_queue_statuses_locked(self, counts) -> tuple:
+        """Decide the per-queue status deltas (caller holds the lock):
+        returns ([(name, counts)], shed_count).  Bookkeeping is recorded
+        optimistically at stage time so the NEXT cycle's delta decisions
+        never race the flush; a failed write un-records (run_status_flush)."""
+        if counts is None:
+            return [], 0
+        write = getattr(self.status_updater, "update_queue_status", None)
+        if write is None:
+            return [], 0
+        if self._status_degraded():
+            # deltas-only writeback: an unwritten count stays "dirty" in
+            # _queue_status_written and lands on the next healthy close
+            return [], len(counts)
+        # queues previously written but absent from this cycle's counts
+        # (their podgroups all left) zero out rather than going stale
+        zero = queue_phase_counts()
+        names = set(counts) | set(self._queue_status_written)
+        qwrites = []
+        for name in names:
+            if self.queues.get(name) is None:
+                continue  # deleted mid-cycle
+            c = counts.get(name, zero)
+            if self._queue_status_written.get(name) == c:
+                continue
+            self._queue_status_written[name] = dict(c)
+            qwrites.append((name, dict(c)))
+        return qwrites, 0
 
     def update_queue_statuses(self, counts: Dict[str, dict]) -> None:
         """Write changed per-queue podgroup-phase counts (QueueStatus,
@@ -1280,31 +1736,9 @@ class SchedulerCache:
         reference — it declares the fields but never fills them; here the
         close pass hands the counts it already derived and only deltas are
         written. Updaters without the seam (older fakes) are skipped."""
-        write = getattr(self.status_updater, "update_queue_status", None)
-        if write is None:
-            return
-        if self._status_degraded():
-            # deltas-only writeback: an unwritten count stays "dirty" in
-            # _queue_status_written and lands on the next healthy close
-            from kube_batch_tpu import metrics
-
-            metrics.register_status_writes_shed(len(counts))
-            return
-        # queues previously written but absent from this cycle's counts
-        # (their podgroups all left) zero out rather than going stale
-        zero = queue_phase_counts()
-        names = set(counts) | set(self._queue_status_written)
-        for name in names:
-            if self.queues.get(name) is None:
-                continue  # deleted mid-cycle
-            c = counts.get(name, zero)
-            if self._queue_status_written.get(name) == c:
-                continue
-            try:
-                write(name, c)
-                self._queue_status_written[name] = dict(c)
-            except Exception as e:  # noqa: BLE001 — next close re-derives
-                logger.error("queue status write %s failed: %s", name, e)
+        with self._lock:
+            qwrites, shed = self._stage_queue_statuses_locked(counts)
+        self.run_status_flush(StatusFlush([], [], qwrites, shed, False))
 
     def _status_degraded(self) -> bool:
         """Should close-time status flushes shed? True while the scheduler

@@ -55,10 +55,21 @@ _EMPTY: FrozenSet[str] = frozenset()
 
 class DirtyTracker:
     """Accumulates ingest churn between session opens.  All mutation entry
-    points run under the cache's big lock, so plain sets suffice."""
+    points run under the cache's big lock, so plain sets suffice.
+
+    ``on_advance`` (optional, set by the cache) is invoked on every version
+    bump — the event-driven cycle trigger's wake signal: an arrival burst
+    schedules a cycle immediately instead of waiting out the tick.  It must
+    never block (the stamps run under the cache's big lock).
+
+    ``hold_version()``/``release_version()`` bracket a batched ingest: the
+    per-kind dirty sets still accumulate per item, but the monotonic version
+    advances ONCE for the whole batch (one lease/delta token, one trigger
+    wake) instead of once per item."""
 
     __slots__ = ("version", "jobs", "nodes", "pods", "queues_changed",
-                 "priority_classes_changed", "full")
+                 "priority_classes_changed", "full", "on_advance", "_held",
+                 "_held_pending")
 
     def __init__(self) -> None:
         self.version = 0
@@ -70,31 +81,52 @@ class DirtyTracker:
         # a cold tracker reads as "everything changed": the first open after
         # construction (or after a forced invalidation) must rebuild fully
         self.full = True
+        self.on_advance = None
+        self._held = False
+        self._held_pending = False
+
+    def _advance(self) -> None:
+        if self._held:
+            self._held_pending = True
+            return
+        self.version += 1
+        if self.on_advance is not None:
+            self.on_advance()
+
+    def hold_version(self) -> None:
+        self._held = True
+        self._held_pending = False
+
+    def release_version(self) -> None:
+        self._held = False
+        if self._held_pending:
+            self._held_pending = False
+            self._advance()
 
     # -- stamps (called from the cache's ingest/status choke points) -------
     def note_job(self, uid: str) -> None:
-        self.version += 1
         self.jobs.add(uid)
+        self._advance()
 
     def note_node(self, name: str) -> None:
-        self.version += 1
         self.nodes.add(name)
+        self._advance()
 
     def note_pod(self, key: str) -> None:
-        self.version += 1
         self.pods.add(key)
+        self._advance()
 
     def mark_queues(self) -> None:
-        self.version += 1
         self.queues_changed = True
+        self._advance()
 
     def mark_priority_classes(self) -> None:
-        self.version += 1
         self.priority_classes_changed = True
+        self._advance()
 
     def mark_full(self) -> None:
-        self.version += 1
         self.full = True
+        self._advance()
 
     # -- consumption -------------------------------------------------------
     def take(self) -> DirtyDelta:

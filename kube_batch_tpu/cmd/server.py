@@ -10,10 +10,10 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
 - POST/DELETE /v1/pods         — informer-shaped ingest (JSON bodies per
 - POST/DELETE /v1/nodes          api/serialize.py); POST is add-or-update,
 - POST/DELETE /v1/podgroups      matching the informers' upsert handlers
-- POST/DELETE /v1/queues         (event_handlers.go)
-- POST        /v1/priorityclasses
-- POST/DELETE /v1/poddisruptionbudgets
-- POST/DELETE /v1/persistentvolumes
+- POST/DELETE /v1/queues         (event_handlers.go).  A LIST body batches:
+- POST        /v1/priorityclasses  the whole batch applies under one cache
+- POST/DELETE /v1/poddisruptionbudgets  lock acquisition + one dirty-version
+- POST/DELETE /v1/persistentvolumes     advance ({"ok":true,"applied":N})
 - GET  /v1/queues              — queue list w/ podgroup phase counts (the
                                  Queue CRD status the CLI renders, list.go:51)
 - GET  /v1/jobs                — podgroup phases/conditions
@@ -236,9 +236,31 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 self._send(404, json.dumps({"error": f"unknown kind {kind}"}))
                 return
             parse, add, remove = entry
+            apply_fn = remove if delete else add
             try:
-                obj = parse(self._body())
-                (remove if delete else add)(obj)
+                body = self._body()
+                if isinstance(body, list):
+                    # batched ingest: a list body applies under ONE cache
+                    # lock acquisition and ONE dirty-version advance
+                    # (cache.ingest_batch) — high-QPS clients stop paying a
+                    # lock round-trip (and a lease/delta token move) per
+                    # pod.  The whole batch parses BEFORE any element
+                    # applies: a malformed element rejects the batch, never
+                    # half-applies it.
+                    ops = [(apply_fn, parse(d)) for d in body]
+                    applied = cache.ingest_batch(ops)
+                    if applied < len(ops):
+                        # an element that parsed but whose HANDLER raised:
+                        # mirror the single-object path's 400, with the
+                        # partial count so the client knows what landed
+                        self._send(400, json.dumps({
+                            "ok": False, "applied": applied,
+                            "failed": len(ops) - applied}))
+                        return
+                    self._send(200, json.dumps(
+                        {"ok": True, "applied": applied}))
+                    return
+                apply_fn(parse(body))
             except (TypeError, ValueError, KeyError) as e:
                 self._send(400, json.dumps({"error": str(e)}))
                 return

@@ -200,6 +200,11 @@ class Session:
         # registry singleton: multiple Scheduler/cache instances in one
         # process (tests, the simulator) must not cross wires (ADVICE.md #5)
         self.host_discards = 0
+        # the staged StatusFlush, stashed here by close_session as soon as
+        # staging succeeds: if the close's own finally raises afterwards,
+        # the pipelined caller recovers the flush from the session instead
+        # of dropping writes whose stage-time bookkeeping already committed
+        self.staged_flush = None
 
     def drop_job(self, uid: str) -> None:
         """Remove a job from the session (open-gate drops).  The caller is
@@ -1013,12 +1018,11 @@ def _close_status_columnar(ssn: Session) -> None:
                 for code in range(N_PHASES):
                     qc[CODE_PHASE[code].value.lower()] = int(bc[qi, code])
                 qcounts[cols.queue_names[qi]] = qc
-    ssn.cache.update_job_statuses_bulk(updates)
     _count_gate_dropped(ssn, qcounts)
-    ssn.cache.update_queue_statuses(qcounts)
     # consumed: ingest that lands after this point (deferred mutations,
     # residue reverts) re-stamps rows for the next cycle's visit
     cols.j_touched[:] = False
+    return updates, qcounts
 
 
 def _count_gate_dropped(ssn: Session, qcounts: Dict[str, dict]) -> None:
@@ -1037,11 +1041,22 @@ def _count_gate_dropped(ssn: Session, qcounts: Dict[str, dict]) -> None:
         qc[(pg.phase or PodGroupPhase.PENDING).value.lower()] += 1
 
 
-def close_session(ssn: Session) -> None:
+def close_session(ssn: Session, stage_flush: bool = False):
     """Plugin close hooks then the job updater (framework.go:55-62 +
     job_updater.go:33-122, sans the 16-worker pool — the host loop is cold).
     Exclusive sessions additionally unwind Pipelined placements (session-only
-    state, gone with a cloned session) and release the cache gate."""
+    state, gone with a cloned session) and release the cache gate.
+
+    ``stage_flush=True`` is the pipelined cycle's close: the status pass
+    still DERIVES everything synchronously (phase writes, dirty stamps,
+    rate-limit bookkeeping, queue-delta decisions — all the state the next
+    session open depends on), but the egress half is returned as a
+    value-snapshotted ``StatusFlush`` for the writeback stage to run
+    overlapped with the next cycle, and the async binder drain is left to
+    that same stage (``_inflight_bind_hosts`` protects deferred ingest
+    against the unacked window).  Serial callers get ``None`` and identical
+    behavior to before the split — stage + run back-to-back."""
+    flush = None
     try:
         for plugin in ssn.plugins:
             t0 = telemetry.perf_counter()
@@ -1051,7 +1066,12 @@ def close_session(ssn: Session) -> None:
                 (telemetry.perf_counter() - t0) * 1e6,
             )
         if ssn.columns is not None and ssn.rows_synced and ssn.jobs:
-            _close_status_columnar(ssn)
+            updates, qcounts = _close_status_columnar(ssn)
+            flush = ssn.staged_flush = ssn.cache.stage_status_flush(
+                updates, qcounts)
+            if not stage_flush:
+                ssn.cache.run_status_flush(flush)
+                flush = ssn.staged_flush = None
         else:
             qcounts: Dict[str, dict] = {}
             for job in ssn.jobs.values():
@@ -1073,7 +1093,17 @@ def close_session(ssn: Session) -> None:
                     job, prev_status=ssn.pod_group_status_at_open.get(job.uid)
                 )
             _count_gate_dropped(ssn, qcounts)
-            ssn.cache.update_queue_statuses(qcounts)
+            if stage_flush:
+                # the pipelined loop reaches this branch only for EMPTY
+                # sessions (exclusive sessions always carry columns): the
+                # per-job loop above did nothing, and the queue zero-outs
+                # must go through the same staged handoff — an inline write
+                # here would race the previous cycle's writeback worker,
+                # breaking the single-status-writer design
+                flush = ssn.staged_flush = ssn.cache.stage_status_flush(
+                    (), qcounts)
+            else:
+                ssn.cache.update_queue_statuses(qcounts)
     finally:
         if ssn.exclusive:
             # revert surviving Pipelined placements: they exist only inside
@@ -1089,12 +1119,16 @@ def close_session(ssn: Session) -> None:
             # leak node/volume accounting onto the authoritative cache
             _revert_residue(ssn, ssn.allocated_tasks, TaskStatus.ALLOCATED,
                             release_volumes=True)
-            # drain binder acks BEFORE applying deferred ingest: a deferred
-            # pod update must observe the durable bindings (pod.node_name)
-            # this cycle produced, or it would clobber them
-            flush = getattr(ssn.cache, "flush_binds", None)
-            if flush is not None:
-                flush()
+            if not stage_flush:
+                # drain binder acks BEFORE applying deferred ingest: a
+                # deferred pod update must observe the durable bindings
+                # (pod.node_name) this cycle produced, or it would clobber
+                # them.  The pipelined close leaves the drain to the
+                # writeback stage — deferred ingest racing the unacked
+                # window is protected by the cache's in-flight bind map.
+                drain = getattr(ssn.cache, "flush_binds", None)
+                if drain is not None:
+                    drain()
             ssn.cache.end_exclusive_session()
         ssn.jobs = {}
         ssn.nodes = {}
@@ -1102,3 +1136,4 @@ def close_session(ssn: Session) -> None:
         ssn.plugins = []
         ssn.pipelined_tasks = []
         ssn.allocated_tasks = []
+    return flush

@@ -242,6 +242,27 @@ WHATIF_SNAPSHOT_VERSION = Gauge(
     f"{_SUBSYSTEM}_whatif_snapshot_version",
     "Dirty-tracker version token of the published snapshot lease",
 )
+# pipelined-cycle metrics (the event-driven loop): the latency the pipeline
+# exists to optimize (pod ARRIVAL → bind DECISION, not just cycle ms), what
+# woke each cycle, and how much egress the writeback stage hid behind the
+# next cycle's compute
+DECISION_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_arrival_to_decision_latency_milliseconds",
+    "Pod-arrival to bind-decision latency in milliseconds",
+)
+TRIGGER_WAKES = Counter(
+    f"{_SUBSYSTEM}_cycle_trigger_wakes_total",
+    "Scheduling-cycle wakeups, by trigger (ingest|floor)",
+    ("trigger",),
+)
+PIPELINE_OVERLAP = Histogram(
+    f"{_SUBSYSTEM}_pipeline_writeback_overlap_milliseconds",
+    "Writeback-stage time overlapped behind the next cycle (ms)",
+)
+STAGED_INGEST = Counter(
+    f"{_SUBSYSTEM}_staged_ingest_events_total",
+    "Ingest events applied through the staged (one-lock) drain",
+)
 # longitudinal fairness surfaced live (sim runner + any caller with
 # per-queue share samples): dominant share vs weight entitlement per queue
 QUEUE_SHARE = Gauge(
@@ -283,6 +304,10 @@ METRICS = [
     WHATIF_QUEUE_DEPTH,
     WHATIF_LATENCY,
     WHATIF_SNAPSHOT_VERSION,
+    DECISION_LATENCY,
+    TRIGGER_WAKES,
+    PIPELINE_OVERLAP,
+    STAGED_INGEST,
     QUEUE_SHARE,
     QUEUE_ENTITLEMENT,
 ]
@@ -405,6 +430,42 @@ def observe_whatif_latency(ms: float) -> None:
 
 def set_whatif_snapshot_version(version: int) -> None:
     WHATIF_SNAPSHOT_VERSION.set(float(version))
+
+
+# optional exact-sample sink for the decision-latency stream: the bench
+# needs true p50/p99 over the raw samples, which the 5·2^k histogram
+# buckets are far too coarse for — a registered list receives every ms
+# value alongside the histogram observation
+_decision_sink = None
+
+
+def set_decision_latency_sink(sink) -> None:
+    """Register (or clear, sink=None) a list that receives every raw
+    arrival→decision latency sample in ms."""
+    global _decision_sink
+    _decision_sink = sink
+
+
+def observe_decision_latencies(ms_values) -> None:
+    """Record arrival→decision latencies for one cycle's bind decisions."""
+    for ms in ms_values:
+        DECISION_LATENCY.observe(ms)
+    sink = _decision_sink
+    if sink is not None:
+        sink.extend(ms_values)
+
+
+def register_trigger_wake(trigger: str) -> None:
+    TRIGGER_WAKES.inc(trigger)
+
+
+def observe_pipeline_overlap(ms: float) -> None:
+    PIPELINE_OVERLAP.observe(ms)
+
+
+def register_staged_ingest(count: int) -> None:
+    if count:
+        STAGED_INGEST.add(count)
 
 
 def set_queue_shares(shares: dict) -> None:

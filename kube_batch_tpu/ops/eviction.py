@@ -89,6 +89,130 @@ class EvictResult(NamedTuple):
     victim_claimant: jnp.ndarray  # [T] i32 — claimant task index a victim serves, -1
 
 
+# ---- the victim machinery shared by every eviction path ------------------
+# (the committed solves below AND the query plane's hypothetical probe,
+# ops/probe.py _evict_probe — one set of lines, so the probe cannot drift
+# from the solve; tests/test_whatif.py's fixture equivalence is the
+# behavioral check, this sharing is the structural one)
+
+
+def victim_running(snap: DeviceSnapshot) -> jnp.ndarray:
+    """[T] bool — base victim eligibility: valid RUNNING tasks on a node
+    whose job is in-session.  job_valid gates victims too: the columnar
+    snapshot's row space carries tasks of jobs OUTSIDE the session (dropped
+    at open / unknown queue), which the per-session object snapshot never
+    contained — their rows' job metadata is stale scratch and the host
+    decode would drop them anyway, wasting the whole claim."""
+    return (
+        snap.task_valid
+        & (snap.task_status == int(TaskStatus.RUNNING))
+        & (snap.task_node >= 0)
+        & snap.job_valid[snap.task_job]
+    )
+
+
+def gang_slack0(snap: DeviceSnapshot, config: EvictConfig) -> jnp.ndarray:
+    """[J] i32 — evictions a job can absorb while staying ≥ MinAvailable;
+    MinAvailable ≤ 1 jobs are not gangs — always evictable (gang.go:71-94).
+    BIG everywhere when the gang victim gate is off."""
+    J = snap.job_min_avail.shape[0]
+    if not config.victim_gang:
+        return jnp.full(J, BIG)
+    return jnp.where(
+        snap.job_min_avail > 1, snap.job_ready - snap.job_min_avail, BIG
+    )
+
+
+def claim_winners(has, best, rank, n_nodes: int):
+    """One winner per node — the lowest-rank bidder.  The claimant axis C
+    is whatever the caller bids with: the full task axis (the solves) or a
+    speculative gang's members (the probe).  Returns
+    (is_winner [C] bool, winner_idx [N] i32 — claimant index or -1,
+    node_has_claim [N] bool)."""
+    N = n_nodes
+    idx = jnp.arange(has.shape[0], dtype=jnp.int32)
+    bid_node = jnp.where(has, best, N)
+    win_rank = (
+        jnp.full(N + 1, BIG, jnp.int32)
+        .at[bid_node].min(jnp.where(has, rank, BIG))
+    )[:N]
+    is_winner = has & (rank == win_rank[jnp.clip(best, 0, N - 1)])
+    winner_idx = (
+        jnp.full(N, -1, jnp.int32)
+        .at[jnp.where(is_winner, best, 0)]
+        .max(jnp.where(is_winner, idx, -1))
+    )
+    return is_winner, winner_idx, winner_idx >= 0
+
+
+def pick_victims(snap: DeviceSnapshot, vmask, node_req, node_has_claim,
+                 victim_rank, slack_rem, config: EvictConfig, n_nodes: int,
+                 *, qbudget_rem=None, task_queue=None):
+    """Victim selection for one round's claimed nodes: victims pop in
+    reverse task order until the winner's request is covered (the
+    reference's victimsQueue pops !TaskOrderFn, preempt.go:219-224 — a
+    segmented prefix scan), then the exact global caps — gang slack (a job
+    never drops below MinAvailable, gang.go:71-94) and, when
+    ``qbudget_rem``/``task_queue`` are given, the proportion queue budget
+    (proportion.go:171-196) — then the coverage recheck: victims dropped by
+    a cap can break a claim's coverage, and such claims cancel entirely
+    (evictions never happen without a covered placement,
+    reclaim.go:150-163).  Returns (final_take [T] bool, covered [N] bool)."""
+    T = snap.task_req.shape[0]
+    N = n_nodes
+    J = snap.job_min_avail.shape[0]
+    Q = snap.queue_weight.shape[0]
+    vn = jnp.clip(snap.task_node, 0, N - 1)
+
+    seg = jnp.where(vmask, snap.task_node, N)
+    order = ordering.sort_by_segment_then_rank(seg, victim_rank, N + 1)
+    seg_s = seg[order]
+    req_s = jnp.where(vmask[order, None], snap.task_resreq[order], 0.0)
+    is_start = jnp.concatenate([jnp.array([True]), seg_s[1:] != seg_s[:-1]])
+    prefix = segmented_prefix(req_s, is_start)                   # exclusive
+    need_s = node_req[jnp.clip(seg_s, 0, N - 1)]
+    covered_before = jnp.all(prefix >= need_s - snap.quanta, axis=-1)
+    take_s = vmask[order] & (seg_s < N) & ~covered_before
+    take = jnp.zeros(T, bool).at[order].set(take_s)
+
+    if config.victim_gang:
+        # position among taken victims of the same job < remaining slack
+        jorder = ordering.sort_by_segment_then_rank(
+            jnp.where(take, snap.task_job, J), victim_rank, J + 1
+        )
+        js = jnp.where(take, snap.task_job, J)[jorder]
+        j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
+        pos = segmented_prefix(
+            take[jorder].astype(jnp.float32)[:, None], j_start
+        )[:, 0].astype(jnp.int32)
+        keep_j = take[jorder] & (pos < slack_rem[jnp.clip(js, 0, J - 1)])
+        take = jnp.zeros(T, bool).at[jorder].set(keep_j)
+    if qbudget_rem is not None:
+        # cumulative eviction per victim queue ≤ remaining budget
+        qorder = ordering.sort_by_segment_then_rank(
+            jnp.where(take, task_queue, Q), victim_rank, Q + 1
+        )
+        qs = jnp.where(take, task_queue, Q)[qorder]
+        q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
+        qreq_s = jnp.where(take[qorder, None], snap.task_resreq[qorder], 0.0)
+        qprefix = segmented_prefix(qreq_s, q_start)
+        fits_budget = jnp.all(
+            qprefix + qreq_s
+            <= qbudget_rem[jnp.clip(qs, 0, Q - 1)] + snap.quanta,
+            axis=-1,
+        )
+        take = jnp.zeros(T, bool).at[qorder].set(take[qorder] & fits_budget)
+
+    # coverage recheck after caps; cancel uncovered claims
+    got = jax.ops.segment_sum(
+        jnp.where(take[:, None], snap.task_resreq, 0.0),
+        jnp.where(take, snap.task_node, N),
+        num_segments=N + 1,
+    )[:N]
+    covered = node_has_claim & jnp.all(got >= node_req - snap.quanta, axis=-1)
+    return take & covered[vn], covered
+
+
 def local_evict_bids(snap: DeviceSnapshot, config: EvictConfig):
     """Build the single-program bids head: ``bids(victim_ok, claimant_ok)
     -> (best, has)`` — the per-round [T, N]-scale victim-capacity /
@@ -175,6 +299,7 @@ def evict_rounds(
     bids_fn,
     fits_idle_any=None,
     n_nodes=None,
+    claimant_mask=None,
 ) -> EvictResult:
     """The eviction machinery shared by every solve path: victim/claimant
     eligibility, ranks, winner-per-node selection, victim picking, global
@@ -183,7 +308,10 @@ def evict_rounds(
     scale bids come from ``bids_fn``; ``fits_idle_any`` is the idle-gate
     probe (required iff ``config.idle_gate`` on reclaim).  ``n_nodes``
     overrides the GLOBAL node count when ``snap``'s node arrays are
-    shard-local blocks (the shard_map body)."""
+    shard-local blocks (the shard_map body).  ``claimant_mask`` ([T] bool)
+    restricts claimants beyond the standard eligibility — callers probing
+    a SUBSET of the pending work (a single job's what-if, a drained queue)
+    share this machinery instead of forking it."""
     T, R = snap.task_req.shape
     N = n_nodes if n_nodes is not None else snap.node_alloc.shape[0]
     J = snap.job_min_avail.shape[0]
@@ -191,17 +319,7 @@ def evict_rounds(
     preempt = config.mode == "preempt"
 
     task_queue = snap.job_queue[snap.task_job]                      # [T]
-    # job_valid gates victims too: the columnar snapshot's row space carries
-    # tasks of jobs OUTSIDE the session (dropped at open / unknown queue),
-    # which the per-session object snapshot never contained — their rows'
-    # job metadata (min_avail, queue) is stale scratch and the host decode
-    # would drop them anyway, wasting the whole claim
-    running = (
-        snap.task_valid
-        & (snap.task_status == int(TaskStatus.RUNNING))
-        & (snap.task_node >= 0)
-        & snap.job_valid[snap.task_job]
-    )
+    running = victim_running(snap)
     subrank = ordering.task_subranks(snap.task_prio, snap.task_creation)
     # victims pop in reverse task order (!TaskOrderFn, preempt.go:219-224)
     victim_rank = ordering.multisort_ranks([snap.task_prio, -snap.task_creation])
@@ -209,14 +327,7 @@ def evict_rounds(
     deserved = fairness.proportion_deserved(
         snap.total, snap.queue_weight, snap.queue_request, snap.queue_valid
     )
-    # gang slack: evictions a job can absorb while staying ≥ MinAvailable;
-    # MinAvailable ≤ 1 jobs are not gangs — always evictable (gang.go:71-94)
-    if config.victim_gang:
-        slack0 = jnp.where(
-            snap.job_min_avail > 1, snap.job_ready - snap.job_min_avail, BIG
-        )
-    else:
-        slack0 = jnp.full(J, BIG)
+    slack0 = gang_slack0(snap, config)
     # proportion budget: resource a queue can lose while staying ≥ deserved
     qbudget0 = jnp.maximum(snap.queue_alloc - deserved, 0.0)        # [Q, R]
 
@@ -226,6 +337,8 @@ def evict_rounds(
         & snap.job_valid[snap.task_job]
         & snap.job_schedulable[snap.task_job]
     )
+    if claimant_mask is not None:
+        claimant_base &= claimant_mask
     if config.idle_gate and not preempt:
         # IMPROVEMENT over reclaim.go (which never looks at Idle and will
         # evict cross-queue victims for a task free capacity could satisfy):
@@ -323,17 +436,9 @@ def evict_rounds(
         has &= claimant_ok
 
         # ---- one winner per node: lowest claimant rank ---------------
-        bid_node = jnp.where(has, best, N)
-        win_rank = (
-            jnp.full(N + 1, BIG, jnp.int32).at[bid_node].min(jnp.where(has, rank, BIG))
-        )[:N]
-        is_winner = has & (rank == win_rank[jnp.clip(best, 0, N - 1)])
-        winner_task = (
-            jnp.full(N, -1, jnp.int32)
-            .at[jnp.where(is_winner, best, 0)]
-            .max(jnp.where(is_winner, jnp.arange(T, dtype=jnp.int32), -1))
+        is_winner, winner_task, node_has_claim = claim_winners(
+            has, best, rank, N
         )
-        node_has_claim = winner_task >= 0
         node_req = jnp.where(
             node_has_claim[:, None], snap.task_req[jnp.maximum(winner_task, 0)], jnp.inf
         )                                                            # [N, R]
@@ -350,7 +455,7 @@ def evict_rounds(
                 snap.total,
             )                                                        # [N]
 
-        # ---- victim selection per node (reverse task order) ----------
+        # ---- victims (shared machinery: selection + caps + coverage) -
         vn = jnp.clip(snap.task_node, 0, N - 1)
         vmask = victim_ok & node_has_claim[vn]
         if preempt:
@@ -362,55 +467,13 @@ def evict_rounds(
                 vmask &= winner_post_share[vn] <= victim_post_share + SHARE_DELTA
         else:
             vmask &= task_queue != winner_queue[vn]                  # cross-queue
-
-        seg = jnp.where(vmask, snap.task_node, N)
-        order = ordering.sort_by_segment_then_rank(seg, victim_rank, N + 1)
-        seg_s = seg[order]
-        req_s = jnp.where(vmask[order, None], snap.task_resreq[order], 0.0)
-        is_start = jnp.concatenate([jnp.array([True]), seg_s[1:] != seg_s[:-1]])
-        prefix = segmented_prefix(req_s, is_start)                   # exclusive
-        need_s = node_req[jnp.clip(seg_s, 0, N - 1)]
-        covered_before = jnp.all(prefix >= need_s - snap.quanta, axis=-1)
-        take_s = vmask[order] & (seg_s < N) & ~covered_before
-        take = jnp.zeros(T, bool).at[order].set(take_s)
-
-        # ---- exact global caps ---------------------------------------
-        if config.victim_gang:
-            # position among taken victims of the same job < remaining slack
-            jorder = ordering.sort_by_segment_then_rank(
-                jnp.where(take, snap.task_job, J), victim_rank, J + 1
-            )
-            js = jnp.where(take, snap.task_job, J)[jorder]
-            j_start = jnp.concatenate([jnp.array([True]), js[1:] != js[:-1]])
-            pos = segmented_prefix(
-                take[jorder].astype(jnp.float32)[:, None], j_start
-            )[:, 0].astype(jnp.int32)
-            keep_j = take[jorder] & (pos < slack_rem[jnp.clip(js, 0, J - 1)])
-            take = jnp.zeros(T, bool).at[jorder].set(keep_j)
-        if config.victim_proportion and not preempt:
-            # cumulative eviction per victim queue ≤ remaining budget
-            qorder = ordering.sort_by_segment_then_rank(
-                jnp.where(take, task_queue, Q), victim_rank, Q + 1
-            )
-            qs = jnp.where(take, task_queue, Q)[qorder]
-            q_start = jnp.concatenate([jnp.array([True]), qs[1:] != qs[:-1]])
-            qreq_s = jnp.where(take[qorder, None], snap.task_resreq[qorder], 0.0)
-            qprefix = segmented_prefix(qreq_s, q_start)
-            fits_budget = jnp.all(
-                qprefix + qreq_s
-                <= qbudget_rem[jnp.clip(qs, 0, Q - 1)] + snap.quanta,
-                axis=-1,
-            )
-            take = jnp.zeros(T, bool).at[qorder].set(take[qorder] & fits_budget)
-
-        # ---- coverage recheck after caps; cancel uncovered claims ----
-        got = jax.ops.segment_sum(
-            jnp.where(take[:, None], snap.task_resreq, 0.0),
-            jnp.where(take, snap.task_node, N),
-            num_segments=N + 1,
-        )[:N]
-        covered = node_has_claim & jnp.all(got >= node_req - snap.quanta, axis=-1)
-        final_take = take & covered[vn]
+        proportion_cap = config.victim_proportion and not preempt
+        final_take, covered = pick_victims(
+            snap, vmask, node_req, node_has_claim, victim_rank, slack_rem,
+            config, N,
+            qbudget_rem=qbudget_rem if proportion_cap else None,
+            task_queue=task_queue if proportion_cap else None,
+        )
 
         # ---- apply ---------------------------------------------------
         new_claim = is_winner & covered[jnp.clip(best, 0, N - 1)]

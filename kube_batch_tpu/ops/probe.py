@@ -74,13 +74,18 @@ from kube_batch_tpu.ops.assignment import (
     round_head_parts,
     tie_break_hash_rows,
 )
-from kube_batch_tpu.ops.eviction import BIG, EvictConfig
+from kube_batch_tpu.ops.eviction import (
+    EvictConfig,
+    claim_winners,
+    gang_slack0,
+    pick_victims,
+    victim_running,
+)
 from kube_batch_tpu.ops.feasibility import (
     FeasibilityMasks,
     failure_histogram,
     fits,
 )
-from kube_batch_tpu.ops.ordering import segmented_prefix
 from kube_batch_tpu.utils import jitstats
 
 #: the enqueue action's 20% overcommit (enqueue.go:74-81) — the admission
@@ -221,12 +226,15 @@ def _evict_probe(snap: DeviceSnapshot, req, pending, queue, min_avail,
                  assigned0, bid_fn, config: EvictConfig, n_nodes: int):
     """Hypothetical preempt pass for one gang: which nodes would its
     unplaced members claim, and which running victims would be evicted —
-    mirroring :func:`ops.eviction.evict_rounds` with claimants restricted
-    to the gang (its victim eligibility, reverse-task-order selection, gang
-    slack cap, coverage recheck, and commit gate are the same lines at full
-    task-axis scale).  For a speculative job every same-queue RUNNING task
-    is another job's — the reference's preempt victim filter
-    (preempt.go:113-121) reduces to the queue test.
+    built ON :mod:`ops.eviction`'s shared victim machinery
+    (:func:`~ops.eviction.victim_running` / :func:`~ops.eviction.claim_winners`
+    / :func:`~ops.eviction.pick_victims`), with claimants restricted to the
+    gang's members, so the probe's victim eligibility, reverse-task-order
+    selection, gang slack cap, coverage recheck, and commit gate are
+    literally the solve's lines rather than a ~90-line mirror of them.  For
+    a speculative job every same-queue RUNNING task is another job's — the
+    reference's preempt victim filter (preempt.go:113-121) reduces to the
+    queue test.
 
     ``bid_fn(claimant_ok, cap) -> (best, has)`` is the only [G, N]-scale
     block (the masked two-key argmax over per-node evictable capacity);
@@ -241,21 +249,11 @@ def _evict_probe(snap: DeviceSnapshot, req, pending, queue, min_avail,
     i32 = jnp.int32
 
     task_queue = snap.job_queue[snap.task_job]
-    running = (
-        snap.task_valid
-        & (snap.task_status == int(TaskStatus.RUNNING))
-        & (snap.task_node >= 0)
-        & snap.job_valid[snap.task_job]
-    )
+    running = victim_running(snap)
     victim_rank = ordering.multisort_ranks(
         [snap.task_prio, -snap.task_creation]
     )
-    if config.victim_gang:
-        slack0 = jnp.where(
-            snap.job_min_avail > 1, snap.job_ready - snap.job_min_avail, BIG
-        )
-    else:
-        slack0 = jnp.full(J, BIG)
+    slack0 = gang_slack0(snap, config)
 
     q_ok = (queue >= 0) & (queue < Q)
     claimant_base = pending & (assigned0 < 0) & q_ok
@@ -290,62 +288,23 @@ def _evict_probe(snap: DeviceSnapshot, req, pending, queue, min_avail,
         best, has = bid_fn(claimant_ok, cap)
         has &= claimant_ok
 
-        # one winner per node: lowest member rank (evict_rounds' win_rank)
-        bid_node = jnp.where(has, best, N)
-        win_rank = (
-            jnp.full(N + 1, BIG, i32).at[bid_node].min(
-                jnp.where(has, rank_g, BIG))
-        )[:N]
-        is_winner = has & (rank_g == win_rank[jnp.clip(best, 0, N - 1)])
-        winner_member = (
-            jnp.full(N, -1, i32)
-            .at[jnp.where(is_winner, best, 0)]
-            .max(jnp.where(is_winner, rank_g, -1))
+        # one winner per node: lowest member rank (the gang's claimant axis
+        # plugged into the solve's winner selection)
+        is_winner, winner_member, node_has_claim = claim_winners(
+            has, best, rank_g, N
         )
-        node_has_claim = winner_member >= 0
         node_req = jnp.where(
             node_has_claim[:, None], req[jnp.maximum(winner_member, 0)],
             jnp.inf,
         )                                                        # [N, R]
 
-        # victim selection per node, reverse task order (preempt.go:219-224)
+        # the solve's victim machinery: reverse-task-order selection, gang
+        # slack cap (no proportion budget — preempt semantics), coverage
         vmask = vq & node_has_claim[vn]
-        seg = jnp.where(vmask, snap.task_node, N)
-        order = ordering.sort_by_segment_then_rank(seg, victim_rank, N + 1)
-        seg_s = seg[order]
-        req_s = jnp.where(vmask[order, None], snap.task_resreq[order], 0.0)
-        is_start = jnp.concatenate(
-            [jnp.array([True]), seg_s[1:] != seg_s[:-1]]
+        final_take, covered = pick_victims(
+            snap, vmask, node_req, node_has_claim, victim_rank, slack_rem,
+            config, N,
         )
-        prefix = segmented_prefix(req_s, is_start)
-        need_s = node_req[jnp.clip(seg_s, 0, N - 1)]
-        covered_before = jnp.all(prefix >= need_s - snap.quanta, axis=-1)
-        take_s = vmask[order] & (seg_s < N) & ~covered_before
-        take = jnp.zeros(T, bool).at[order].set(take_s)
-
-        if config.victim_gang:
-            jorder = ordering.sort_by_segment_then_rank(
-                jnp.where(take, snap.task_job, J), victim_rank, J + 1
-            )
-            js = jnp.where(take, snap.task_job, J)[jorder]
-            j_start = jnp.concatenate(
-                [jnp.array([True]), js[1:] != js[:-1]]
-            )
-            pos = segmented_prefix(
-                take[jorder].astype(jnp.float32)[:, None], j_start
-            )[:, 0].astype(i32)
-            keep_j = take[jorder] & (pos < slack_rem[jnp.clip(js, 0, J - 1)])
-            take = jnp.zeros(T, bool).at[jorder].set(keep_j)
-
-        got = jax.ops.segment_sum(
-            jnp.where(take[:, None], snap.task_resreq, 0.0),
-            jnp.where(take, snap.task_node, N),
-            num_segments=N + 1,
-        )[:N]
-        covered = node_has_claim & jnp.all(
-            got >= node_req - snap.quanta, axis=-1
-        )
-        final_take = take & covered[vn]
 
         new_claim = is_winner & covered[jnp.clip(best, 0, N - 1)]
         claim_node = jnp.where(new_claim, best, claim_node)

@@ -3,13 +3,32 @@
 Holds the cache, the configured action pipeline, and the plugin tiers; each
 tick opens a session (snapshot + plugin open), executes the actions in conf
 order, and closes the session (status writeback). `run_forever` is the
-wait.Until(runOnce, period) analog."""
+wait.Until(runOnce, period) analog — and, by default, its PIPELINED
+successor: the cycle is an explicitly staged pipeline
+
+    ingest drain → delta session open → device solve → host replay
+                 → status derive ║ writeback (status flush + binder drain)
+
+where everything left of ║ runs on the cycle thread and the writeback
+stage runs on a single worker, double-buffered: cycle N+1's ingest drain,
+delta open, and solve dispatch proceed while cycle N's status flush and
+async binder drain complete (the PR 3 fit-error-histogram overlap inside
+allocate is the in-cycle instance of the same mechanism).  Cycle
+triggering is event-driven: the cache's dirty-version advance wakes a
+condition variable, so an arrival burst schedules immediately instead of
+waiting out the reference's fixed 1 s tick, while an idle cluster ticks at
+the slow floor.  Knobs: ``KB_PIPELINE=0`` restores the serial
+wait.Until loop (the bit-exactness oracle), ``KB_PERIOD_MIN`` is the
+minimum spacing between cycle starts (rate floor for bursts),
+``KB_PERIOD_MAX`` the idle tick period (default: the schedule period)."""
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from kube_batch_tpu import actions as _actions  # registers actions
@@ -22,6 +41,71 @@ from kube_batch_tpu import metrics
 from kube_batch_tpu.utils import telemetry
 
 logger = logging.getLogger("kube_batch_tpu")
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "false", "off", "no")
+
+
+class CycleTrigger:
+    """Event-driven cycle pacing: the cache's dirty-version advance (and the
+    staged-ingest arrival hook) call :meth:`notify`; the loop waits on the
+    condition variable between cycles.  A pending signal — even one raised
+    MID-cycle — wakes the next cycle as soon as the ``min_period`` rate
+    floor allows; with no signal the loop idles until ``max_period`` since
+    the last cycle start (the reference's 1 s tick becomes the slow floor).
+
+    Deadline arithmetic reads the INJECTED clock (the Scheduler's clock
+    seam) so tests can pace it; the blocking itself is the condition
+    variable's (real-time) wait, re-armed against the injected deadline
+    each lap."""
+
+    def __init__(self, clock=None):
+        self.clock = clock if clock is not None else time
+        # the guard lock is created HERE (not Condition's default, which
+        # would be born inside the threading module) so the runtime lockdep
+        # checker tracks it: notify() under the cache's big lock records the
+        # big→trigger edge, and any reverse nesting would report
+        self._cond = threading.Condition(lock=threading.Lock())
+        self._pending = False
+
+    def notify(self) -> None:
+        """Wake the loop (never blocks; safe from any thread, including
+        under the cache's locks — the condition guard is a leaf)."""
+        with self._cond:
+            self._pending = True
+            self._cond.notify_all()
+
+    def poll(self) -> bool:
+        """Consume a pending signal without waiting (the sim's virtual-time
+        pacing asks 'would the trigger fire now?' instead of blocking)."""
+        with self._cond:
+            pending, self._pending = self._pending, False
+            return pending
+
+    def wait_for_work(self, cycle_start: float, min_period: float,
+                      max_period: float) -> str:
+        """Block until the next cycle should start; returns the wake reason
+        (``"ingest"`` — signalled arrival churn; ``"floor"`` — the idle
+        period elapsed).  The rate floor is enforced first: bursts coalesce
+        into one cycle per ``min_period``, so a hot ingest stream cannot
+        busy-spin the solve."""
+        clock = self.clock
+        floor_rem = min_period - (clock.monotonic() - cycle_start)
+        if floor_rem > 0:
+            clock.sleep(floor_rem)
+        deadline = cycle_start + max_period
+        with self._cond:
+            while not self._pending:
+                rem = deadline - clock.monotonic()
+                if rem <= 0:
+                    return "floor"
+                self._cond.wait(rem)
+            self._pending = False
+            return "ingest"
 
 
 class Scheduler:
@@ -63,6 +147,24 @@ class Scheduler:
         # sheds the close-time status flush to the cache's async pool and
         # keeps ticking, instead of stalling the loop in egress writeback
         self.cycle_budget = float(os.environ.get("KB_CYCLE_BUDGET", "0") or 0)
+        # event-driven pipelined loop (the default; KB_PIPELINE=0 restores
+        # the serial wait.Until loop as the bit-exactness oracle)
+        self.pipelined = _env_flag("KB_PIPELINE", True)
+        # cycle-start spacing: bursts coalesce to one cycle per min_period;
+        # an idle cluster ticks every max_period (default: today's period)
+        self.min_period = float(
+            os.environ.get("KB_PERIOD_MIN", "") or
+            min(0.05, schedule_period)
+        )
+        self.max_period = float(
+            os.environ.get("KB_PERIOD_MAX", "") or schedule_period
+        )
+        self.trigger = CycleTrigger(clock=self.clock)
+        # the writeback stage: one worker, double-buffered — at most one
+        # cycle's (status flush + binder drain) in flight while the next
+        # cycle computes; _await_writeback is the stage barrier
+        self._wb_pool: Optional[ThreadPoolExecutor] = None
+        self._wb_future = None
 
     def _stat_conf(self) -> Optional[float]:
         if not self._conf_path:
@@ -100,7 +202,30 @@ class Scheduler:
         self._conf_mtime = mtime
 
     def run_once(self) -> None:
-        """(scheduler.go:88-102)"""
+        """(scheduler.go:88-102) — the serial cycle: every stage inline,
+        binder drain at the end, deterministic post-cycle state.  The
+        pipelined loop runs the same stages via :meth:`run_once_pipelined`;
+        this form stays the bit-exactness oracle (KB_PIPELINE=0)."""
+        self._cycle(pipelined=False)
+
+    def run_once_pipelined(self) -> None:
+        """One pipelined cycle: staged ingest drains under one lock, the
+        session opens/solves/replays on this thread, the close DERIVES the
+        status pass synchronously but hands the egress half (status flush +
+        async binder drain) to the writeback worker — overlapped with the
+        caller's next cycle.  :meth:`drain_pipeline` (or the next cycle's
+        stage barrier) joins it."""
+        self._cycle(pipelined=True)
+
+    def _cycle(self, pipelined: bool) -> None:
+        if pipelined:
+            # ingest stage: everything the watch/ingest threads staged since
+            # the last cycle applies under ONE cache-lock acquisition —
+            # BEFORE the resync drain, so repair decisions see the freshest
+            # pod store
+            drain = getattr(self.cache, "drain_staged_ingest", None)
+            if drain is not None:
+                metrics.register_staged_ingest(drain())
         # drain the resync queue at the cycle boundary: the background repair
         # tick (cache.go:563-581) skips while an exclusive session owns the
         # cache, and at small schedule periods sessions run nearly
@@ -119,6 +244,7 @@ class Scheduler:
         # the configured pipeline, for actions whose behavior depends on
         # what runs after them (reclaim's idle-fit claimant gate)
         ssn.action_names = [a.name for a in self.actions]
+        staged_flush = None
         try:
             for action in self.actions:
                 a_start = telemetry.perf_counter()
@@ -138,23 +264,93 @@ class Scheduler:
                 metrics.register_cycle_budget_exceeded()
                 self.cache.shed_status_writes = True
             try:
-                close_session(ssn)
+                # pipelined: the close stages the flush (degraded verdict
+                # captured NOW, while the shed flag is visible) and skips
+                # the inline binder drain — both run on the writeback worker
+                staged_flush = close_session(ssn, stage_flush=pipelined)
             finally:
                 if shed:
                     self.cache.shed_status_writes = False
+                if pipelined:
+                    # stage barrier: at most one writeback generation in
+                    # flight (double buffer) — join cycle N-1's egress, then
+                    # hand off ours.  INSIDE the finally: a cycle that died
+                    # in an action still staged its flush, and the stage
+                    # already recorded the queue deltas / rate-limit windows
+                    # as written — dropping the flush here would suppress
+                    # those writes until the counts next change.  A close
+                    # whose OWN finally raised after staging never returned
+                    # the flush — recover it from the session stash.
+                    if staged_flush is None:
+                        staged_flush = getattr(ssn, "staged_flush", None)
+                    self._await_writeback()
+                    self._submit_writeback(staged_flush)
         metrics.observe_e2e_latency((telemetry.perf_counter() - start) * 1e3)
-        # drain async binder dispatch (cache.go:478's goroutines) outside the
-        # measured cycle so callers observe a deterministic post-cycle state
-        flush = getattr(self.cache, "flush_binds", None)
-        if flush is not None:
-            flush()
+        if not pipelined:
+            # drain async binder dispatch (cache.go:478's goroutines) outside
+            # the measured cycle so callers observe a deterministic
+            # post-cycle state
+            flush = getattr(self.cache, "flush_binds", None)
+            if flush is not None:
+                flush()
         if self.on_cycle_end is not None:
             self.on_cycle_end()
 
+    # ---- writeback stage (the overlapped half of the pipeline) ----------
+    def _writeback(self, staged_flush) -> None:
+        t0 = telemetry.perf_counter()
+        if staged_flush:
+            self.cache.run_status_flush(staged_flush)
+        drain = getattr(self.cache, "flush_binds", None)
+        if drain is not None:
+            drain()
+        metrics.observe_pipeline_overlap(
+            (telemetry.perf_counter() - t0) * 1e3
+        )
+
+    def _submit_writeback(self, staged_flush) -> None:
+        if self._wb_pool is None:
+            self._wb_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kb-writeback"
+            )
+        self._wb_future = self._wb_pool.submit(self._writeback, staged_flush)
+
+    def _await_writeback(self) -> None:
+        fut, self._wb_future = self._wb_future, None
+        if fut is not None:
+            try:
+                fut.result()
+            except Exception:  # noqa: BLE001 — next close re-derives
+                logger.exception("writeback stage failed; statuses will "
+                                 "re-derive next cycle")
+
+    def drain_pipeline(self) -> None:
+        """Join the in-flight writeback stage and apply any still-staged
+        ingest — the deterministic post-cycle state the serial run_once
+        gives inline.  Tests, the sim, and shutdown call this."""
+        self._await_writeback()
+        drain = getattr(self.cache, "drain_staged_ingest", None)
+        if drain is not None:
+            metrics.register_staged_ingest(drain())
+
+    def _recover_failed_cycle(self) -> None:
+        # exclusive (no-clone) sessions mutate the authoritative cache in
+        # place: a cycle that died mid-mutation may have leaked partial
+        # state — rebuild from the pod store (the informer re-list analog)
+        # before the next cycle
+        recover = getattr(self.cache, "rebuild_from_pod_store", None)
+        if recover is not None:
+            try:
+                recover()
+            except Exception:  # noqa: BLE001
+                logger.exception("re-list recovery failed")
+
     def run_forever(self) -> None:
-        """wait.Until(runOnce, period) preceded by cache.Run — the reference
-        starts the cache's background repair loops (resync + cleanup) before
-        ticking (scheduler.go:63-86, cache.go:342-384)."""
+        """The L1 loop, preceded by cache.Run — the reference starts the
+        cache's background repair loops (resync + cleanup) before ticking
+        (scheduler.go:63-86, cache.go:342-384).  KB_PIPELINE=0 gives the
+        reference's serial wait.Until(runOnce, period); the default is the
+        event-driven pipelined loop (module docstring)."""
         cache_run = getattr(self.cache, "run", None)
         if cache_run is not None:
             cache_run(resync_period=min(self.schedule_period, 1.0))
@@ -162,22 +358,16 @@ class Scheduler:
         # run_forever in the same process after a leadership loss
         self._stop = False
         try:
+            if self.pipelined:
+                self._run_forever_pipelined()
+                return
             while not self._stop:
                 tick = self.clock.monotonic()
                 try:
                     self.run_once()
                 except Exception:  # noqa: BLE001 — next cycle self-corrects
                     logger.exception("scheduling cycle failed")
-                    # exclusive (no-clone) sessions mutate the authoritative
-                    # cache in place: a cycle that died mid-mutation may have
-                    # leaked partial state — rebuild from the pod store (the
-                    # informer re-list analog) before the next cycle
-                    recover = getattr(self.cache, "rebuild_from_pod_store", None)
-                    if recover is not None:
-                        try:
-                            recover()
-                        except Exception:  # noqa: BLE001
-                            logger.exception("re-list recovery failed")
+                    self._recover_failed_cycle()
                 elapsed = self.clock.monotonic() - tick
                 self.clock.sleep(max(self.schedule_period - elapsed, 0.0))
         finally:
@@ -185,5 +375,53 @@ class Scheduler:
             if cache_stop is not None:
                 cache_stop()
 
+    def _run_forever_pipelined(self) -> None:
+        """The event-driven pipelined loop (the caller holds the cache-run /
+        cache-stop bracket).  Ingest staging routes watch churn through the
+        leaf staging buffer, the dirty tracker's version advance wakes the
+        trigger, and shutdown drains every in-flight stage before the cache
+        stops."""
+        cache = self.cache
+        enable = getattr(cache, "enable_ingest_staging", None)
+        signal = getattr(cache, "set_ingest_signal", None)
+        if signal is not None:
+            signal(self.trigger.notify)
+        if enable is not None:
+            enable()
+        logger.info(
+            "pipelined cycle loop: event-driven trigger, min_period=%.3fs "
+            "max_period=%.3fs (KB_PIPELINE=0 for the serial oracle)",
+            self.min_period, self.max_period,
+        )
+        try:
+            while not self._stop:
+                tick = self.clock.monotonic()
+                try:
+                    self.run_once_pipelined()
+                except Exception:  # noqa: BLE001 — next cycle self-corrects
+                    logger.exception("scheduling cycle failed")
+                    self._recover_failed_cycle()
+                reason = self.trigger.wait_for_work(
+                    tick, self.min_period, self.max_period
+                )
+                metrics.register_trigger_wake(reason)
+        finally:
+            # shutdown drain: join the in-flight writeback, apply staged
+            # ingest, and detach the trigger so a re-armed run_forever (the
+            # warm-standby path) starts from a clean pipeline
+            try:
+                disable = getattr(cache, "disable_ingest_staging", None)
+                if disable is not None:
+                    disable()
+                self.drain_pipeline()
+            finally:
+                if signal is not None:
+                    signal(None)
+                if self._wb_pool is not None:
+                    self._wb_pool.shutdown(wait=True)
+                    self._wb_pool = None
+
     def stop(self) -> None:
         self._stop = True
+        # a stopping pipelined loop may be idling at the slow floor — wake it
+        self.trigger.notify()

@@ -34,10 +34,14 @@ def main(argv=None) -> int:
                     help="also write the JSON report to this path")
     ap.add_argument("--no-fairness-series", action="store_true",
                     help="omit the per-cycle fairness series (compact)")
+    ap.add_argument("--pipelined", action="store_true",
+                    help="event-driven pipelined cycles: wake at arrivals "
+                         "(floored by the preset's min_period) instead of "
+                         "the fixed tick; staged close + writeback worker")
     args = ap.parse_args(argv)
 
     report = run_preset(args.preset, seed=args.seed, cycles=args.cycles,
-                        trace_path=args.trace)
+                        trace_path=args.trace, pipelined=args.pipelined)
     if args.no_fairness_series:
         report.pop("fairness_series", None)
     out = json.dumps(report, indent=2, sort_keys=True)

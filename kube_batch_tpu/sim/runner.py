@@ -11,6 +11,14 @@ advances one schedule period. No apiserver, no wall-clock waits, no
 sampling during the run — same seed, byte-identical trace.
 
 `python -m kube_batch_tpu.sim --seed 7 --preset smoke` is the CLI front.
+
+`--pipelined` switches the loop to the event-driven pacing of the L1
+pipeline (PR 9): cycles run at arrival events (floored by the config's
+`min_period`, capped by the idle `period`) through the REAL staged cycle
+body — run_once_pipelined + the writeback worker, joined per cycle so the
+trace stays seed-deterministic.  The report's `pod_bind_latency_vt` is
+then the arrival→decision latency the event trigger optimizes; compare
+against the serial run of the same preset/seed.
 """
 
 from __future__ import annotations
@@ -76,6 +84,16 @@ class SimConfig:
     duration_range: Tuple[float, float] = (3.0, 12.0)
     start_latency: float = 0.5
     arrivals: Optional[List[SimEvent]] = None  # pre-built / trace-driven
+    # event-driven pipelined pacing (the L1 loop's CycleTrigger under
+    # virtual time): instead of ticking every `period`, the next cycle runs
+    # at the earliest pending event, floored by `min_period` (burst
+    # coalescing) and capped by `period` (the idle tick).  The cycle BODY is
+    # the real pipelined one (staged close + writeback worker), joined per
+    # cycle so the trace stays seed-deterministic; what virtual time
+    # measures is the TRIGGER policy — arrival→decision latency — while the
+    # wall-clock bench measures the overlap gain.
+    pipelined: bool = False
+    min_period: float = 0.05
     # faults
     faults: Tuple[SimEvent, ...] = ()
     evict_delay: float = 1.0
@@ -463,38 +481,87 @@ class SimRunner:
         return pending, running
 
     # ---- the loop --------------------------------------------------------
+    def _one_cycle(self) -> Tuple[int, int]:
+        """Apply due events, run one scheduling cycle (serial or pipelined
+        body per the config), drain the kubelet, sample the longitudinal
+        metrics.  Returns (pending, running) task counts."""
+        now = self.clock.now()
+        for event in self.heap.pop_due(now):
+            self._apply(event)
+        if self.cfg.pipelined:
+            # the real staged cycle — close stages the flush, the writeback
+            # worker runs it — joined immediately so binder acks land before
+            # the kubelet drain and the trace stays byte-deterministic
+            self.scheduler.run_once_pipelined()
+            self.scheduler.drain_pipeline()
+        else:
+            self.scheduler.run_once()  # flushes async binds at its end
+        self._drain_kubelet(now)
+        pending, running = self._task_counts()
+        shares = self._queue_shares()
+        # surface the longitudinal fairness series live: the same
+        # per-queue share/entitlement samples the report aggregates are
+        # exported as volcano_queue_* gauges, so a /metrics scrape of a
+        # sim-driven (or production) process sees the current window
+        prom_metrics.set_queue_shares(shares)
+        self.metrics.note_cycle(
+            now, shares, pending, running,
+            snapshot_path=(
+                f"{self.cache.last_open_path}"
+                f"/{self.cache.columns.last_snapshot_path}"
+            ),
+            churn=self.cache.last_churn,
+        )
+        return pending, running
+
+    def _drained(self, pending: int) -> bool:
+        submitted = len(self.metrics.arrivals)
+        return (not self.heap and pending == 0 and submitted > 0
+                and len(self.metrics.completions) == submitted)
+
     def run(self) -> Dict:
         self._setup()
         cfg = self.cfg
         cycles_run = 0
-        for _ in range(cfg.cycles):
-            now = self.clock.now()
-            for event in self.heap.pop_due(now):
-                self._apply(event)
-            self.scheduler.run_once()  # flushes async binds at its end
-            self._drain_kubelet(now)
-            pending, running = self._task_counts()
-            shares = self._queue_shares()
-            # surface the longitudinal fairness series live: the same
-            # per-queue share/entitlement samples the report aggregates are
-            # exported as volcano_queue_* gauges, so a /metrics scrape of a
-            # sim-driven (or production) process sees the current window
-            prom_metrics.set_queue_shares(shares)
-            self.metrics.note_cycle(
-                now, shares, pending, running,
-                snapshot_path=(
-                    f"{self.cache.last_open_path}"
-                    f"/{self.cache.columns.last_snapshot_path}"
-                ),
-                churn=self.cache.last_churn,
+        if cfg.pipelined:
+            # event-driven pacing over the SAME virtual horizon as the
+            # serial run (cycles × period): wake at the earliest pending
+            # event, floored by min_period, capped by the idle period — the
+            # CycleTrigger's semantics computed from the event heap (a
+            # virtual clock has no condition variable to block on).  The
+            # iteration cap bounds a pathological event stream.
+            horizon = cfg.cycles * cfg.period
+            max_cycles = cfg.cycles * max(
+                2, int(round(cfg.period / max(cfg.min_period, 1e-6)))
             )
-            cycles_run += 1
-            submitted = len(self.metrics.arrivals)
-            if (not self.heap and pending == 0
-                    and submitted
-                    and len(self.metrics.completions) == submitted):
-                break  # workload fully drained — nothing left to simulate
-            self.clock.sleep(cfg.period)
+            try:
+                while cycles_run < max_cycles:
+                    pending, _ = self._one_cycle()
+                    cycles_run += 1
+                    if self._drained(pending):
+                        break
+                    now = self.clock.now()
+                    nxt = self.heap.next_time()
+                    if nxt is None:
+                        step = cfg.period       # idle: tick at the slow floor
+                    else:
+                        step = min(max(nxt - now, cfg.min_period), cfg.period)
+                    if now + step > horizon:
+                        break
+                    self.clock.sleep(step)
+            finally:
+                # the per-cycle drain already joined every stage; retire the
+                # writeback worker so runners don't leak executor threads
+                if self.scheduler._wb_pool is not None:
+                    self.scheduler._wb_pool.shutdown(wait=True)
+                    self.scheduler._wb_pool = None
+        else:
+            for _ in range(cfg.cycles):
+                pending, _ = self._one_cycle()
+                cycles_run += 1
+                if self._drained(pending):
+                    break  # workload fully drained — nothing left to simulate
+                self.clock.sleep(cfg.period)
         return self._finalize(cycles_run)
 
     # ---- end-of-run checks ----------------------------------------------
@@ -579,6 +646,7 @@ class SimRunner:
         report.update({
             "unit": "virtual_seconds",
             "seed": cfg.seed,
+            "cycle_mode": "pipelined" if cfg.pipelined else "serial",
             "cycles_run": cycles_run,
             "resident_scatter": scatter,
             **({"solve_collectives": solve_collectives}
@@ -600,6 +668,7 @@ class SimRunner:
                 "queues": list(map(list, cfg.queues)),
                 "cycles": cfg.cycles,
                 "period": cfg.period,
+                "min_period": cfg.min_period if cfg.pipelined else None,
                 "n_jobs_poisson": cfg.n_jobs if cfg.arrivals is None else 0,
                 "faults": [e.kind for e in cfg.faults],
             },
@@ -650,11 +719,13 @@ class SimRunner:
 
 
 def run_preset(name: str, seed: int = 0, cycles: Optional[int] = None,
-               trace_path: Optional[str] = None) -> Dict:
+               trace_path: Optional[str] = None,
+               pipelined: bool = False) -> Dict:
     """One-call entrypoint used by the CLI and the tests."""
     cfg = preset(name, seed=seed)
     if cycles is not None:
         cfg.cycles = cycles
+    cfg.pipelined = pipelined
     runner = SimRunner(cfg)
     report = runner.run()
     report["metric"] = f"sim_{name}_makespan_vt"

@@ -36,3 +36,9 @@ echo "kbt-check: chaos smoke clean"
 # Prometheus-counter + amortization assertions (scripts/whatif_smoke.py)
 echo "kbt-check: whatif smoke (query plane)"
 env JAX_PLATFORMS=cpu python scripts/whatif_smoke.py
+
+# pipeline smoke: the event-driven pipelined loop's virtual-time evidence —
+# trigger-bound p99 ≥2× better than the fixed 1 s tick, and the bind-storm
+# chaos preset pipelined with zero duplicate binds and a full drain
+echo "kbt-check: pipeline smoke (event-driven cycles)"
+env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py

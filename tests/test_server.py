@@ -40,15 +40,19 @@ def _get(port: int, path: str):
         return json.loads(body) if "json" in ctype else body.decode()
 
 
-def _post(port: int, path: str, obj):
+def _post_method(port: int, path: str, obj, method: str):
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}",
         data=json.dumps(obj).encode(),
-        method="POST",
+        method=method,
         headers={"Content-Type": "application/json"},
     )
     with urllib.request.urlopen(req, timeout=5) as r:
         return json.loads(r.read())
+
+
+def _post(port: int, path: str, obj):
+    return _post_method(port, path, obj, "POST")
 
 
 class TestOptions:
@@ -186,6 +190,36 @@ class TestAdminServer:
         job = next(iter(cache.jobs.values()))
         assert len(job.tasks) == 1
         assert job.total_request.milli_cpu == 700.0
+
+    def test_batched_ingest_list_body(self, server):
+        """A list body applies the whole batch under one lock acquisition
+        and ONE dirty-version advance — the high-QPS ingest path."""
+        cache, srv = server
+        _post(srv.port, "/v1/queues", {"name": "default", "weight": 1})
+        v0 = cache.dirty.version
+        pods = [
+            serialize.pod_to_dict(build_pod(
+                "default", f"bp{i}", None, PodPhase.PENDING, {"cpu": 100.0}))
+            for i in range(6)
+        ]
+        resp = _post(srv.port, "/v1/pods", pods)
+        assert resp == {"ok": True, "applied": 6}
+        assert all(f"default/bp{i}" in cache.pods for i in range(6))
+        assert cache.dirty.version == v0 + 1
+        # batched DELETE takes the same path
+        resp = _post_method(srv.port, "/v1/pods", pods[:2], "DELETE")
+        assert resp == {"ok": True, "applied": 2}
+        assert "default/bp0" not in cache.pods
+        assert "default/bp2" in cache.pods
+
+    def test_batched_ingest_rejects_malformed_batch_wholesale(self, server):
+        cache, srv = server
+        good = serialize.pod_to_dict(build_pod(
+            "default", "gx", None, PodPhase.PENDING, {"cpu": 100.0}))
+        with pytest.raises(urllib.error.HTTPError):
+            _post(srv.port, "/v1/pods", [good, {"bogus_field": 1}])
+        # the whole batch parses before any element applies
+        assert "default/gx" not in cache.pods
 
     def test_delete_and_errors(self, server):
         cache, srv = server
