@@ -265,12 +265,15 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         rec["compiles"] = jitstats.total_compiles() - compiles0
         rec["open_path"] = cache.last_open_path
         rec["snapshot_path"] = cache.columns.last_snapshot_path
+        rec["topk"] = get_action("allocate").last_topk
+        rec["solve_rounds"] = get_action("allocate").last_solve_rounds
         records.append(rec)
     cache.stop()
 
     warm, steady = records[:warmup_cycles], records[warmup_cycles:]
     phase_keys = sorted(set().union(*(set(r) for r in steady))
-                        - {"compiles", "open_path", "snapshot_path"})
+                        - {"compiles", "open_path", "snapshot_path",
+                           "topk", "solve_rounds"})
     summary = {
         k: {
             "p50": round(_pct([r.get(k, 0.0) for r in steady], 0.50), 2),
@@ -286,6 +289,29 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
     for r in steady:
         key = f"{r['open_path']}/{r['snapshot_path']}"
         paths[key] = paths.get(key, 0) + 1
+    # candidate-compaction evidence (ISSUE 10): which steady cycles ran the
+    # compacted program, the K/bucket they ran at, and the exhaustion /
+    # full-head-re-entry counters that prove K is sized right (an
+    # exhaustion rate near 0 means the table almost never falls back)
+    topk_cycles = [r for r in steady if r.get("topk")]
+    rounds_steady = [r.get("solve_rounds", 0) for r in steady]
+    topk_summary = {
+        "compacted_cycles": len(topk_cycles),
+        "steady_cycles": len(steady),
+        "rounds_run_p50": _pct(rounds_steady, 0.50) if rounds_steady else 0,
+    }
+    if topk_cycles:
+        exh = sum(r["topk"]["exhausted"] for r in topk_cycles)
+        reent = sum(r["topk"]["reentries"] for r in topk_cycles)
+        rounds_c = sum(max(r.get("solve_rounds", 0), 1) for r in topk_cycles)
+        topk_summary.update({
+            "k": topk_cycles[-1]["topk"]["k"],
+            "bucket": max(r["topk"]["bucket"] for r in topk_cycles),
+            "exhausted_total": exh,
+            "reentries_total": reent,
+            "exhaustion_rate_per_round": round(exh / rounds_c, 4),
+            "reentries_per_solve": round(reent / len(topk_cycles), 3),
+        })
     return {
         "delta_enabled": delta,
         "pods_target": n_tasks,
@@ -305,6 +331,7 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         # whether ANY steady cycle retraced (must be 0 across the wobble)
         "snapshot_paths": paths,
         "retraces_steady": sum(r["compiles"] for r in steady),
+        "topk": topk_summary,
         "jit_compile_counts": jitstats.compile_counts(),
         # which solve the cycles dispatched ("single" | "sharded") and the
         # per-cycle device-resident cache's delta-vs-full bytes-moved
@@ -336,6 +363,37 @@ def run_multicycle_pair(conf, n_tasks, n_nodes, cycles=8):
     f = mc_full["open_plus_snapshot_build_ms"]["p50"]
     reduction = round(1.0 - d / f, 3) if f > 0 else 0.0
     return mc_delta, mc_full, reduction
+
+
+def run_topk_pair(conf, n_tasks, n_nodes, cycles=6):
+    """Compacted-vs-full solve-phase comparison on the same host/workload
+    (ISSUE 10 acceptance): the multicycle regime with KB_TOPK at its
+    default vs KB_TOPK=0 (the full-matrix oracle).  Returns a dict with
+    both solve p50s, the speedup, and the compacted run's candidate-table
+    stats — the compacted run must also show zero steady retraces."""
+    saved = os.environ.get("KB_TOPK")
+    try:
+        os.environ.pop("KB_TOPK", None)          # default = compacted on
+        on = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+        os.environ["KB_TOPK"] = "0"
+        off = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+    finally:
+        if saved is None:
+            os.environ.pop("KB_TOPK", None)
+        else:
+            os.environ["KB_TOPK"] = saved
+    s_on = on["steady"].get("allocate_solve", {}).get("p50", 0.0)
+    s_off = off["steady"].get("allocate_solve", {}).get("p50", 0.0)
+    return {
+        "pods": n_tasks, "nodes": n_nodes,
+        "solve_p50_ms_topk": s_on,
+        "solve_p50_ms_full": s_off,
+        "solve_speedup": round(s_off / s_on, 2) if s_on > 0 else 0.0,
+        "e2e_p50_ms_topk": on["steady"].get("e2e", {}).get("p50"),
+        "e2e_p50_ms_full": off["steady"].get("e2e", {}).get("p50"),
+        "retraces_steady_topk": on.get("retraces_steady"),
+        "topk": on.get("topk"),
+    }
 
 
 def collective_evidence(n_tasks, n_nodes):
@@ -391,6 +449,36 @@ def collective_evidence(n_tasks, n_nodes):
             nodes2["per_round_bytes"] == base["per_round_bytes"]
             and tasks2["per_round_bytes"] > base["per_round_bytes"]
         ),
+        # the compacted program's contract: after the ONE per-solve
+        # candidate merge + node-column gathers, rounds cross zero bytes
+        "topk": _topk_collective_evidence(n_tasks, n_nodes, J, Q),
+    }
+
+
+def _topk_collective_evidence(n_tasks, n_nodes, J, Q):
+    from kube_batch_tpu.actions.allocate import TOPK_PEND_BUCKETS, resolve_topk
+    from kube_batch_tpu.analysis.jaxpr_audit import abstract_snapshot
+    from kube_batch_tpu.api.snapshot import bucket
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import collective_stats, default_mesh
+
+    k = resolve_topk()
+    if not k:
+        # KB_TOPK=0: the measured cycles dispatched the full program —
+        # emitting compacted-path evidence here would attribute it to a
+        # run that never executed the compacted solve
+        return {"disabled": "KB_TOPK=0 (full-matrix oracle run)"}
+    st = collective_stats(
+        default_mesh(), config=AllocateConfig(topk=k),
+        snap=abstract_snapshot(T=bucket(n_tasks), N=bucket(n_nodes), J=J, Q=Q),
+        pend_bucket=TOPK_PEND_BUCKETS[0],
+    )
+    return {
+        "k": k,
+        "pend_bucket": st["pend_bucket"],
+        "per_round_bytes": st["per_round_bytes"],
+        "per_solve_bytes": st["per_solve_bytes"],
+        "zero_round_collectives": st["per_round_bytes"] == 0,
     }
 
 
@@ -772,6 +860,14 @@ def main() -> None:
             result["multicycle_open_snapshot_reduction"] = red
         except Exception as e:  # noqa: BLE001 — the JSON line must land
             result["multicycle_error"] = f"{type(e).__name__}: {e}"
+        # compacted-vs-full solve comparison at the ISSUE 10 acceptance
+        # shape (20k×2k, CPU) — the ≥2× solve-phase p50 evidence
+        try:
+            result["topk_compare"] = run_topk_pair(
+                conf, 20_000, 2_000, cycles=4
+            )
+        except Exception as e:  # noqa: BLE001
+            result["topk_compare_error"] = f"{type(e).__name__}: {e}"
         # sharded steady-state evidence on a forced 4-device host mesh — a
         # child process, because the device count must be fixed before the
         # child's jax initializes (this process is already single-device)
@@ -854,6 +950,15 @@ def main() -> None:
             result["multicycle"] = mc_d
             result["multicycle_full_rebuild"] = mc_f
             result["multicycle_open_snapshot_reduction"] = red
+
+    # ---- compacted-vs-full solve comparison (ISSUE 10): the top-K
+    # candidate table's ≥2× solve-phase p50 claim at the 20k×2k regime,
+    # with the compacted run's exhaustion/retrace counters
+    if section("topk_compare", margin_s=150):
+        with guarded("topk_compare"):
+            result["topk_compare"] = run_topk_pair(
+                conf, 20_000, 2_000, cycles=6
+            )
 
     # ---- the SHARDED steady-state regime: same persistent-cache churn
     # cycle over the device mesh — the per-shard scatter-delta residency's
@@ -1050,7 +1155,7 @@ def _emit(result: dict, tpu_capture_note: bool) -> None:
         missing = [
             s for s in ("go_loop_ms", "pallas_roundhead", "pipeline5_ms",
                         "het30_ms", "multicycle", "multicycle_sharded",
-                        "whatif_serving")
+                        "whatif_serving", "topk_compare")
             if s not in capture
         ]
         # the matrix is complete only when every build_cases() config has a

@@ -34,9 +34,87 @@ from kube_batch_tpu.api.types import TaskStatus
 from kube_batch_tpu.framework.interface import Action
 from kube_batch_tpu.framework.session import FitFailure, JOB_READY
 from kube_batch_tpu import metrics
-from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
+from kube_batch_tpu.ops.assignment import (
+    AllocateConfig,
+    allocate_solve,
+    allocate_topk_solve,
+)
 
 logger = logging.getLogger("kube_batch_tpu")
+
+# --------------------------------------------------------------------------
+# top-K candidate compaction (KB_TOPK) — dispatch-side planning
+# --------------------------------------------------------------------------
+
+#: the pending-row bucket ladder.  The compacted solve's task axis is ONE
+#: FIXED bucket per task-capacity shape: the largest ladder value at or
+#: below capT/4 (compaction only runs where it wins — pending well under
+#: the task bucket).  Deriving the bucket from capT instead of the
+#: instantaneous pending count makes steady-state retraces structurally
+#: impossible: the bucket cannot move while the cache's shape buckets
+#: don't, no matter how the pending count wobbles (an instantaneous-count
+#: ladder flapped a boundary mid-steady and retraced — measured, rejected).
+#: Cycles whose pending exceeds the bucket (cold starts) run the full
+#: program, which is the right shape there anyway.
+TOPK_PEND_BUCKETS = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 65536)
+
+#: default candidate-list width — the measured knee at bench scales; the
+#: exhaustion re-entry keeps ANY width bit-exact, so K tunes cost, never
+#: correctness
+TOPK_DEFAULT = 32
+
+
+def resolve_topk() -> int:
+    """KB_TOPK: candidate-list width K (default 32); 0 disables compaction
+    and keeps the full-matrix program as the oracle — same contract as
+    KB_SHARD_MAP=0 / KB_PIPELINE=0.  An unparsable value DISABLES
+    compaction (a typo'd attempt to turn the knob off must not silently
+    re-enable it and invalidate an oracle comparison)."""
+    raw = os.environ.get("KB_TOPK", "").strip()
+    if not raw:
+        return TOPK_DEFAULT
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        logger.warning("unparsable KB_TOPK=%r; compaction disabled", raw)
+        return 0
+
+
+def topk_bucket_for(capT: int):
+    """The ONE pending bucket a task capacity of ``capT`` compacts into —
+    the largest ladder value at or below capT/4, or None below the
+    smallest rung (tiny clusters: the full program is already cheap)."""
+    fit = [b for b in TOPK_PEND_BUCKETS if b <= capT // 4]
+    return fit[-1] if fit else None
+
+
+def plan_topk_bucket(snap, cols, k: int):
+    """The dispatch's compaction plan: (pend_rows [P] np.int32, K) or
+    (None, 0) when the full-matrix program should run.
+
+    Compaction is declined when it cannot win: no pending rows (idle
+    cycles are skipped upstream anyway), K no smaller than the node
+    bucket, a task bucket too small to carry a compaction rung, or a
+    pending set past the bucket (the cold-start regime — the full
+    program IS the right shape there).  The bucket itself is a pure
+    function of the task-capacity shape (:func:`topk_bucket_for`), so
+    the compacted program's shapes can only change when the cache's own
+    shape buckets do — zero steady-state retraces by construction."""
+    del cols  # the bucket is shape-derived; no per-cache state
+    capT = int(snap.task_req.shape[0])
+    capN = int(snap.node_idle.shape[0])
+    if k <= 0 or k >= capN:
+        return None, 0
+    bucket = topk_bucket_for(capT)
+    if bucket is None:
+        return None, 0
+    rows = np.flatnonzero(np.asarray(snap.task_pending))
+    if rows.size == 0 or rows.size > bucket:
+        return None, 0
+    pend_rows = np.full(bucket, -1, np.int32)
+    pend_rows[: rows.size] = rows.astype(np.int32)
+    return pend_rows, k
+
 
 def _run_bounds(sorted_arr) -> list:
     """[lo..hi) run boundaries of equal values in a sorted array — the
@@ -96,25 +174,53 @@ def session_allocate_config(ssn) -> AllocateConfig:
 
 
 def dispatch_allocate_solve(snap, config, cols=None):
-    """Shard-or-local solve dispatch; returns (result, mode).
+    """Shard-or-local solve dispatch; returns (result, mode, topk_info).
 
     With a ColumnStore, the ingest-static feature columns ride the
     device-resident cache (columns.resident_features) so per-cycle
     host→device traffic is only the truly per-cycle arrays; the caller's
-    `snap` stays host-backed for its numpy reads."""
+    `snap` stays host-backed for its numpy reads.
+
+    ``topk_info`` records the compaction decision ({"k", "bucket"} when
+    the KB_TOPK compacted program ran, None otherwise) — the action folds
+    the solve's exhaustion counters into it for the bench/sim."""
     from kube_batch_tpu.parallel.mesh import (
+        TASK_AXIS,
         default_mesh,
         sharded_allocate_solve,
+        sharded_allocate_topk_solve,
         should_shard,
     )
 
+    pend_rows, k = plan_topk_bucket(snap, cols, resolve_topk())
     if should_shard(snap.node_alloc.shape[0]):
         mesh = default_mesh()
+        # the compacted body requires a 1-D node mesh — the 2-D task-axis
+        # grid is the cold-start HBM escape, where compaction can't apply
+        if pend_rows is not None and dict(mesh.shape).get(TASK_AXIS, 1) == 1:
+            return (
+                sharded_allocate_topk_solve(
+                    resident_snap(cols, snap, mesh), pend_rows,
+                    config._replace(topk=k), mesh,
+                ),
+                "sharded",
+                {"k": k, "bucket": int(pend_rows.shape[0])},
+            )
         return (
             sharded_allocate_solve(resident_snap(cols, snap, mesh), config, mesh),
             "sharded",
+            None,
         )
-    return allocate_solve(resident_snap(cols, snap), config), "single"
+    if pend_rows is not None:
+        return (
+            allocate_topk_solve(
+                resident_snap(cols, snap), pend_rows,
+                config._replace(topk=k),
+            ),
+            "single",
+            {"k": k, "bucket": int(pend_rows.shape[0])},
+        )
+    return allocate_solve(resident_snap(cols, snap), config), "single", None
 
 
 def republish_query_lease(ssn, snap=None, meta=None, build=None) -> None:
@@ -162,6 +268,10 @@ class AllocateAction(Action):
         # bidding rounds the last solve executed (early exits make this
         # the measured convergence, not the 6x3 cap)
         self.last_solve_rounds = 0
+        # candidate-compaction record of the most recent execute():
+        # {"k", "bucket", "exhausted", "reentries"} when the KB_TOPK
+        # compacted program ran, None otherwise (bench/sim evidence)
+        self.last_topk = None
         # fallback pressure of the most recent execute() (VERDICT r2 #6)
         self.last_fallback: Dict[str, int] = {}
         # jobs whose placements were DISCARDED host-side this execute()
@@ -178,6 +288,7 @@ class AllocateAction(Action):
         self.last_fallback = {}
         self.last_host_discards = 0
         self.last_solve_rounds = 0
+        self.last_topk = None
         self._host_place_count = 0
         self._n_applied = 0
         self._ports_by_node = None
@@ -219,7 +330,7 @@ class AllocateAction(Action):
         # multi-chip parts shard the node axis over the ICI mesh — the
         # production analog of the reference's always-on 16-worker fan-out
         # (scheduler_helper.go:34-64); single-chip or small-N stays local
-        result, self.last_solve_mode = dispatch_allocate_solve(
+        result, self.last_solve_mode, topk_info = dispatch_allocate_solve(
             snap, session_allocate_config(ssn), cols=cols
         )
         # the lease shares this dispatch's resident swap (memoized on the
@@ -227,12 +338,18 @@ class AllocateAction(Action):
         republish_query_lease(ssn, snap, meta)
         # kbt: allow[KBT010] THE sanctioned choke point: one blocking
         # transfer for everything the host replay reads
-        assigned, pipelined, rounds_run = jax.device_get(
-            (result.assigned, result.pipelined, result.rounds_run)
+        assigned, pipelined, rounds_run, topk_exh, topk_reent = jax.device_get(
+            (result.assigned, result.pipelined, result.rounds_run,
+             result.topk_exhausted, result.topk_reentries)
         )
         # convergence diagnostic (round-cap tuning); NOT in last_phase_ms —
         # that dict is ms-typed for the bench phases map
         self.last_solve_rounds = int(rounds_run)
+        if topk_info is not None:
+            topk_info = dict(
+                topk_info, exhausted=int(topk_exh), reentries=int(topk_reent)
+            )
+        self.last_topk = topk_info
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
         t2 = telemetry.perf_counter()

@@ -126,7 +126,7 @@ class BackfillAction(Action):
         snap = snap._replace(
             job_schedulable=snap.job_schedulable & jnp.asarray(safe_np)
         )
-        result, _mode = dispatch_allocate_solve(
+        result, _mode, _topk = dispatch_allocate_solve(
             snap, session_allocate_config(ssn), cols=cols
         )
         # this swap retired the what-if lease on donating backends — re-arm

@@ -549,15 +549,19 @@ class TelemetryMisuseRule(Rule):
 # --------------------------------------------------------------------------
 
 #: calls whose results live on device (the PR 3 resident/solve surface,
-#: extended for the PR 5 sharded scatters + enqueue gate dispatch shapes
-#: and the PR 8 what-if probe — the query plane's outputs are device
-#: arrays until its one sanctioned batch readback)
+#: extended for the PR 5 sharded scatters + enqueue gate dispatch shapes,
+#: the PR 8 what-if probe — the query plane's outputs are device arrays
+#: until its one sanctioned batch readback — and the KB_TOPK compacted
+#: solves, whose candidate-table intermediates and exhaustion counters are
+#: device values until the allocate action's single choke-point readback)
 _DEVICE_SOURCES = {
     "kube_batch_tpu.ops.assignment.allocate_solve",
+    "kube_batch_tpu.ops.assignment.allocate_topk_solve",
     "kube_batch_tpu.ops.assignment.failure_histogram_solve",
     "kube_batch_tpu.ops.eviction.evict_solve",
     "kube_batch_tpu.ops.probe.probe_solve",
     "kube_batch_tpu.parallel.mesh.sharded_allocate_solve",
+    "kube_batch_tpu.parallel.mesh.sharded_allocate_topk_solve",
     "kube_batch_tpu.parallel.mesh.sharded_failure_histogram",
     "kube_batch_tpu.parallel.mesh.sharded_evict_solve",
     "kube_batch_tpu.parallel.mesh.sharded_probe_solve",

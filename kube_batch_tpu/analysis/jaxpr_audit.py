@@ -131,6 +131,42 @@ def _build_failure_histogram():
     return failure_histogram_solve, (abstract_snapshot(),)
 
 
+#: audit-scale pending bucket + candidate width for the compacted solve
+_P, _TOPK = 8, 2
+
+
+def _abstract_pend_rows(P=_P):
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    return S((P,), jnp.int32)
+
+
+def _build_topk_allocate():
+    from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_topk_solve
+
+    return allocate_topk_solve, (
+        abstract_snapshot(), _abstract_pend_rows(),
+        AllocateConfig(topk=_TOPK),
+    )
+
+
+def _build_topk_probe():
+    """The probe traced with a topk>0 config: the query plane reuses the
+    session's AllocateConfig, and the probe's [G, N] head ignores the
+    compaction knob by design (a gang's task axis is already tiny) — this
+    entry pins that the knob stays inert on the probe program."""
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.ops.probe import probe_solve
+
+    batch, rows = _abstract_probe_batch()
+    return probe_solve, (
+        abstract_snapshot(), batch, rows, AllocateConfig(topk=_TOPK),
+        EvictConfig(mode="preempt"), True,
+    )
+
+
 def _build_evict_reclaim():
     from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
 
@@ -182,6 +218,25 @@ def _build_pallas_round_head():
     )
 
 
+def _build_pallas_topk_blocks():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.ops.pallas_kernels import (
+        NODE_TILE,
+        TASK_TILE,
+        masked_topk_blocks,
+    )
+
+    P, N = TASK_TILE, NODE_TILE
+    return masked_topk_blocks, (
+        S((P, N), jnp.float32), S((P, _R), jnp.float32),
+        S((N, _R), jnp.float32), S((N, _R), jnp.float32),
+        S((P,), jnp.int32), S((_R,), jnp.float32),
+        0, True,  # n0=0, interpret=True: auditable off-TPU
+    )
+
+
 def _abstract_probe_batch(B=2, G=4):
     """A ProbeBatch of ShapeDtypeStructs + the [G] row oracle — the query
     plane's serving shapes at audit scale."""
@@ -223,6 +278,7 @@ def _scatter_donation() -> Dict[str, Tuple[int, ...]]:
 
 REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.assignment.allocate_solve", _build_allocate),
+    EntryPoint("ops.assignment.allocate_topk_solve", _build_topk_allocate),
     EntryPoint("ops.assignment.failure_histogram_solve",
                _build_failure_histogram),
     EntryPoint("ops.eviction.evict_solve[reclaim]", _build_evict_reclaim),
@@ -232,7 +288,10 @@ REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.admission.enqueue_gate", _build_enqueue_gate),
     EntryPoint("ops.pallas_kernels.masked_best_node",
                _build_pallas_round_head),
+    EntryPoint("ops.pallas_kernels.masked_topk_blocks",
+               _build_pallas_topk_blocks),
     EntryPoint("ops.probe.probe_solve", _build_probe),
+    EntryPoint("ops.probe.probe_solve[topk-inert]", _build_topk_probe),
 )
 
 
@@ -253,6 +312,14 @@ def _build_sharded_allocate(mesh, impl):
 
     return allocate_solve_fn(mesh, AllocateConfig(), impl=impl), (
         abstract_snapshot(),)
+
+
+def _build_sharded_topk(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import allocate_topk_solve_fn
+
+    fn = allocate_topk_solve_fn(mesh, AllocateConfig(topk=_TOPK), impl=impl)
+    return fn, (abstract_snapshot(), _abstract_pend_rows())
 
 
 def _build_sharded_histogram(mesh, impl):
@@ -353,6 +420,8 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
         entries += [
             EntryPoint(f"parallel.mesh.sharded_allocate_solve{tag}",
                        p(_build_sharded_allocate, mesh, impl)),
+            EntryPoint(f"parallel.mesh.sharded_allocate_topk_solve{tag}",
+                       p(_build_sharded_topk, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_failure_histogram{tag}",
                        p(_build_sharded_histogram, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[reclaim]{tag}",

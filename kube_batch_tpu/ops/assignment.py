@@ -101,6 +101,10 @@ class AllocateConfig(NamedTuple):
     drf: bool = True         # drf job ordering
     proportion: bool = True  # queue overused gating + queue order
     use_pallas: bool = False  # fused round-head kernel (ops/pallas_kernels)
+    topk: int = 0            # top-K candidate compaction width (the
+    #                          allocate_topk_solve path only; 0 in every
+    #                          full-matrix program — see KB_TOPK in
+    #                          actions/allocate.py's dispatch)
     weights: ScoreWeights = ScoreWeights()
 
 
@@ -114,6 +118,10 @@ class AllocateResult(NamedTuple):
     deserved: jnp.ndarray       # [Q, R] proportion deserved (diagnostics)
     rounds_run: jnp.ndarray     # [] i32 — total bidding rounds executed
     #                             (convergence diagnostic for round tuning)
+    topk_exhausted: jnp.ndarray  # [] i32 — task-rounds whose candidate list
+    #                              was exhausted (0 on the full-matrix path)
+    topk_reentries: jnp.ndarray  # [] i32 — rounds that re-entered the
+    #                              full-matrix head for exhausted rows
 
 
 @jax.jit
@@ -295,6 +303,7 @@ def allocate_rounds(
     idle0: jnp.ndarray,
     releasing0: jnp.ndarray,
     used0: jnp.ndarray,
+    compact_head=None,
 ) -> AllocateResult:
     """The solve machinery shared by every allocate path: bidding rounds
     with ``head_fn`` supplying (best, has, chose_idle) per round, conflict
@@ -302,7 +311,13 @@ def allocate_rounds(
     loop.  ``idle0``/``releasing0``/``used0`` are the GLOBAL [N, R] cycle-
     start ledgers (the shard_map body passes the explicitly all-gathered
     replicated copies; per-round cross-shard traffic then lives entirely
-    inside ``head_fn``)."""
+    inside ``head_fn``).
+
+    ``compact_head`` (the top-K compaction path) replaces ``head_fn`` with
+    a head returning ``(best, has, chose_idle, exhausted_count)`` — the
+    candidate-table scan plus its full-matrix exhaustion re-entry (see
+    :func:`allocate_topk_solve`); the extra count feeds the
+    ``topk_exhausted``/``topk_reentries`` diagnostics."""
     T, R = snap.task_req.shape
     N = idle0.shape[0]
     J = snap.job_min_avail.shape[0]
@@ -325,7 +340,7 @@ def allocate_rounds(
 
     def outer_body(state):
         (idle, releasing, used, assigned, pipelined, job_failed, o,
-         rounds_total, _more) = state
+         rounds_total, exh_total, reent_total, _more) = state
 
         # ---- fairness state + virtual-time rank, once per outer pass -----
         # (the rank is a static plan for the whole round set: virtual time
@@ -372,7 +387,8 @@ def allocate_rounds(
             return (i < config.rounds) & progress
 
         def round_body(state):
-            idle, releasing, used, assigned, pipelined, i, _ = state
+            (idle, releasing, used, assigned, pipelined, exh_n, reent_n,
+             i, _) = state
             placed = assigned >= 0
             placed_req = jnp.where(placed[:, None], snap.task_resreq, 0.0)
             job_new = jax.ops.segment_sum(placed_req, snap.task_job, num_segments=J)
@@ -381,7 +397,14 @@ def allocate_rounds(
             )
             pending = eligible & ~placed & ~job_failed[snap.task_job]
 
-            best, has, chose_idle = head_fn(idle, releasing, pending)
+            if compact_head is not None:
+                best, has, chose_idle, exh_round = compact_head(
+                    idle, releasing, pending
+                )
+                exh_n = exh_n + exh_round
+                reent_n = reent_n + (exh_round > 0).astype(jnp.int32)
+            else:
+                best, has, chose_idle = head_fn(idle, releasing, pending)
             if config.proportion:
                 new_alloc_cnt = jax.ops.segment_sum(
                     (placed & ~pipelined).astype(jnp.int32),
@@ -428,14 +451,16 @@ def allocate_rounds(
             newly = acc_a | acc_p
             assigned = jnp.where(newly, best, assigned)
             pipelined = pipelined | acc_p
-            return (idle, releasing, used, assigned, pipelined, i + 1, jnp.any(newly))
+            return (idle, releasing, used, assigned, pipelined, exh_n,
+                    reent_n, i + 1, jnp.any(newly))
 
-        (idle, releasing, used, assigned, pipelined, rounds_i, rounds_progress) = (
+        (idle, releasing, used, assigned, pipelined, exh_total, reent_total,
+         rounds_i, rounds_progress) = (
             jax.lax.while_loop(
                 round_cond,
                 round_body,
-                (idle, releasing, used, assigned, pipelined,
-                 jnp.int32(0), jnp.bool_(True)),
+                (idle, releasing, used, assigned, pipelined, exh_total,
+                 reent_total, jnp.int32(0), jnp.bool_(True)),
             )
         )
         # inner loop capped while still placing? another outer pass continues
@@ -481,10 +506,10 @@ def allocate_rounds(
             eligible & (assigned < 0) & ~job_failed[snap.task_job]
         )
         return (idle, releasing, used, assigned, pipelined, job_failed, o + 1,
-                rounds_total + rounds_i, more)
+                rounds_total + rounds_i, exh_total, reent_total, more)
 
     def outer_cond(state):
-        *_, o, _rounds, more = state
+        *_, o, _rounds, _exh, _reent, more = state
         return (o < config.outer) & more
 
     init = (
@@ -496,11 +521,14 @@ def allocate_rounds(
         jnp.zeros(J, bool),
         jnp.int32(0),
         jnp.int32(0),
+        jnp.int32(0),
+        jnp.int32(0),
         jnp.bool_(True),
     )
     # while_loop with early exit — a scan would pay every outer iteration
     # (~12% of solve time each) even after everything is placed
-    (idle, releasing, used, assigned, pipelined, _, _, rounds_run, _) = (
+    (idle, releasing, used, assigned, pipelined, _, _, rounds_run,
+     exhausted, reentries, _) = (
         jax.lax.while_loop(outer_cond, outer_body, init)
     )
 
@@ -519,6 +547,8 @@ def allocate_rounds(
         node_used=used,
         deserved=deserved,
         rounds_run=rounds_run,
+        topk_exhausted=exhausted,
+        topk_reentries=reentries,
     )
 
 
@@ -531,8 +561,373 @@ def allocate_solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResu
     )
 
 
+# ==========================================================================
+# Top-K candidate compaction (KB_TOPK) — the O(T·K) round inner loop
+# ==========================================================================
+#
+# The full-matrix round head re-streams [T, N]-scale fits/argmax every
+# bidding round even though (a) only the PENDING rows can bid and (b) node
+# budgets only SHRINK between the cycle start and any round (gang reverts
+# return exactly what accepted bids consumed, so idle/releasing never
+# exceed their cycle-start values).  The compacted path exploits both:
+#
+#   pending bucket  — the solve's head runs on a [P] bucket of the cycle's
+#     pending task rows (P ≪ T in steady state; the row map is an input);
+#   candidate table — once per solve, at cycle-start budgets, each bucket
+#     row's nodes are ranked by the EXACT round-head key (score_static
+#     desc, tie_hash desc, node index asc) and the top-K kept.
+#
+# Exactness invariant (why first-fit-over-the-table == full argmax): the
+# table is the exact lexicographic top-K among cycle-start-FEASIBLE nodes;
+# any node outside the table has key ≤ every table entry's key; a round's
+# currently-fitting nodes are a subset of cycle-start-feasible (budgets
+# only shrink); so whenever ANY table entry fits, the two-key argmax over
+# the fitting table entries is the full-matrix argmax.  A row whose table
+# entries ALL stop fitting while the table was truncated (> K feasible
+# nodes at build) is EXHAUSTED: the same round re-enters the full-matrix
+# head for exactly those rows (a lax.cond — steady rounds with no
+# exhaustion never pay it), so compacted-vs-full is bit-exact by
+# construction, not by tolerance.
+
+#: sort-key of NEG — table entries at or below it are invalid padding
+_I32_MIN = jnp.int32(-(2 ** 31))
+
+
+def f32_sort_key(x: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving map f32 → i32 (finite inputs; the solve's scores
+    are finite by construction): integer compare of the keys equals float
+    compare of the values, so the candidate build can run entirely in
+    exact integer arithmetic.  ``x + 0.0`` canonicalizes -0.0 to +0.0
+    first (exact identity for every other value): float compare treats
+    the two zeros as EQUAL, and the raw bit patterns would order them —
+    a custom extra_rows score emitting -0.0 must not break the
+    bit-exactness contract with the float-comparing full-matrix oracle.
+    Zero-canonical inputs make the map a bijection (``_inv_sort_key``)."""
+    b = jax.lax.bitcast_convert_type(x + jnp.float32(0.0), jnp.int32)
+    return jnp.where(b < 0, b ^ jnp.int32(0x7FFFFFFF), b)
+
+
+def _neg_key() -> jnp.ndarray:
+    return f32_sort_key(jnp.float32(NEG))
+
+
+def lex_topk(skey: jnp.ndarray, hash_: jnp.ndarray, idx0: jnp.ndarray,
+             K: int, block: int = 64):
+    """Exact per-row lexicographic top-K of (skey desc, hash desc,
+    position asc) over [P, M] — ``jnp.argmax``'s first-max-index semantics
+    extended to K extractions.  Returns ``(idx, skey, hash)`` [P, K] in
+    descending key order (full-tie entries in ascending position order).
+
+    XLA's CPU ``sort``/``top_k`` are comparator-bound (≈50× a reduction
+    pass at [2k, 2k]); this is a blocked tournament instead: per-block
+    two-key winner triples once, then K extraction steps that re-reduce
+    ONLY the winning block under a (val, hash, position) threshold — no
+    per-step scatter into the [P, M] operands, which stay read-only.
+    ``idx0`` carries the caller's global identity per position (a
+    broadcast arange+offset for a build over a node block; the stored
+    global indices for a cross-shard merge)."""
+    P, M = skey.shape
+    C = min(block, M)
+    Mp = -(-M // C) * C
+    pad = Mp - M
+    if pad:
+        skey = jnp.pad(skey, ((0, 0), (0, pad)), constant_values=-(2 ** 31))
+        hash_ = jnp.pad(hash_, ((0, 0), (0, pad)), constant_values=-1)
+        idx0 = jnp.pad(idx0, ((0, 0), (0, pad)), constant_values=-1)
+    B = Mp // C
+    s3 = skey.reshape(P, B, C)
+    h3 = hash_.reshape(P, B, C)
+    bval = jnp.max(s3, axis=-1)
+    btie = s3 >= bval[..., None]
+    bh = jnp.max(jnp.where(btie, h3, -2), axis=-1)
+    bcol = jnp.argmax(jnp.where(btie, h3, -2), axis=-1).astype(jnp.int32)
+    rows = jnp.arange(P)
+    carange = jnp.arange(C, dtype=jnp.int32)[None, :]
+
+    def step(k, state):
+        bval, bh, bcol, oi, os, oh = state
+        # global two-key argmax over the per-block winners; first block
+        # among full ties = lowest position (blocks are position-ordered)
+        gv = jnp.max(bval, axis=1)
+        tie = bval >= gv[:, None]
+        ghv = jnp.max(jnp.where(tie, bh, -2), axis=1)
+        gb = jnp.argmax(jnp.where(tie, bh, -2), axis=1).astype(jnp.int32)
+        col = jnp.take_along_axis(bcol, gb[:, None], 1)[:, 0]
+        flat = gb * C + col
+        oi = jax.lax.dynamic_update_slice(
+            oi, jnp.take_along_axis(idx0, flat[:, None], 1), (0, k))
+        os = jax.lax.dynamic_update_slice(os, gv[:, None], (0, k))
+        oh = jax.lax.dynamic_update_slice(oh, ghv[:, None], (0, k))
+        # winning block re-reduces under the extracted threshold: keep
+        # strictly-lower keys, or equal keys at LATER positions (extraction
+        # order is monotone, so the threshold subsumes all prior ones)
+        cols_ = (gb * C)[:, None] + carange
+        gs = jnp.take_along_axis(skey, cols_, 1)
+        gh2 = jnp.take_along_axis(hash_, cols_, 1)
+        keep = (gs < gv[:, None]) | ((gs == gv[:, None]) & (
+            (gh2 < ghv[:, None])
+            | ((gh2 == ghv[:, None]) & (cols_ > flat[:, None]))))
+        gs = jnp.where(keep, gs, _I32_MIN)
+        nv = jnp.max(gs, axis=1)
+        nt = gs >= nv[:, None]
+        nh = jnp.max(jnp.where(nt, gh2, -2), axis=1)
+        nc = jnp.argmax(jnp.where(nt, gh2, -2), axis=1).astype(jnp.int32)
+        bval = bval.at[rows, gb].set(nv)
+        bh = bh.at[rows, gb].set(nh)
+        bcol = bcol.at[rows, gb].set(nc)
+        return bval, bh, bcol, oi, os, oh
+
+    init = (bval, bh, bcol, jnp.zeros((P, K), jnp.int32),
+            jnp.full((P, K), _I32_MIN), jnp.full((P, K), -1, jnp.int32))
+    *_, oi, os, oh = jax.lax.fori_loop(0, K, step, init)
+    return oi, os, oh
+
+
+def _remap_rows(sparse_idx: jnp.ndarray, pend_rows: jnp.ndarray) -> jnp.ndarray:
+    """Map sparse per-task row indices (affinity/preference corrections)
+    into pending-bucket slots; rows outside the bucket park at -1 (their
+    corrections can only affect non-pending rows, which the head masks)."""
+    eq = sparse_idx[:, None] == pend_rows[None, :]          # [Ks, P]
+    hit = jnp.any(eq, axis=1) & (sparse_idx >= 0)
+    slot = jnp.argmax(eq, axis=1).astype(jnp.int32)
+    return jnp.where(hit, slot, -1)
+
+
+def pend_view(snap: DeviceSnapshot, pend_rows: jnp.ndarray) -> DeviceSnapshot:
+    """``snap`` with the task axis gathered to the [P] pending bucket
+    (``pend_rows`` global task rows, -1 padding).  Per-element math over
+    the view equals the same rows of the full matrices — the bit-exactness
+    contract shared with the shard_map block view.  Padding slots carry
+    row 0's data with valid/pending forced off, so every consumer masks
+    them out."""
+    T = snap.task_req.shape[0]
+    safe = jnp.clip(pend_rows, 0, T - 1)
+    live = pend_rows >= 0
+
+    def g(arr):
+        return arr[safe]
+
+    return snap._replace(
+        task_req=g(snap.task_req),
+        task_resreq=g(snap.task_resreq),
+        task_job=g(snap.task_job),
+        task_prio=g(snap.task_prio),
+        task_creation=g(snap.task_creation),
+        task_status=g(snap.task_status),
+        task_valid=g(snap.task_valid) & live,
+        task_pending=g(snap.task_pending) & live,
+        task_best_effort=g(snap.task_best_effort),
+        task_sel_bits=g(snap.task_sel_bits),
+        task_sel_impossible=g(snap.task_sel_impossible),
+        task_tol_bits=g(snap.task_tol_bits),
+        task_node=g(snap.task_node),
+        task_critical=g(snap.task_critical),
+        task_needs_host=g(snap.task_needs_host),
+        task_aff_idx=_remap_rows(snap.task_aff_idx, pend_rows),
+        task_pref_idx=_remap_rows(snap.task_pref_idx, pend_rows),
+    )
+
+
+def compact_candidates(view_p: DeviceSnapshot, pend_rows: jnp.ndarray,
+                       idle0: jnp.ndarray, releasing0: jnp.ndarray,
+                       quanta: jnp.ndarray, config: AllocateConfig, n0=0):
+    """The per-solve candidate build over one node block: rank the block's
+    nodes per bucket row by the exact (score_static, tie_hash, index) key
+    at the CYCLE-START budgets and keep the top ``config.topk``.
+
+    Returns ``(idx, skey, hash, n_feas, score_static, tie_hash)`` — the
+    [P, K] table triple in descending key order, the per-row feasible
+    count (the truncation test), and the [P, N_blk] score/hash planes
+    (the single-device path reuses them for the exhaustion re-entry).
+    ``n0`` offsets node indices and the tie hash to GLOBAL coordinates for
+    shard-local blocks, exactly like the shard_map round head."""
+    K = config.topk
+    P = view_p.task_req.shape[0]
+    N_blk = idle0.shape[0]
+    safe_rows = jnp.maximum(pend_rows, 0)
+    tie_hash = tie_break_hash_rows(
+        safe_rows, jnp.arange(N_blk, dtype=jnp.int32) + n0
+    )
+    static_ok = static_predicates(view_p)
+    score = score_matrix(view_p, config.weights)
+    score_static = jnp.where(static_ok, score, NEG)
+    if config.use_pallas:
+        from kube_batch_tpu.ops.pallas_kernels import masked_topk_blocks
+
+        skey0, bval, bhash, bcol = masked_topk_blocks(
+            score_static, view_p.task_req, idle0, releasing0,
+            safe_rows, quanta, n0=n0,
+            interpret=jax.default_backend() != "tpu",
+        )
+        triples = (bval, bhash, bcol)
+        del triples  # block partials are a fusion detail; extraction below
+        # recomputes them from skey0 (the kernel's win is the fused
+        # fit+mask+sort-key emit, not the cheap [P, B] triples)
+    else:
+        fit0 = fits(view_p.task_req, idle0, quanta)
+        fit0_rel = jax.lax.cond(
+            jnp.any(releasing0 > 0.0),
+            lambda rel: fits(view_p.task_req, rel, quanta),
+            lambda rel: jnp.zeros_like(fit0),
+            releasing0,
+        )
+        masked0 = jnp.where(fit0 | fit0_rel, score_static, NEG)
+        skey0 = f32_sort_key(masked0)
+    neg_key = _neg_key()
+    # dtype pinned: the count rides the shard merge's i32 payload and must
+    # stay i32 under the jaxpr audit's x64 probe
+    n_feas = jnp.sum(skey0 > neg_key, axis=1, dtype=jnp.int32)
+    idx0 = jnp.broadcast_to(
+        jnp.arange(N_blk, dtype=jnp.int32)[None, :] + n0, (P, N_blk)
+    )
+    ki, ks, kh = lex_topk(skey0, tie_hash, idx0, K)
+    return ki, ks, kh, n_feas, score_static, tie_hash
+
+
+def make_compact_head(cand_idx, cand_skey, cand_hash, truncated,
+                      req_p, quanta, N: int, fallback_fn):
+    """Build the compacted round head: ``head(idle, releasing, pending) ->
+    (best, has, chose_idle, exhausted_count)``, all [P]-axis — the
+    compacted solve runs :func:`allocate_rounds` NATIVELY on the bucket
+    view (its task axis is shape-generic; the what-if probe's gang-axis
+    solve is the precedent), so the per-round [T]-sized sorts and segment
+    scans of the rank/gate/conflict machinery shrink to [P] too.
+
+    Per round the head gathers ONLY the K candidate nodes' live budgets
+    ([P, K, R]), two-key-argmaxes the fitting entries' stored keys (exact
+    by the module invariant), and re-enters ``fallback_fn(idle, releasing,
+    pending_exh) -> (best_p, has_p, chose_p)`` — the full-matrix head over
+    the bucket — for exhausted rows only, under a lax.cond that steady
+    rounds never execute."""
+    valid = cand_skey > _neg_key()
+    safe_idx = jnp.clip(cand_idx, 0, N - 1)
+
+    def head(idle, releasing, pending):
+        idle_k = idle[safe_idx]                              # [P, K, R]
+        fit_idle = jnp.all(req_p[:, None, :] <= idle_k + quanta, axis=-1)
+        fit_rel = jax.lax.cond(
+            jnp.any(releasing > 0.0),
+            lambda rel: jnp.all(
+                req_p[:, None, :] <= rel[safe_idx] + quanta, axis=-1
+            ),
+            lambda rel: jnp.zeros_like(fit_idle),
+            releasing,
+        )
+        fit_k = valid & (fit_idle | fit_rel) & pending[:, None]
+        sk = jnp.where(fit_k, cand_skey, _I32_MIN)
+        best_sk = jnp.max(sk, axis=1)
+        hk = jnp.where(sk >= best_sk[:, None], cand_hash, -1)
+        # first position among (key, hash) ties = lowest node index — the
+        # table stores full ties in ascending index order
+        pos = jnp.argmax(hk, axis=1)
+        has_p = jnp.any(fit_k, axis=1)
+        best_p = jnp.take_along_axis(cand_idx, pos[:, None], 1)[:, 0]
+        chose_p = jnp.take_along_axis(fit_idle, pos[:, None], 1)[:, 0]
+        exh_p = pending & ~has_p & truncated
+
+        def with_fallback(_):
+            fb_best, fb_has, fb_chose = fallback_fn(idle, releasing, exh_p)
+            return (
+                jnp.where(exh_p, fb_best, best_p),
+                jnp.where(exh_p, fb_has, has_p),
+                jnp.where(exh_p, fb_chose, chose_p),
+            )
+
+        best_p2, has_p2, chose_p2 = jax.lax.cond(
+            jnp.any(exh_p), with_fallback,
+            lambda _: (best_p, has_p, chose_p), None,
+        )
+        # dtype pinned: the count rides a while-loop carry, which must stay
+        # i32 under the jaxpr audit's x64 probe
+        return best_p2, has_p2, chose_p2, jnp.sum(exh_p, dtype=jnp.int32)
+
+    return head
+
+
+def scatter_bucket_result(res: AllocateResult, pend_rows: jnp.ndarray,
+                          T: int) -> AllocateResult:
+    """Re-express a bucket-axis solve result on the full [T] task axis:
+    assigned/pipelined scatter at the bucket's global rows (padding slots
+    land in the dropped T slot of a [T+1] buffer — the segment-sum idiom;
+    negative indices must never reach a scatter).  Every other field is
+    already global ([N, R] ledgers, [J]/[Q] aggregates, scalars).
+
+    Exactness of the bucket-axis solve itself: every schedulable-pending
+    row is IN the bucket (the dispatch guarantees it), non-bucket rows can
+    never bid or place, their zero contributions drop out of every f32
+    prefix/segment sum exactly (x + 0.0 == x), and the bucket preserves
+    ascending global row order (np.flatnonzero), so every stable-sort tie
+    in the rank machinery resolves identically to the full program."""
+    scat = jnp.where(pend_rows >= 0, pend_rows, T)
+    assigned = jnp.full(T + 1, -1, jnp.int32).at[scat].set(res.assigned)[:T]
+    pipelined = jnp.zeros(T + 1, bool).at[scat].set(res.pipelined)[:T]
+    return res._replace(assigned=assigned, pipelined=pipelined)
+
+
+def make_bucket_fallback(view_p: DeviceSnapshot, score_static_p, tie_hash_p,
+                         quanta):
+    """The exhaustion re-entry for a bucket whose full score/hash planes
+    are at hand: the full-matrix head restricted to the [P] bucket —
+    literally :func:`round_head_parts`' masked two-key argmax over the
+    [P, N] planes, masked to the exhausted rows."""
+    req_p = view_p.task_req
+
+    def fallback(idle, releasing, pending_exh):
+        fit_idle = fits(req_p, idle, quanta)
+        fit_rel = jax.lax.cond(
+            jnp.any(releasing > 0.0),
+            lambda rel: fits(req_p, rel, quanta),
+            lambda rel: jnp.zeros_like(fit_idle),
+            releasing,
+        )
+        masked = jnp.where(
+            (fit_idle | fit_rel) & pending_exh[:, None], score_static_p, NEG
+        )
+        best_p, has_p = _best_node(masked, tie_hash_p)
+        chose_p = jnp.take_along_axis(fit_idle, best_p[:, None], 1)[:, 0]
+        return best_p, has_p, chose_p
+
+    return fallback
+
+
+@partial(jax.jit, static_argnames=("config",))
+def allocate_topk_solve(snap: DeviceSnapshot, pend_rows: jnp.ndarray,
+                        config: AllocateConfig) -> AllocateResult:
+    """The compacted allocate solve: identical outputs to
+    :func:`allocate_solve` (the KB_TOPK=0 oracle), computed on the [P]
+    pending bucket × [P, K] candidate table instead of the [T, N]
+    matrices.  ``pend_rows`` [P] i32 must cover every schedulable-pending
+    task row (-1 padding); ``config.topk`` = K > 0.  The dispatch
+    (actions/allocate.py) owns bucket/K selection and the full-path
+    fallbacks for shapes where compaction cannot win."""
+    T = snap.task_req.shape[0]
+    N = snap.node_idle.shape[0]
+    K = config.topk
+    view_p = pend_view(snap, pend_rows)
+    ki, ks, kh, n_feas, score_static_p, tie_hash_p = compact_candidates(
+        view_p, pend_rows, snap.node_idle, snap.node_releasing,
+        snap.quanta, config,
+    )
+    truncated = n_feas > K
+    fallback = make_bucket_fallback(
+        view_p, score_static_p, tie_hash_p, snap.quanta
+    )
+    head = make_compact_head(
+        ki, ks, kh, truncated, view_p.task_req, snap.quanta, N, fallback,
+    )
+    # the rounds run NATIVELY on the bucket view — the rank / queue-gate /
+    # conflict machinery's per-round sorts and segment scans all shrink
+    # from [T] to [P] (see scatter_bucket_result for the exactness story)
+    res = allocate_rounds(
+        view_p, config, None, snap.node_idle, snap.node_releasing,
+        snap.node_used, compact_head=head,
+    )
+    return scatter_bucket_result(res, pend_rows, T)
+
+
 # retrace accounting (utils/jitstats): the bench asserts these stay flat
 # across steady-state cycles — shape-bucketed snapshots must hit the jit
 # cache every cycle after warmup
 jitstats.register("allocate_solve", allocate_solve)
+jitstats.register("allocate_topk_solve", allocate_topk_solve)
 jitstats.register("failure_histogram_solve", failure_histogram_solve)

@@ -203,6 +203,149 @@ def masked_best_node_raw(
     return best[:, 0], val[:, 0], hsh[:, 0], chose[:, 0] > 0.0
 
 
+# --------------------------------------------------------------------------
+# top-K candidate build (ops/assignment.py's KB_TOPK compaction)
+# --------------------------------------------------------------------------
+
+#: sub-block width of the emitted per-block winner triples — must divide
+#: NODE_TILE; the XLA-side extraction (ops.assignment.lex_topk) defaults to
+#: the same block width, so the kernel's partials line up with its grid
+TOPK_BLOCK = 64
+
+
+def _topk_kernel(score_ref, req_ref, idle_ref, rel_ref, rows_ref,
+                 quanta_ref, offs_ref, skey_ref, bval_ref, bhash_ref,
+                 bcol_ref):
+    TM = score_ref.shape[0]
+    TN = score_ref.shape[1]
+    R = req_ref.shape[1]
+    C = TOPK_BLOCK
+    NB = TN // C
+    j = pl.program_id(1)
+
+    req = req_ref[:]
+    quanta = quanta_ref[:]
+
+    def fit_matrix(budget_ref):
+        fit = None
+        for r in range(R):
+            f = req[:, r][:, None] <= budget_ref[:, r][None, :] + quanta[0, r]
+            fit = f if fit is None else (fit & f)
+        return fit
+
+    # the build-time masked key plane: score_static where the node fits the
+    # CYCLE-START budgets, NEG otherwise, as the order-preserving i32 sort
+    # key (ops.assignment.f32_sort_key — same bit trick, Mosaic-safe)
+    feas = fit_matrix(idle_ref) | fit_matrix(rel_ref)
+    masked = jnp.where(feas, score_ref[:], NEG)
+    # + 0.0 canonicalizes -0.0 (exact identity otherwise) — must match
+    # ops.assignment.f32_sort_key bit-for-bit
+    bits = jax.lax.bitcast_convert_type(masked + 0.0, jnp.int32)
+    skey = jnp.where(bits < 0, bits ^ jnp.int32(0x7FFFFFFF), bits)
+    skey_ref[:] = skey
+
+    # the tie hash at GLOBAL (task-row, node) coordinates: task rows come
+    # from an explicit per-row index ref (the pending bucket's rows are
+    # scattered, not an arange block), node columns from the tile offset
+    from kube_batch_tpu.ops.assignment import _H1, _H2, _H3
+
+    ti = jnp.broadcast_to(rows_ref[:], (TM, TN))
+    ni = (
+        jax.lax.broadcasted_iota(jnp.int32, (TM, TN), 1)
+        + j * TN + offs_ref[0, 0]
+    )
+    h = ti * jnp.int32(_H1) + ni * jnp.int32(_H2)
+    h = (h ^ jax.lax.shift_right_logical(h, 15)) * jnp.int32(_H3)
+    tie_hash = jax.lax.shift_right_logical(h, 16)
+
+    # per-C-block two-key winner triples (the extraction's phase-1 input):
+    # max key, max hash among key ties, first column among full ties
+    # trace-time unroll over the static sub-block count (NODE_TILE /
+    # TOPK_BLOCK = 8) inside the kernel body — no per-iteration dispatch;
+    # argmax rides f32 (Mosaic's argmax lowering is f32-only; hashes are
+    # 16-bit ints, so the cast is exact — same trick as the round head)
+    for b in range(NB):
+        sb = skey[:, b * C:(b + 1) * C]
+        hb = tie_hash[:, b * C:(b + 1) * C]
+        # kbt: allow[KBT005] static in-kernel unroll (see loop comment)
+        bval = jnp.max(sb, axis=1)
+        tie = sb >= bval[:, None]
+        # kbt: allow[KBT005] static in-kernel unroll (see loop comment)
+        hmask = jnp.where(tie, hb, -2)
+        # kbt: allow[KBT005] static in-kernel unroll (see loop comment)
+        bcol = jnp.argmax(hmask.astype(jnp.float32), axis=1).astype(jnp.int32)
+        # kbt: allow[KBT005] static in-kernel unroll (see loop comment)
+        bhash = jnp.max(hmask, axis=1)
+        bval_ref[:, b:b + 1] = bval[:, None]
+        bhash_ref[:, b:b + 1] = bhash[:, None]
+        bcol_ref[:, b:b + 1] = bcol[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_topk_blocks(
+    score_static: jnp.ndarray,  # [P, N] f32 — statics already folded (NEG)
+    task_req: jnp.ndarray,      # [P, R] f32 — InitResreq of the bucket rows
+    idle: jnp.ndarray,          # [N, R] f32 — cycle-start budgets
+    releasing: jnp.ndarray,     # [N, R] f32
+    rows: jnp.ndarray,          # [P] i32 — GLOBAL task row per bucket slot
+    quanta: jnp.ndarray,        # [R] f32
+    n0=0,                       # global node offset of this block (i32)
+    interpret: bool = False,
+):
+    """The fused candidate-build head for the KB_TOPK compaction: one VMEM
+    pass emits the masked sort-key plane ``skey`` [P, N] i32 plus the
+    per-``TOPK_BLOCK`` two-key winner triples (``bval``/``bhash``/``bcol``
+    [P, N/TOPK_BLOCK]) without materializing the fit matrices in HBM.  The
+    XLA extraction loop (ops.assignment.lex_topk) consumes ``skey``; the
+    triples prove the kernel computes the exact phase-1 reduction (the
+    parity test cross-checks them).  P must be a multiple of the task tile
+    and N of the node tile, like the round-head kernel."""
+    P, N = score_static.shape
+    R = task_req.shape[1]
+    tile_t = min(TASK_TILE, P)
+    tile_n = min(NODE_TILE, N)
+    grid = (P // tile_t, N // tile_n)
+    NB = tile_n // TOPK_BLOCK
+    q2 = quanta.reshape(1, R).astype(jnp.float32)
+    offs = jnp.asarray([n0], jnp.int32).reshape(1, 1)
+
+    skey, bval, bhash, bcol = pl.pallas_call(
+        _topk_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_t, tile_n), lambda i, j: (i, j)),  # score
+            pl.BlockSpec((tile_t, R), lambda i, j: (i, 0)),       # req
+            pl.BlockSpec((tile_n, R), lambda i, j: (j, 0)),       # idle
+            pl.BlockSpec((tile_n, R), lambda i, j: (j, 0)),       # releasing
+            pl.BlockSpec((tile_t, 1), lambda i, j: (i, 0)),       # rows
+            pl.BlockSpec((1, R), lambda i, j: (0, 0)),            # quanta
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),            # offsets
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_t, tile_n), lambda i, j: (i, j)),  # skey
+            pl.BlockSpec((tile_t, NB), lambda i, j: (i, j)),      # bval
+            pl.BlockSpec((tile_t, NB), lambda i, j: (i, j)),      # bhash
+            pl.BlockSpec((tile_t, NB), lambda i, j: (i, j)),      # bcol
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((P, N), jnp.int32),
+            jax.ShapeDtypeStruct((P, N // TOPK_BLOCK), jnp.int32),
+            jax.ShapeDtypeStruct((P, N // TOPK_BLOCK), jnp.int32),
+            jax.ShapeDtypeStruct((P, N // TOPK_BLOCK), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        score_static.astype(jnp.float32),
+        task_req.astype(jnp.float32),
+        idle.astype(jnp.float32),
+        releasing.astype(jnp.float32),
+        rows.astype(jnp.int32)[:, None],
+        q2,
+        offs,
+    )
+    return skey, bval, bhash, bcol
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def masked_best_node(
     score: jnp.ndarray,       # [T, N] f32

@@ -223,6 +223,8 @@ def allocate_solve_fn(mesh: Mesh, config: AllocateConfig,
                 node_used=node2,
                 deserved=repl,
                 rounds_run=repl,
+                topk_exhausted=repl,
+                topk_reentries=repl,
             )
             fn = jax.jit(
                 partial(_solve, config=config),
@@ -246,6 +248,56 @@ def sharded_allocate_solve(
 
 def _solve(snap: DeviceSnapshot, config: AllocateConfig) -> AllocateResult:
     return allocate_solve(snap, config)
+
+
+def allocate_topk_solve_fn(mesh: Mesh, config: AllocateConfig,
+                           impl: Optional[str] = None):
+    """The memoized jitted COMPACTED allocate solve for (mesh, config,
+    impl) — config.topk > 0 selects the [P, K] candidate-table program
+    (ops.assignment.allocate_topk_solve).  The shard_map impl builds
+    per-shard candidate lists and merges them with one per-solve gather
+    (parallel/shard_solve.allocate_topk_shard_map — zero per-round
+    collectives); the pjit impl re-jits the single-device compacted body
+    with mesh shardings as the sharded bit-exactness oracle, mirroring the
+    full solve's impl split."""
+    from kube_batch_tpu.ops.assignment import allocate_topk_solve
+
+    impl = _impl(impl)
+    key = (mesh, config, "topk", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.allocate_topk_shard_map(mesh, config)
+        else:
+            in_shardings = snapshot_shardings(mesh)
+            node2 = NamedSharding(mesh, P(NODE_AXIS, None))
+            repl = NamedSharding(mesh, P())
+            out_shardings = AllocateResult(
+                assigned=repl, pipelined=repl, committed=repl,
+                node_idle=node2, node_releasing=node2, node_used=node2,
+                deserved=repl, rounds_run=repl,
+                topk_exhausted=repl, topk_reentries=repl,
+            )
+            fn = jax.jit(
+                partial(allocate_topk_solve.__wrapped__, config=config),
+                in_shardings=(in_shardings, repl),
+                out_shardings=out_shardings,
+            )
+        jitstats.register(f"sharded_allocate_topk_solve[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sharded_allocate_topk_solve(
+    snap: DeviceSnapshot, pend_rows, config: AllocateConfig, mesh: Mesh
+) -> AllocateResult:
+    """The compacted allocate solve jitted over the mesh (pending-row
+    bucket replicated, node columns sharded, ledgers back node-sharded)."""
+    fn = allocate_topk_solve_fn(mesh, config)
+    with mesh:
+        return fn(snap, pend_rows)
 
 
 def failure_histogram_fn(mesh: Mesh, impl: Optional[str] = None):
@@ -411,23 +463,39 @@ def dispatch_enqueue_gate(min_res, cand, idle0, quanta, n_nodes_padded: int):
 
 
 def collective_stats(mesh: Mesh, config: Optional[AllocateConfig] = None,
-                     snap=None) -> dict:
+                     snap=None, pend_bucket: Optional[int] = None) -> dict:
     """Traced collective inventory of the shard_map allocate solve on
     `mesh` — the per-round / per-solve cross-shard byte accounting
     (utils/jitstats.collective_inventory) of the program XLA actually
     compiles, at the abstract shapes of ``snap`` (defaults to the audit's
     small shapes).  The bench and the sim report this next to the measured
     round counts, so the O(tasks) comms claim is checked against the real
-    traced program, not asserted in a comment."""
+    traced program, not asserted in a comment.
+
+    With ``config.topk > 0`` and a ``pend_bucket`` size, the COMPACTED
+    program is traced instead — its contract is per_round_bytes == 0
+    (the candidate merge and the fallback's node-column gathers are all
+    per-solve), which the bench and tests assert from these numbers."""
+    import jax.numpy as jnp
+
     if snap is None:
         from kube_batch_tpu.analysis.jaxpr_audit import abstract_snapshot
 
         snap = abstract_snapshot()
-    fn = allocate_solve_fn(mesh, config or AllocateConfig(),
-                           impl="shard_map")
-    traced = fn.trace(snap)
+    config = config or AllocateConfig()
+    if config.topk and pend_bucket:
+        fn = allocate_topk_solve_fn(mesh, config, impl="shard_map")
+        traced = fn.trace(
+            snap, jax.ShapeDtypeStruct((pend_bucket,), jnp.int32)
+        )
+    else:
+        fn = allocate_solve_fn(mesh, config, impl="shard_map")
+        traced = fn.trace(snap)
     stats = jitstats.collective_inventory(traced.jaxpr)
     stats["mesh"] = {k: int(v) for k, v in dict(mesh.shape).items()}
     stats["task_bucket"] = int(snap.task_req.shape[0])
     stats["node_bucket"] = int(snap.node_idle.shape[0])
+    if config.topk and pend_bucket:
+        stats["topk"] = int(config.topk)
+        stats["pend_bucket"] = int(pend_bucket)
     return stats

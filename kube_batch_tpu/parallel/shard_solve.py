@@ -149,23 +149,49 @@ def _local_best(masked, tie_blk, n0):
 
 
 def _combine_best(lval, lkey, lidx, lextra=None):
-    """The cross-shard two-key argmax: three explicit O(T) collectives over
-    the node axis (pmax value, pmax key among value ties, pmin global index
-    among (value, key) ties) — equivalent to running jnp.argmax over the
-    concatenated node axis.  ``lextra`` optionally rides with the unique
-    winner via a one-hot psum (a fourth O(T) collective)."""
-    vmax = jax.lax.pmax(lval, NODE_AXIS)
-    eq = lval == vmax
-    kmax = jax.lax.pmax(
-        jnp.where(eq, lkey, jnp.asarray(-1, lkey.dtype)), NODE_AXIS
-    )
-    eqk = eq & (lkey == kmax)
-    imin = jax.lax.pmin(jnp.where(eqk, lidx, BIG), NODE_AXIS)
+    """The cross-shard two-key argmax as ONE stacked-payload collective.
+
+    The first cut ran four DEPENDENT O(T) reductions per round — pmax
+    value → pmax key among value ties → pmin global index among (value,
+    key) ties → one-hot psum of the winner's extra — four cross-host
+    latency hops on DCN.  Since the per-shard triple is tiny (3-4 i32
+    rows of T), a single ``all_gather`` of the stacked payload followed by
+    a replicated lexicographic reduce over the shard axis computes the
+    same winner with ONE collective: the f32 value rides as its
+    order-preserving i32 sort key (ops.assignment.f32_sort_key — integer
+    compare ≡ float compare), so max-by-(value, key, −index) over the
+    gathered [S, ·, T] block is exact.  Equivalent to jnp.argmax over the
+    concatenated node axis, bit-for-bit (the pjit oracle and the
+    equivalence tests hold it to that)."""
+    from kube_batch_tpu.ops.assignment import f32_sort_key
+
+    vkey = f32_sort_key(lval)
+    parts = [vkey, lkey, lidx]
+    if lextra is not None:
+        parts.append(lextra)
+    g = jax.lax.all_gather(
+        jnp.stack(parts, axis=0), NODE_AXIS, axis=0, tiled=False
+    )                                                  # [S, 3|4, T]
+    gv, gk, gi = g[:, 0], g[:, 1], g[:, 2]
+    vmax_k = jnp.max(gv, axis=0)
+    # the key map is a bijection, so the max key's preimage IS the max value
+    vmax = _inv_sort_key(vmax_k)
+    eq = gv == vmax_k
+    kmax = jnp.max(jnp.where(eq, gk, jnp.asarray(-1, gk.dtype)), axis=0)
+    eqk = eq & (gk == kmax)
+    imin = jnp.min(jnp.where(eqk, gi, BIG), axis=0)
     if lextra is None:
         return vmax, imin
-    mine = eqk & (lidx == imin)
-    extra = jax.lax.psum(jnp.where(mine, lextra, 0), NODE_AXIS)
+    win = eqk & (gi == imin)
+    shard = jnp.argmax(win, axis=0)[None]              # [1, T]
+    extra = jnp.take_along_axis(g[:, 3], shard, axis=0)[0]
     return vmax, imin, extra
+
+
+def _inv_sort_key(k):
+    """Inverse of ops.assignment.f32_sort_key (exact bijection)."""
+    b = jnp.where(k < 0, k ^ jnp.int32(0x7FFFFFFF), k)
+    return jax.lax.bitcast_convert_type(b, jnp.float32)
 
 
 # --------------------------------------------------------------------------
@@ -246,6 +272,131 @@ def _allocate_body(snap, *, config, node_shards, task_shards):
         node_releasing=sl(res.node_releasing),
         node_used=sl(res.node_used),
     )
+
+
+# --------------------------------------------------------------------------
+# compacted allocate (KB_TOPK) — zero per-round cross-shard collectives
+# --------------------------------------------------------------------------
+
+
+def _allocate_topk_body(snap, pend_rows, *, config, node_shards):
+    """The compacted sharded solve: each shard ranks its local [P, N_loc]
+    block into a [P, K] candidate list (exact lex order, global node
+    indices, offset tie hash), the lists merge via ONE per-solve
+    ``all_gather`` + replicated top-K merge, and the bidding rounds then
+    run fully replicated on the merged table + the gathered ledgers — ZERO
+    per-round cross-shard collectives (``collective_stats`` proves it from
+    the traced program).  The exhaustion re-entry computes the full-matrix
+    head over the bucket from per-solve-gathered node columns, so even the
+    rare fallback rounds stay collective-free."""
+    from kube_batch_tpu.ops import assignment as _asg
+
+    N_loc = snap.node_idle.shape[0]
+    N = N_loc * node_shards
+    T = snap.task_req.shape[0]
+    K = config.topk
+    n0 = jax.lax.axis_index(NODE_AXIS) * N_loc
+    quanta = snap.quanta
+    P_rows = pend_rows.shape[0]
+
+    # ---- local block build + single-gather merge ------------------------
+    view_l = _asg.pend_view(snap, pend_rows)
+    ki, ks, kh, n_feas_l, _ss, _tie = _asg.compact_candidates(
+        view_l, pend_rows, snap.node_idle, snap.node_releasing, quanta,
+        config, n0=n0,
+    )
+    payload = jnp.concatenate(
+        [ks, kh, ki, n_feas_l[:, None]], axis=1
+    )                                                  # [P, 3K+1] i32
+    g = jax.lax.all_gather(payload, NODE_AXIS, axis=0, tiled=False)
+    # shard-major concat: positions ascend with the global node index, so
+    # the merge's first-position tie rule keeps jnp.argmax semantics
+    skeys = jnp.transpose(g[:, :, 0:K], (1, 0, 2)).reshape(P_rows, -1)
+    hashes = jnp.transpose(g[:, :, K:2 * K], (1, 0, 2)).reshape(P_rows, -1)
+    idxs = jnp.transpose(g[:, :, 2 * K:3 * K], (1, 0, 2)).reshape(P_rows, -1)
+    n_feas = jnp.sum(g[:, :, 3 * K], axis=0)
+    mi, ms, mh = _asg.lex_topk(skeys, hashes, idxs, K, block=max(K, 8))
+    truncated = n_feas > K
+
+    # ---- per-solve gathers: ledgers + the fallback's node columns -------
+    idle0 = _gather_nodes(snap.node_idle, node_shards)
+    rel0 = _gather_nodes(snap.node_releasing, node_shards)
+    used0 = _gather_nodes(snap.node_used, node_shards)
+
+    def _gn(x):
+        return _gather_nodes(x, node_shards)
+
+    def _gn1(x):  # [K?, N_loc] sharded along axis 1
+        if node_shards == 1:
+            return x
+        return jax.lax.all_gather(x, NODE_AXIS, axis=1, tiled=True)
+
+    snap_repl = snap._replace(
+        node_idle=idle0, node_releasing=rel0, node_used=used0,
+        node_alloc=_gn(snap.node_alloc), node_valid=_gn(snap.node_valid),
+        node_sched=_gn(snap.node_sched),
+        node_label_bits=_gn(snap.node_label_bits),
+        node_taint_bits=_gn(snap.node_taint_bits),
+        task_aff_mask=_gn1(snap.task_aff_mask),
+        task_pref_node=_gn1(snap.task_pref_node),
+        task_pref_pod=_gn1(snap.task_pref_pod),
+    )
+    view_repl = _asg.pend_view(snap_repl, pend_rows)
+    safe_rows = jnp.maximum(pend_rows, 0)
+
+    def fallback(idle, releasing, pending_exh):
+        # traced inside the exhaustion cond — the [P, N] planes are only
+        # computed in rounds that actually re-enter the full-matrix head
+        static_ok = static_predicates(view_repl)
+        score = score_matrix(view_repl, config.weights)
+        ss = jnp.where(static_ok, score, NEG)
+        tie = _asg.tie_break_hash_rows(
+            safe_rows, jnp.arange(N, dtype=jnp.int32)
+        )
+        return _asg.make_bucket_fallback(view_repl, ss, tie, quanta)(
+            idle, releasing, pending_exh
+        )
+
+    head = _asg.make_compact_head(
+        mi, ms, mh, truncated, view_repl.task_req, quanta, N, fallback,
+    )
+    # rounds run replicated AND bucket-native: the rank/gate/conflict
+    # machinery shrinks from [T] to [P] exactly like the single-device
+    # compacted solve (scatter_bucket_result documents the exactness)
+    res = _asg.allocate_rounds(
+        view_repl, config, None, idle0, rel0, used0, compact_head=head
+    )
+    res = _asg.scatter_bucket_result(res, pend_rows, T)
+    sl = partial(jax.lax.dynamic_slice_in_dim, start_index=n0,
+                 slice_size=N_loc, axis=0)
+    return res._replace(
+        node_idle=sl(res.node_idle),
+        node_releasing=sl(res.node_releasing),
+        node_used=sl(res.node_used),
+    )
+
+
+def allocate_topk_shard_map(mesh, config):
+    """jitted shard_map compacted allocate solve for (mesh, config) — the
+    pending-row bucket rides replicated; node-axis inputs shard-local like
+    the full solve.  Task-axis (2-D) meshes are not compacted — the
+    dispatch routes them to the full path (their regime is the cold-start
+    HBM escape, where the whole task axis is pending anyway)."""
+    from kube_batch_tpu.ops.assignment import AllocateResult
+
+    task_shards, node_shards = _axis_sizes(mesh)
+    if task_shards != 1:
+        raise ValueError("KB_TOPK compaction requires a 1-D node mesh")
+    node2 = P(NODE_AXIS, None)
+    out_specs = AllocateResult(
+        assigned=P(), pipelined=P(), committed=P(),
+        node_idle=node2, node_releasing=node2, node_used=node2,
+        deserved=P(), rounds_run=P(),
+        topk_exhausted=P(), topk_reentries=P(),
+    )
+    body = partial(_allocate_topk_body, config=config,
+                   node_shards=node_shards)
+    return _shard_map(body, mesh, (_snapshot_specs(mesh), P()), out_specs)
 
 
 # --------------------------------------------------------------------------
@@ -394,6 +545,7 @@ def allocate_shard_map(mesh, config):
         assigned=P(), pipelined=P(), committed=P(),
         node_idle=node2, node_releasing=node2, node_used=node2,
         deserved=P(), rounds_run=P(),
+        topk_exhausted=P(), topk_reentries=P(),
     )
     body = partial(_allocate_body, config=config,
                    node_shards=node_shards, task_shards=task_shards)

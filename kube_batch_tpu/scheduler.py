@@ -18,9 +18,11 @@ triggering is event-driven: the cache's dirty-version advance wakes a
 condition variable, so an arrival burst schedules immediately instead of
 waiting out the reference's fixed 1 s tick, while an idle cluster ticks at
 the slow floor.  Knobs: ``KB_PIPELINE=0`` restores the serial
-wait.Until loop (the bit-exactness oracle), ``KB_PERIOD_MIN`` is the
-minimum spacing between cycle starts (rate floor for bursts),
-``KB_PERIOD_MAX`` the idle tick period (default: the schedule period)."""
+wait.Until loop (the bit-exactness oracle), ``KB_PERIOD_MIN`` pins the
+minimum spacing between cycle starts (rate floor for bursts; unset, the
+floor ADAPTS to an EWMA of the cycle's own measured cost — see
+:meth:`Scheduler._note_cycle_cost`), ``KB_PERIOD_MAX`` the idle tick
+period (default: the schedule period)."""
 
 from __future__ import annotations
 
@@ -151,14 +153,22 @@ class Scheduler:
         # the serial wait.Until loop as the bit-exactness oracle)
         self.pipelined = _env_flag("KB_PIPELINE", True)
         # cycle-start spacing: bursts coalesce to one cycle per min_period;
-        # an idle cluster ticks every max_period (default: today's period)
-        self.min_period = float(
-            os.environ.get("KB_PERIOD_MIN", "") or
-            min(0.05, schedule_period)
-        )
+        # an idle cluster ticks every max_period (default: today's period).
+        # The floor is ADAPTIVE by default: it tracks an EWMA of the
+        # cycle's own measured cost (_note_cycle_cost), so the coalescing
+        # window follows the solve instead of a static 50 ms — a 200 ms
+        # solve shouldn't be re-triggered every 50 ms, and a 10 ms cycle
+        # shouldn't wait out 50.  Setting KB_PERIOD_MIN pins the static
+        # value back (the escape hatch, like KB_PIPELINE=0).
+        raw_min = os.environ.get("KB_PERIOD_MIN", "")
+        self.min_period_pinned = bool(raw_min.strip())
+        self.min_period = float(raw_min or min(0.05, schedule_period))
         self.max_period = float(
             os.environ.get("KB_PERIOD_MAX", "") or schedule_period
         )
+        # EWMA of measured cycle cost (seconds) — the adaptive floor's p50
+        # estimator; None until the first pipelined cycle completes
+        self.cycle_cost_ewma: Optional[float] = None
         self.trigger = CycleTrigger(clock=self.clock)
         # the writeback stage: one worker, double-buffered — at most one
         # cycle's (status flush + binder drain) in flight while the next
@@ -324,6 +334,31 @@ class Scheduler:
                 logger.exception("writeback stage failed; statuses will "
                                  "re-derive next cycle")
 
+    # EWMA smoothing of the adaptive coalescing floor, and its clamps: the
+    # floor never drops below 5 ms (a degenerate idle cycle must not let a
+    # hot ingest stream busy-spin the loop) and never exceeds max_period
+    # (the idle tick must stay reachable)
+    EWMA_ALPHA = 0.2
+    MIN_PERIOD_FLOOR = 0.005
+
+    def _note_cycle_cost(self, elapsed: float) -> None:
+        """Feed one measured cycle cost (seconds, injected clock) into the
+        adaptive min-period: EWMA-smooth it and, unless KB_PERIOD_MIN
+        pinned a static floor, retarget the trigger's coalescing window to
+        the smoothed cost."""
+        if elapsed < 0:
+            return
+        prev = self.cycle_cost_ewma
+        self.cycle_cost_ewma = (
+            elapsed if prev is None
+            else self.EWMA_ALPHA * elapsed + (1.0 - self.EWMA_ALPHA) * prev
+        )
+        if not self.min_period_pinned:
+            self.min_period = min(
+                max(self.cycle_cost_ewma, self.MIN_PERIOD_FLOOR),
+                self.max_period,
+            )
+
     def drain_pipeline(self) -> None:
         """Join the in-flight writeback stage and apply any still-staged
         ingest — the deterministic post-cycle state the serial run_once
@@ -398,6 +433,10 @@ class Scheduler:
                 tick = self.clock.monotonic()
                 try:
                     self.run_once_pipelined()
+                    # successful cycles only: a fast-CRASHING cycle must
+                    # not drag the adaptive floor down and turn the loop
+                    # into a high-frequency crash retry
+                    self._note_cycle_cost(self.clock.monotonic() - tick)
                 except Exception:  # noqa: BLE001 — next cycle self-corrects
                     logger.exception("scheduling cycle failed")
                     self._recover_failed_cycle()

@@ -232,6 +232,11 @@ class SimRunner:
         self.bound_uids: set = set()
         self.duplicate_binds = 0
         self.failover_events: List[Dict] = []
+        # KB_TOPK candidate-compaction longitudinal counters
+        self.topk_cycles = 0
+        self.topk_exhausted = 0
+        self.topk_reentries = 0
+        self.topk_k = 0
 
     # ---- shared lookups --------------------------------------------------
     def job_of_pod(self, key: str) -> Optional[str]:
@@ -497,6 +502,17 @@ class SimRunner:
         else:
             self.scheduler.run_once()  # flushes async binds at its end
         self._drain_kubelet(now)
+        # candidate-compaction longitudinal counters (ISSUE 10): presets
+        # prove K is sized right when the exhaustion/full-head-re-entry
+        # totals stay near zero over the whole scenario
+        from kube_batch_tpu.framework.interface import get_action
+
+        topk = getattr(get_action("allocate"), "last_topk", None)
+        if topk is not None:
+            self.topk_cycles += 1
+            self.topk_exhausted += topk.get("exhausted", 0)
+            self.topk_reentries += topk.get("reentries", 0)
+            self.topk_k = topk.get("k", self.topk_k)
         pending, running = self._task_counts()
         shares = self._queue_shares()
         # surface the longitudinal fairness series live: the same
@@ -649,6 +665,15 @@ class SimRunner:
             "cycle_mode": "pipelined" if cfg.pipelined else "serial",
             "cycles_run": cycles_run,
             "resident_scatter": scatter,
+            # candidate-compaction longitudinal evidence: how many cycles
+            # ran compacted, and whether K was sized right (exhaustion /
+            # re-entry totals near zero over the whole scenario)
+            "topk": {
+                "compacted_cycles": self.topk_cycles,
+                "k": self.topk_k,
+                "exhausted_total": self.topk_exhausted,
+                "reentries_total": self.topk_reentries,
+            },
             **({"solve_collectives": solve_collectives}
                if solve_collectives is not None else {}),
             # fault-hardening evidence: bind integrity (no lost/duplicate

@@ -34,10 +34,13 @@ class TestRegistryClean:
     def test_registry_covers_the_hot_path(self):
         names = {e.name for e in REGISTRY}
         assert any("allocate_solve" in n for n in names)
+        assert any("allocate_topk_solve" in n for n in names)
         assert any("evict_solve" in n for n in names)
         assert any("resident" in n for n in names)
         assert any("pallas" in n for n in names)
+        assert any("masked_topk_blocks" in n for n in names)
         assert any("enqueue_gate" in n for n in names)
+        assert any("topk-inert" in n for n in names)
 
     def test_sharded_variants_traced_on_the_virtual_mesh(self):
         """The conftest's forced 8-device CPU mesh stands in for multi-chip
@@ -49,6 +52,7 @@ class TestRegistryClean:
         sharded = sharded_registry()
         names = {e.name for e in sharded}
         assert any("sharded_allocate_solve" in n for n in names)
+        assert any("sharded_allocate_topk_solve" in n for n in names)
         assert any("sharded_failure_histogram" in n for n in names)
         assert any("sharded_evict_solve" in n for n in names)
         assert any("scatter_sharded" in n for n in names)

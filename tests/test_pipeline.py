@@ -348,6 +348,85 @@ class TestCycleTrigger:
         assert reason == "ingest"
 
 
+class TestAdaptiveMinPeriod:
+    """KB_PERIOD_MIN unset → the trigger's coalescing floor tracks an EWMA
+    of the cycle's own measured cost (a 200 ms solve shouldn't re-trigger
+    every 50 ms; a 10 ms cycle shouldn't wait out 50); setting the env
+    pins the static floor back."""
+
+    def _sched(self, **env):
+        import os
+
+        saved = {k: os.environ.get(k) for k in ("KB_PERIOD_MIN",)}
+        os.environ.pop("KB_PERIOD_MIN", None)
+        os.environ.update(env)
+        try:
+            return Scheduler(_mk_cache(), conf=load_scheduler_conf(None),
+                             schedule_period=1.0)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def test_adapts_to_measured_cost(self):
+        sched = self._sched()
+        assert not sched.min_period_pinned
+        assert sched.min_period == pytest.approx(0.05)  # static default
+        sched._note_cycle_cost(0.2)
+        assert sched.cycle_cost_ewma == pytest.approx(0.2)
+        assert sched.min_period == pytest.approx(0.2)
+        # EWMA smoothing: a single fast outlier moves the floor by alpha
+        sched._note_cycle_cost(0.0)
+        expect = (1.0 - Scheduler.EWMA_ALPHA) * 0.2
+        assert sched.cycle_cost_ewma == pytest.approx(expect)
+        assert sched.min_period == pytest.approx(expect)
+
+    def test_floor_and_ceiling_clamps(self):
+        sched = self._sched()
+        # degenerate fast cycles clamp at the busy-spin floor, not zero
+        for _ in range(50):
+            sched._note_cycle_cost(0.0)
+        assert sched.min_period == pytest.approx(Scheduler.MIN_PERIOD_FLOOR)
+        # a pathological cycle cost clamps at max_period (idle tick stays
+        # reachable)
+        for _ in range(50):
+            sched._note_cycle_cost(100.0)
+        assert sched.min_period == pytest.approx(sched.max_period)
+        # negative (clock skew) samples are ignored
+        ewma = sched.cycle_cost_ewma
+        sched._note_cycle_cost(-1.0)
+        assert sched.cycle_cost_ewma == ewma
+
+    def test_env_pin_restores_static_floor(self):
+        sched = self._sched(KB_PERIOD_MIN="0.123")
+        assert sched.min_period_pinned
+        assert sched.min_period == pytest.approx(0.123)
+        sched._note_cycle_cost(0.5)
+        # the EWMA still tracks (observability), the floor does not move
+        assert sched.cycle_cost_ewma == pytest.approx(0.5)
+        assert sched.min_period == pytest.approx(0.123)
+
+    def test_pipelined_loop_feeds_the_ewma(self):
+        """The real loop wires measured cycle costs into the floor: after a
+        brief pipelined run of an idle cache, the EWMA is populated and the
+        unpinned floor has left the static 50 ms default (fast idle cycles
+        pull it down toward the busy-spin floor)."""
+        sched = self._sched()
+        sched.pipelined = True
+        sched.max_period = 0.01  # tick fast so several cycles run
+        t = threading.Thread(target=sched.run_forever, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.5)
+        finally:
+            sched.stop()
+            t.join(timeout=5.0)
+        assert sched.cycle_cost_ewma is not None
+        assert sched.min_period < 0.05
+
+
 class TestRunForeverPipelined:
     def test_burst_binds_and_shutdown_drains(self):
         """run_forever in pipelined mode: a pod staged mid-loop is bound

@@ -291,9 +291,11 @@ def test_collective_bytes_scale_with_tasks_not_nodes():
         "per-round collective bytes moved with the node count")
     assert tasks2["per_round_bytes"] == 2 * base["per_round_bytes"], (
         "per-round collective bytes are not linear in the task count")
-    # the inventory names the authored round collectives
+    # the four dependent reductions (2×pmax, pmin, psum) are fused into
+    # ONE stacked-payload all_gather per round — a single DCN latency hop
     round_ops = base["ops"]["per_round"]
-    assert set(round_ops) >= {"pmax", "pmin", "psum"}, round_ops
+    assert set(round_ops) == {"all_gather"}, round_ops
+    assert round_ops["all_gather"]["count"] == 1, round_ops
     # the one-per-solve node-ledger gather grows with N, and only it
     assert nodes2["per_solve_bytes"] > base["per_solve_bytes"]
 
