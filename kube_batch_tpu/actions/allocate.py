@@ -173,8 +173,9 @@ def session_allocate_config(ssn) -> AllocateConfig:
     )
 
 
-def dispatch_allocate_solve(snap, config, cols=None):
-    """Shard-or-local solve dispatch; returns (result, mode, topk_info).
+def dispatch_allocate_solve(snap, config, cols=None, guard=None):
+    """Shard-or-local solve dispatch; returns (result, mode, topk_info,
+    ginfo).
 
     With a ColumnStore, the ingest-static feature columns ride the
     device-resident cache (columns.resident_features) so per-cycle
@@ -183,44 +184,133 @@ def dispatch_allocate_solve(snap, config, cols=None):
 
     ``topk_info`` records the compaction decision ({"k", "bucket"} when
     the KB_TOPK compacted program ran, None otherwise) — the action folds
-    the solve's exhaustion counters into it for the bench/sim."""
+    the solve's exhaustion counters into it for the bench/sim.
+
+    ``guard`` (a :class:`kube_batch_tpu.guard.GuardPlane`) makes the
+    dispatch GUARDED: demoted fast paths fall back to their oracles
+    (KB_TOPK=0 / pjit / use_pallas off) and the sentinel-fused program
+    variants run, returning the invariant verdict + histogram in ``ginfo``
+    ("sentinel") alongside the engaged fast-path names ("engaged") and the
+    compaction plan ("pend_rows", for the diagnostics bundle).  The caller
+    MUST feed the verdict through ``guard.consume_verdict`` before acting
+    on the result (rule KBT013 enforces this at every dispatch site)."""
+    # kbt: allow[KBT013] the dispatch RETURNS the sentinel verdict to its
+    # caller — consume_verdict happens at the action's readback, the one
+    # place the verdict exists on host
     from kube_batch_tpu.parallel.mesh import (
         TASK_AXIS,
         default_mesh,
+        sentinel_sharded_allocate_solve,
+        sentinel_sharded_allocate_topk_solve,
         sharded_allocate_solve,
         sharded_allocate_topk_solve,
         should_shard,
     )
 
-    pend_rows, k = plan_topk_bucket(snap, cols, resolve_topk())
+    sentinel_on = guard is not None and guard.enabled
+    impl = None
+    if guard is not None and not guard.allow("shard_map"):
+        impl = "pjit"  # shard_map demoted → the pjit oracle
+    if guard is not None and not guard.allow("pallas") and config.use_pallas:
+        config = config._replace(use_pallas=False)
+    k = resolve_topk()
+    if guard is not None and not guard.allow("topk"):
+        k = 0  # compaction demoted → the full-matrix oracle
+    pend_rows, k = plan_topk_bucket(snap, cols, k)
+
+    def ginfo(engaged, sentinel, dev, cfg):
+        return {
+            "engaged": engaged, "sentinel": sentinel,
+            "pend_rows": pend_rows, "impl": impl,
+            # the exact (post-resident-swap) snapshot the solve consumed —
+            # what a trip's diagnostics bundle must capture
+            "dev": dev,
+            # the EFFECTIVE config the program ran with (demotions applied:
+            # use_pallas off, topk as dispatched) — a bundle must replay
+            # the condemned program, not the session's nominal one
+            "config": cfg,
+        }
+
     if should_shard(snap.node_alloc.shape[0]):
         mesh = default_mesh()
+        from kube_batch_tpu.parallel.mesh import _impl as resolve_impl
+
+        engaged = ["shard_map"] if resolve_impl(impl) == "shard_map" else []
+        if config.use_pallas:
+            engaged.append("pallas")
         # the compacted body requires a 1-D node mesh — the 2-D task-axis
         # grid is the cold-start HBM escape, where compaction can't apply
         if pend_rows is not None and dict(mesh.shape).get(TASK_AXIS, 1) == 1:
+            info = {"k": k, "bucket": int(pend_rows.shape[0])}
+            cfg = config._replace(topk=k)
+            dev = resident_snap(cols, snap, mesh)
+            if sentinel_on:
+                res, v, h, e = sentinel_sharded_allocate_topk_solve(
+                    dev, pend_rows, cfg, mesh, impl=impl
+                )
+                return (res, "sharded", info,
+                        ginfo(engaged + ["topk"], (v, h, e), dev, cfg))
             return (
-                sharded_allocate_topk_solve(
-                    resident_snap(cols, snap, mesh), pend_rows,
-                    config._replace(topk=k), mesh,
-                ),
-                "sharded",
-                {"k": k, "bucket": int(pend_rows.shape[0])},
+                sharded_allocate_topk_solve(dev, pend_rows, cfg, mesh,
+                                            impl=impl),
+                "sharded", info, ginfo(engaged + ["topk"], None, dev, cfg),
             )
+        dev = resident_snap(cols, snap, mesh)
+        if sentinel_on:
+            res, v, h, e = sentinel_sharded_allocate_solve(
+                dev, config, mesh, impl=impl
+            )
+            return (res, "sharded", None,
+                    ginfo(engaged, (v, h, e), dev, config))
         return (
-            sharded_allocate_solve(resident_snap(cols, snap, mesh), config, mesh),
-            "sharded",
-            None,
+            sharded_allocate_solve(dev, config, mesh, impl=impl),
+            "sharded", None, ginfo(engaged, None, dev, config),
         )
+    engaged = ["pallas"] if config.use_pallas else []
     if pend_rows is not None:
+        info = {"k": k, "bucket": int(pend_rows.shape[0])}
+        cfg = config._replace(topk=k)
+        dev = resident_snap(cols, snap)
+        if sentinel_on:
+            from kube_batch_tpu.ops.invariants import (
+                allocate_topk_sentinel_solve,
+            )
+
+            res, v, h, e = allocate_topk_sentinel_solve(dev, pend_rows, cfg)
+            return (res, "single", info,
+                    ginfo(engaged + ["topk"], (v, h, e), dev, cfg))
         return (
-            allocate_topk_solve(
-                resident_snap(cols, snap), pend_rows,
-                config._replace(topk=k),
-            ),
-            "single",
-            {"k": k, "bucket": int(pend_rows.shape[0])},
+            allocate_topk_solve(dev, pend_rows, cfg),
+            "single", info, ginfo(engaged + ["topk"], None, dev, cfg),
         )
-    return allocate_solve(resident_snap(cols, snap), config), "single", None
+    dev = resident_snap(cols, snap)
+    if sentinel_on:
+        from kube_batch_tpu.ops.invariants import allocate_sentinel_solve
+
+        res, v, h, e = allocate_sentinel_solve(dev, config)
+        return res, "single", None, ginfo(engaged, (v, h, e), dev, config)
+    return (allocate_solve(dev, config), "single", None,
+            ginfo(engaged, None, dev, config))
+
+
+def dispatch_allocate_oracle(snap, config, cols, mode):
+    """The shadow-oracle dispatch for an allocate-shaped audit: the same
+    snapshot through the all-oracle program (KB_TOPK=0, use_pallas off;
+    pjit impl when the committed solve ran sharded).  ``resident_snap`` is
+    memoized on the snap object, so this re-dispatch is device work only —
+    no re-upload."""
+    oracle_cfg = config._replace(topk=0, use_pallas=False)
+    if mode == "sharded":
+        from kube_batch_tpu.parallel.mesh import (
+            default_mesh,
+            sharded_allocate_solve,
+        )
+
+        mesh = default_mesh()
+        return sharded_allocate_solve(
+            resident_snap(cols, snap, mesh), oracle_cfg, mesh, impl="pjit"
+        )
+    return allocate_solve(resident_snap(cols, snap), oracle_cfg)
 
 
 def republish_query_lease(ssn, snap=None, meta=None, build=None) -> None:
@@ -330,17 +420,38 @@ class AllocateAction(Action):
         # multi-chip parts shard the node axis over the ICI mesh — the
         # production analog of the reference's always-on 16-worker fan-out
         # (scheduler_helper.go:34-64); single-chip or small-N stays local
-        result, self.last_solve_mode, topk_info = dispatch_allocate_solve(
-            snap, session_allocate_config(ssn), cols=cols
+        from kube_batch_tpu.guard import guard_of
+
+        gp = guard_of(ssn.cache)
+        config = session_allocate_config(ssn)
+        result, self.last_solve_mode, topk_info, ginfo = (
+            dispatch_allocate_solve(snap, config, cols=cols, guard=gp)
         )
+        # shadow-oracle audit (guard tier 2): every KB_AUDIT_EVERY-th
+        # dispatch re-runs the committed solve through its oracle path,
+        # DISPATCHED here so the oracle re-solve overlaps the readback +
+        # host replay (the fit-histogram idiom) and COMPARED after the
+        # replay — audit cycles pay device time, never critical-path time
+        audit_dev = None
+        if ginfo["engaged"] and gp.audit_due("allocate"):
+            audit_dev = dispatch_allocate_oracle(
+                snap, config, cols, self.last_solve_mode
+            )
         # the lease shares this dispatch's resident swap (memoized on the
         # same snap object), so publication is bookkeeping-only
         republish_query_lease(ssn, snap, meta)
+        sentinel = ginfo["sentinel"]
         # kbt: allow[KBT010] THE sanctioned choke point: one blocking
-        # transfer for everything the host replay reads
-        assigned, pipelined, rounds_run, topk_exh, topk_reent = jax.device_get(
+        # transfer for everything the host replay reads — the sentinel
+        # verdict + violation histogram ride it (the AllocateResult-
+        # counters idiom), so the guard adds zero extra transfers
+        (assigned, pipelined, rounds_run, topk_exh, topk_reent,
+         verdict, vhist, echeck) = jax.device_get(  # kbt: allow[KBT010] ^
             (result.assigned, result.pipelined, result.rounds_run,
-             result.topk_exhausted, result.topk_reentries)
+             result.topk_exhausted, result.topk_reentries,
+             sentinel[0] if sentinel is not None else np.int32(0),
+             sentinel[1] if sentinel is not None else None,
+             sentinel[2] if sentinel is not None else np.int32(0))
         )
         # convergence diagnostic (round-cap tuning); NOT in last_phase_ms —
         # that dict is ms-typed for the bench phases map
@@ -352,6 +463,20 @@ class AllocateAction(Action):
         self.last_topk = topk_info
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
+        if sentinel is not None and not self._consume_sentinel(
+            ssn, gp, snap, config, ginfo, int(verdict), vhist,
+            assigned, meta, int(echeck),
+        ):
+            # guard tier 1: the solve is CONDEMNED — fail closed.  Nothing
+            # below this line runs: no replay, no binds, no fit errors.
+            # The guard has already demoted the engaged fast paths, healed
+            # the resident cache, and dumped the diagnostics bundle.
+            self.last_phase_ms.update(
+                snapshot_build=(t1 - t0) * 1e3,
+                solve=(telemetry.perf_counter() - t1) * 1e3,
+                fit_errors=0.0, replay=0.0,
+            )
+            return
         t2 = telemetry.perf_counter()
         task_job = np.asarray(snap.task_job)[: meta.n_tasks]
         # fit errors only for tasks of jobs that are IN this session (the
@@ -424,6 +549,59 @@ class AllocateAction(Action):
             metrics.observe_task_latencies(
                 (t4 - t0) * 1e6 / self._n_applied, self._n_applied
             )
+        if audit_dev is not None:
+            self._compare_audit(
+                ssn, gp, snap, config, ginfo, audit_dev, assigned, pipelined,
+                meta,
+            )
+
+    # ------------------------------------------------------------------
+    # guard plane wiring (tiers 1 + 2)
+    # ------------------------------------------------------------------
+    def _consume_sentinel(self, ssn, gp, snap, config, ginfo, verdict, vhist,
+                 assigned, meta, echeck) -> bool:
+        """The SHARED assignment-shaped consumer (guard/plane: host
+        pending cross-check + checksum compare + histogram folding +
+        bundle + resident/lease heal) — one copy with backfill's
+        real-request pass."""
+        from kube_batch_tpu.guard import consume_assignment_sentinel
+
+        return consume_assignment_sentinel(
+            gp, "allocate", ssn, snap, meta, ginfo, verdict, vhist,
+            echeck, assigned, extra_report={"mode": self.last_solve_mode},
+        )
+
+    def _compare_audit(self, ssn, gp, snap, config, ginfo, audit_dev,
+                       assigned, pipelined, meta) -> None:
+        """Bit-compare the committed fast-path result against the shadow
+        oracle (read back AFTER the host replay — the oracle re-solve ran
+        overlapped with it)."""
+        from kube_batch_tpu.guard import make_heal, sentinel_bundle_thunk
+
+        # kbt: allow[KBT010] sanctioned post-replay audit readback: the
+        # oracle was dispatched before the replay precisely so this read
+        # overlaps host work instead of stalling the cycle
+        a_assigned, a_pipelined = jax.device_get(
+            (audit_dev.assigned, audit_dev.pipelined)
+        )
+        n = meta.n_tasks
+        mism = int(
+            np.sum(a_assigned[:n] != assigned)
+            + np.sum(a_pipelined[:n] != pipelined)
+        )
+        report = {
+            "audit_mismatches": mism, "engaged": ginfo["engaged"],
+            "mode": self.last_solve_mode,
+        }
+        gp.note_audit(
+            "allocate", ginfo["engaged"], mism == 0,
+            detail=f"fast-vs-oracle mismatch at {mism} task rows",
+            dump=sentinel_bundle_thunk(
+                gp, "allocate", ginfo["dev"], ginfo["config"],
+                report, pend_rows=ginfo.get("pend_rows"),
+            ),
+            heal=make_heal(ssn),
+        )
 
     # ------------------------------------------------------------------
     def _replay(self, ssn, snap, meta, assigned, pipelined, task_job) -> None:

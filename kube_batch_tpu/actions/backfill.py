@@ -126,8 +126,12 @@ class BackfillAction(Action):
         snap = snap._replace(
             job_schedulable=snap.job_schedulable & jnp.asarray(safe_np)
         )
-        result, _mode, _topk = dispatch_allocate_solve(
-            snap, session_allocate_config(ssn), cols=cols
+        from kube_batch_tpu.guard import guard_of
+
+        gp = guard_of(ssn.cache)
+        config = session_allocate_config(ssn)
+        result, _mode, _topk, ginfo = dispatch_allocate_solve(
+            snap, config, cols=cols, guard=gp
         )
         # this swap retired the what-if lease on donating backends — re-arm
         # it off the same (memoized) resident snapshot.  The gang-safe
@@ -138,10 +142,27 @@ class BackfillAction(Action):
         from kube_batch_tpu.actions.allocate import republish_query_lease
 
         republish_query_lease(ssn, snap, meta)
-        # kbt: allow[KBT010] the backfill pass's one sanctioned readback
-        assigned, pipelined = jax.device_get((result.assigned, result.pipelined))
+        sentinel = ginfo["sentinel"]
+        # kbt: allow[KBT010] the backfill pass's one sanctioned readback —
+        # the guard sentinel's verdict + histogram ride it
+        assigned, pipelined, verdict, vhist, echeck = jax.device_get(
+            (result.assigned, result.pipelined,
+             sentinel[0] if sentinel is not None else np.int32(0),
+             sentinel[1] if sentinel is not None else None,
+             sentinel[2] if sentinel is not None else np.int32(0))
+        )
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
+        if sentinel is not None:
+            from kube_batch_tpu.guard import consume_assignment_sentinel
+
+            if not consume_assignment_sentinel(
+                gp, "backfill", ssn, snap, meta, ginfo,
+                int(verdict), vhist, int(echeck), assigned,
+            ):
+                # condemned solve → fail closed: strand the capacity for
+                # this cycle rather than bind from an unlawful result
+                return
         if not (assigned >= 0).any():
             return
         n = int((assigned >= 0).sum())

@@ -224,14 +224,57 @@ class EnqueueAction(Action):
         minr[:k] = cols.j_minres[ordered]
         candv = np.zeros(capJ, bool)
         candv[:k] = enq_ok[order]
-        admitted_dev = dispatch_enqueue_gate(
-            minr, candv,
-            idle.vec.astype(np.float32), spec.quanta.astype(np.float32),
-            n_nodes_padded=cols.nodes.cap,
+        from kube_batch_tpu.guard import guard_of
+        from kube_batch_tpu.parallel.mesh import (
+            shard_map_enabled,
+            should_shard,
         )
-        # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback: the
-        # admitted-rows mask the promotions below consume
-        admitted = np.asarray(jax.device_get(admitted_dev))[:k]
+
+        gp = guard_of(ssn.cache)
+        idle_v = idle.vec.astype(np.float32)
+        quanta_v = spec.quanta.astype(np.float32)
+        use_mesh = should_shard(cols.nodes.cap) and shard_map_enabled()
+        if gp.enabled and not use_mesh:
+            # the FUSED gate sentinel (ops/invariants): admitted ⊆
+            # candidates + the all-finite budget sweep run in the same
+            # compiled program as the admission scan, verdict riding the
+            # one readback — the single-device twin of the solve sentinels
+            from kube_batch_tpu.ops.invariants import (
+                enqueue_gate_sentinel_solve,
+            )
+
+            admitted_dev, v_dev, _hist = enqueue_gate_sentinel_solve(
+                minr, candv, idle_v, quanta_v
+            )
+            # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback:
+            # the admitted-rows mask + the fused sentinel verdict
+            admitted, verdict = jax.device_get((admitted_dev, v_dev))
+            admitted = np.asarray(admitted)[:k]
+            bad = int(verdict)
+        else:
+            admitted_dev = dispatch_enqueue_gate(
+                minr, candv, idle_v, quanta_v,
+                n_nodes_padded=cols.nodes.cap,
+            )
+            # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback:
+            # the admitted-rows mask the promotions below consume
+            admitted = np.asarray(jax.device_get(admitted_dev))[:k]
+            bad = 0
+            if gp.enabled:
+                # mesh path (the replicated shard_map gate has no fused
+                # variant): the invariant is host-checkable from the
+                # dispatch's own host-built inputs
+                bad = int(np.sum(admitted & ~candv[:k]))
+                if (not np.isfinite(minr).all()
+                        or not np.isfinite(idle_v).all()
+                        or not np.isfinite(quanta_v).all()):
+                    bad += 1
+        # a violation fails CLOSED: no promotions from a condemned verdict
+        # (the Pending walk re-decides next cycle)
+        if gp.enabled and not gp.consume_verdict(
+            "enqueue", [], bad, detail=f"enqueue gate verdict={bad}",
+        ):
+            return True
         for r in ordered[admitted].tolist():
             self._promote(cols, job_by_row[r])
         return True

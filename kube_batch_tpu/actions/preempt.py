@@ -7,6 +7,12 @@ gang slack, and DRF share dominance; the host replays each preemptor job
 through a Statement — evictions + pipelines commit only when the job reaches
 Pipelined, mirroring the reference's commit gate.
 
+The solve dispatch is GUARDED (kube_batch_tpu/guard): ``solve_claims``
+(shared with reclaim) runs the sentinel-fused eviction program, consumes
+its invariant verdict + host eligibility cross-checks, and FAILS CLOSED —
+returning zero claims — when the solve is condemned, so no preemption can
+ever be replayed from a corrupted or divergent result.
+
 Phase 2 (intra-job task-priority rebalancing, preempt.go:145-174) stays a
 host loop but only runs for jobs where a pending task outranks a running one
 — the common all-equal-priority case short-circuits to nothing."""

@@ -115,19 +115,51 @@ def solve_claims(ssn, mode: str):
         weights=ssn.score_weights,
     )
     from kube_batch_tpu.api.columns import resident_snap
+    from kube_batch_tpu.guard import guard_of
     from kube_batch_tpu.parallel.mesh import (
         default_mesh,
+        sentinel_sharded_evict_solve,
         sharded_evict_solve,
         should_shard,
     )
 
+    gp = guard_of(ssn.cache)
+    sentinel = None
+    audit_dev = None
+    engaged: List[str] = []
+    mesh = None
     # device-resident feature cache (see allocate's dispatch): the decode
     # below keeps reading the ORIGINAL host-backed snap
     if should_shard(snap.node_alloc.shape[0]):
         mesh = default_mesh()
-        result = sharded_evict_solve(resident_snap(cols, snap, mesh), config, mesh)
+        from kube_batch_tpu.parallel.mesh import _impl as _resolve_impl
+
+        # demotion-aware path selection: a tripped shard_map path runs the
+        # pjit oracle until its half-open probe re-promotes it
+        impl = None if gp.allow("shard_map") else "pjit"
+        if _resolve_impl(impl) == "shard_map":
+            engaged = ["shard_map"]
+        dev = resident_snap(cols, snap, mesh)
+        if gp.enabled:
+            result, v_dev, h_dev, e_dev = sentinel_sharded_evict_solve(
+                dev, config, mesh, impl=impl
+            )
+            sentinel = (v_dev, h_dev, e_dev)
+        else:
+            result = sharded_evict_solve(dev, config, mesh, impl=impl)
+        if engaged and gp.audit_due(mode):
+            # shadow oracle (tier 2): the pjit program on the same
+            # snapshot, read back only after the host decode below
+            audit_dev = sharded_evict_solve(dev, config, mesh, impl="pjit")
     else:
-        result = evict_solve(resident_snap(cols, snap), config)
+        dev = resident_snap(cols, snap)
+        if gp.enabled:
+            from kube_batch_tpu.ops.invariants import evict_sentinel_solve
+
+            result, v_dev, h_dev, e_dev = evict_sentinel_solve(dev, config)
+            sentinel = (v_dev, h_dev, e_dev)
+        else:
+            result = evict_solve(dev, config)
     # this swap retired the what-if lease on donating backends — re-arm it
     # off the same (memoized) resident snapshot so serving doesn't stay
     # dark until the next cycle's allocate
@@ -136,13 +168,41 @@ def solve_claims(ssn, mode: str):
     republish_query_lease(ssn, snap, meta)
     # kbt: allow[KBT010] the evict pass's ONE sanctioned readback — batched
     # (three per-field np.asarray reads were three blocking transfers;
-    # flagged by KBT010's first dogfood run)
-    claim_node, evicted, victim_claimant = jax.device_get(
-        (result.claim_node, result.evicted, result.victim_claimant)
+    # flagged by KBT010's first dogfood run); the guard sentinel's verdict
+    # + histogram ride it
+    claim_node, evicted, victim_claimant, verdict, vhist, echeck = (
+        jax.device_get(  # kbt: allow[KBT010] the annotated choke point above
+            (result.claim_node, result.evicted, result.victim_claimant,
+             sentinel[0] if sentinel is not None else np.int32(0),
+             sentinel[1] if sentinel is not None else None,
+             sentinel[2] if sentinel is not None else np.int32(0))
+        )
     )
     claim_node = claim_node[: meta.n_tasks]
     evicted = evicted[: meta.n_tasks]
     victim_claimant = victim_claimant[: meta.n_tasks]
+
+    if sentinel is not None:
+        from kube_batch_tpu.api.types import TaskStatus as _TS
+        from kube_batch_tpu.guard import consume_sentinel
+
+        # host cross-checks: a claim must target a row the HOST believes
+        # pending, a victim one the HOST believes RUNNING — the device
+        # copies of those columns are exactly what a corruption flips; the
+        # eligibility-checksum compare, histogram folding, bundle dump,
+        # and resident+lease heal live in the SHARED consumer
+        host_pending = np.asarray(snap.task_pending)[: meta.n_tasks]
+        host_status = np.asarray(snap.task_status)[: meta.n_tasks]
+        host_bad = int(
+            np.sum((claim_node >= 0) & ~host_pending)
+            + np.sum(evicted & (host_status != int(_TS.RUNNING)))
+        )
+        if not consume_sentinel(
+            gp, mode, ssn, snap, dev, config, int(verdict), vhist,
+            int(echeck), engaged, host_bad=host_bad,
+        ):
+            # condemned solve → fail closed: NO evictions from it
+            return [], None
 
     task_job = np.asarray(snap.task_job)[: meta.n_tasks]
 
@@ -160,6 +220,34 @@ def solve_claims(ssn, mode: str):
             (ref(ti), meta.node_names[int(claim_node[ti])],
              victims_by_claim.get(int(ti), []))
         )
+    if audit_dev is not None:
+        # kbt: allow[KBT010] post-decode audit readback — the oracle solve
+        # ran overlapped with the host decode above
+        a_claim, a_evicted, a_vc = jax.device_get(
+            (audit_dev.claim_node, audit_dev.evicted,
+             audit_dev.victim_claimant)
+        )
+        n = meta.n_tasks
+        mism = int(
+            np.sum(a_claim[:n] != claim_node)
+            + np.sum(a_evicted[:n] != evicted)
+            + np.sum(a_vc[:n] != victim_claimant)
+        )
+        from kube_batch_tpu.guard import make_heal, sentinel_bundle_thunk
+
+        gp.note_audit(
+            mode, engaged, mism == 0,
+            detail=f"{mode} shard_map-vs-pjit mismatch at {mism} rows",
+            dump=sentinel_bundle_thunk(
+                gp, mode, dev, config,
+                {"audit_mismatches": mism, "engaged": engaged},
+            ),
+            heal=make_heal(ssn),
+        )
+        if mism:
+            # the fast path is already demoted; the claims decoded above
+            # came from the MISMATCHED program — fail closed for this cycle
+            return [], meta
     return claims, meta
 
 

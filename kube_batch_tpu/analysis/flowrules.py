@@ -77,11 +77,24 @@ def _donate_positions(jit_call: ast.Call, ctx: ModuleContext,
 
 class _DonationTable:
     """Module symbol table slice for KBT006: which local names are donating
-    jitted callables, and which zero-arg functions return one."""
+    jitted callables, which zero-arg functions return one, and — ONE call
+    level deep through the module's symbol table — which same-module
+    helpers donate their own parameters.
+
+    The interprocedural level closes the ROADMAP-standing escape: a helper
+    like ``def refresh(dev): return _scatter_fn()(dev, rows, vals)``
+    donates its caller's buffer, but only the helper's body carries the
+    donating call — a caller reading ``dev`` after ``refresh(dev)`` walked
+    clean.  The ``param_donors`` scan marks such helpers so their call
+    sites taint arguments exactly like a direct donating call.  One level
+    only (a helper calling a helper is out of scope), matching the
+    deliberately-bounded depth of the rest of the flow engine."""
 
     def __init__(self, ctx: ModuleContext):
         self.by_name: Dict[str, Tuple[int, ...]] = {}
         self.factories: Dict[str, Tuple[int, ...]] = {}
+        #: helper function name → parameter positions it donates
+        self.param_donors: Dict[str, Tuple[int, ...]] = {}
         tree = ctx.tree
         for node in ast.walk(tree):
             if isinstance(node, ast.Assign):
@@ -110,16 +123,48 @@ class _DonationTable:
                         and isinstance(sub.value, ast.Name)
                         and sub.value.id in self.by_name):
                     self.factories[node.name] = self.by_name[sub.value.id]
+        # one-level interprocedural: a function passing its OWN parameter
+        # into a donating call at a donated position donates that
+        # parameter — including through the factory ``_scatter_fn()(...)``
+        # form, which _direct_positions already resolves
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.args]
+            donated: set = set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                for p in self._direct_positions(sub):
+                    if (p < len(sub.args)
+                            and isinstance(sub.args[p], ast.Name)
+                            and sub.args[p].id in params):
+                        donated.add(params.index(sub.args[p].id))
+            if donated:
+                self.param_donors[node.name] = tuple(sorted(donated))
 
-    def call_positions(self, call: ast.Call) -> Tuple[int, ...]:
-        """Donated positions of this call site, or () — handles the direct
-        ``scatter(...)`` form and the factory ``_scatter_fn()(...)`` form."""
+    def _direct_positions(self, call: ast.Call) -> Tuple[int, ...]:
+        """Donated positions from the module-level table only (no
+        interprocedural step — this is what the one-level scan itself
+        consumes, keeping the closure bounded)."""
         f = call.func
         if isinstance(f, ast.Name):
             return self.by_name.get(f.id, ())
         if (isinstance(f, ast.Call) and isinstance(f.func, ast.Name)
                 and not f.args):
             return self.factories.get(f.func.id, ())
+        return ()
+
+    def call_positions(self, call: ast.Call) -> Tuple[int, ...]:
+        """Donated positions of this call site, or () — the direct
+        ``scatter(...)`` form, the factory ``_scatter_fn()(...)`` form,
+        and same-module helpers that donate their parameters."""
+        direct = self._direct_positions(call)
+        if direct:
+            return direct
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.param_donors.get(f.id, ())
         return ()
 
 

@@ -276,6 +276,50 @@ def _scatter_donation() -> Dict[str, Tuple[int, ...]]:
     return {"cpu": (), "*": (0,)}
 
 
+# ---- sentinel-fused solve variants (guard plane tier 1): the dispatch-
+# facing programs are solve body + ops/invariants tail in ONE jaxpr — they
+# must pass KBT101-104 like the bare solves (a sentinel that smuggled an
+# f64 upcast or a host callback into every production dispatch would tax
+# exactly the path it guards)
+
+
+def _build_sentinel_allocate():
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.invariants import allocate_sentinel_solve
+
+    return allocate_sentinel_solve, (abstract_snapshot(), AllocateConfig())
+
+
+def _build_sentinel_topk():
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.invariants import allocate_topk_sentinel_solve
+
+    return allocate_topk_sentinel_solve, (
+        abstract_snapshot(), _abstract_pend_rows(),
+        AllocateConfig(topk=_TOPK),
+    )
+
+
+def _build_sentinel_evict(mode):
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.ops.invariants import evict_sentinel_solve
+
+    return evict_sentinel_solve, (
+        abstract_snapshot(), EvictConfig(mode=mode))
+
+
+def _build_sentinel_gate():
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    from kube_batch_tpu.ops.invariants import enqueue_gate_sentinel_fn
+
+    return enqueue_gate_sentinel_fn(), (
+        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
+        S((_R,), jnp.float32), S((_R,), jnp.float32),
+    )
+
+
 REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.assignment.allocate_solve", _build_allocate),
     EntryPoint("ops.assignment.allocate_topk_solve", _build_topk_allocate),
@@ -292,6 +336,15 @@ REGISTRY: Tuple[EntryPoint, ...] = (
                _build_pallas_topk_blocks),
     EntryPoint("ops.probe.probe_solve", _build_probe),
     EntryPoint("ops.probe.probe_solve[topk-inert]", _build_topk_probe),
+    EntryPoint("ops.invariants.allocate_sentinel_solve",
+               _build_sentinel_allocate),
+    EntryPoint("ops.invariants.allocate_topk_sentinel_solve",
+               _build_sentinel_topk),
+    EntryPoint("ops.invariants.evict_sentinel_solve[reclaim]",
+               lambda: _build_sentinel_evict("reclaim")),
+    EntryPoint("ops.invariants.evict_sentinel_solve[preempt]",
+               lambda: _build_sentinel_evict("preempt")),
+    EntryPoint("ops.invariants.enqueue_gate_sentinel", _build_sentinel_gate),
 )
 
 
@@ -334,6 +387,31 @@ def _build_sharded_evict(mesh, mode, impl):
 
     return evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl), (
         abstract_snapshot(),)
+
+
+def _build_sharded_sentinel_allocate(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import sentinel_allocate_solve_fn
+
+    fn = sentinel_allocate_solve_fn(mesh, AllocateConfig(), impl=impl)
+    return fn, (abstract_snapshot(),)
+
+
+def _build_sharded_sentinel_topk(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import sentinel_allocate_topk_solve_fn
+
+    fn = sentinel_allocate_topk_solve_fn(
+        mesh, AllocateConfig(topk=_TOPK), impl=impl)
+    return fn, (abstract_snapshot(), _abstract_pend_rows())
+
+
+def _build_sharded_sentinel_evict(mesh, mode, impl):
+    from kube_batch_tpu.ops.eviction import EvictConfig
+    from kube_batch_tpu.parallel.mesh import sentinel_evict_solve_fn
+
+    fn = sentinel_evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl)
+    return fn, (abstract_snapshot(),)
 
 
 def _build_sharded_probe(mesh, impl):
@@ -430,6 +508,17 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
                        p(_build_sharded_evict, mesh, "preempt", impl)),
             EntryPoint(f"parallel.mesh.sharded_probe_solve{tag}",
                        p(_build_sharded_probe, mesh, impl)),
+            EntryPoint(f"parallel.mesh.sentinel_sharded_allocate_solve{tag}",
+                       p(_build_sharded_sentinel_allocate, mesh, impl)),
+            EntryPoint(
+                f"parallel.mesh.sentinel_sharded_allocate_topk_solve{tag}",
+                p(_build_sharded_sentinel_topk, mesh, impl)),
+            EntryPoint(
+                f"parallel.mesh.sentinel_sharded_evict_solve[reclaim]{tag}",
+                p(_build_sharded_sentinel_evict, mesh, "reclaim", impl)),
+            EntryPoint(
+                f"parallel.mesh.sentinel_sharded_evict_solve[preempt]{tag}",
+                p(_build_sharded_sentinel_evict, mesh, "preempt", impl)),
         ]
     entries += [
         EntryPoint("parallel.mesh.sharded_enqueue_gate",

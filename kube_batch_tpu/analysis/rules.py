@@ -549,6 +549,82 @@ class PipelineStageRule(Rule):
                        "(stage the read in stage_status_flush instead)")
 
 
+# --------------------------------------------------------------------------
+# KBT013 — bind/evict dispatch site without a sentinel-verdict consumer
+# --------------------------------------------------------------------------
+
+
+class SentinelConsumeRule(Rule):
+    """Guard for the result-integrity plane (kube_batch_tpu/guard): every
+    action-layer function that dispatches a committed solve — the programs
+    whose results become real binds and evictions — must consume the fused
+    sentinel's verdict through ``GuardPlane.consume_verdict`` before acting
+    on the result.  A dispatch site added without the consumer silently
+    re-opens the exact hole the guard plane closed: a condemned solve's
+    placements would flow to the binder with zero detection.  The bug
+    class is structural (a future action or refactor forgetting the
+    verdict), so the rule is structural too: a function in actions/ that
+    calls a solve dispatch and never calls a verdict consumer reports.
+    ``dispatch_*``-named helpers are the sanctioned SEAM layer: they
+    return the un-consumed sentinel to their caller and are skipped here —
+    but their names sit in DISPATCH_FNS, so every CALL SITE of the seam is
+    still held to the consumer requirement."""
+
+    id = "KBT013"
+    title = "solve dispatch without a sentinel-verdict consumer"
+    scope = ("actions/",)
+
+    #: callables whose results become binds/evictions — the committed
+    #: solve dispatch surface (single-device, sharded, and the actions'
+    #: own dispatch helpers)
+    DISPATCH_FNS = {
+        "dispatch_allocate_solve", "allocate_solve", "allocate_topk_solve",
+        "allocate_sentinel_solve", "allocate_topk_sentinel_solve",
+        "evict_solve", "evict_sentinel_solve",
+        "sharded_allocate_solve", "sharded_allocate_topk_solve",
+        "sharded_evict_solve", "sentinel_sharded_allocate_solve",
+        "sentinel_sharded_allocate_topk_solve",
+        "sentinel_sharded_evict_solve",
+        "dispatch_enqueue_gate",
+    }
+    #: verdict consumers: the GuardPlane choke point and the shared
+    #: readback-side consumers (guard/plane.consume_sentinel /
+    #: consume_assignment_sentinel) — matched by SUBSTRING so an action's
+    #: thin wrapper (`_consume_sentinel`) and shaped variants count
+    #: without baking private names into the rule
+    CONSUME_FNS = {"consume_verdict"}
+    CONSUME_SUBSTR = "consume_"
+
+    def check(self, tree: ast.Module, relpath: str):
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("dispatch_"):
+                continue  # the seam layer (docstring) — call sites checked
+            dispatches: List[ast.Call] = []
+            consumes = False
+            for sub in _walk_skipping_defs(node.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _terminal_name(sub.func)
+                if name in self.DISPATCH_FNS:
+                    dispatches.append(sub)
+                elif (name in self.CONSUME_FNS
+                        or (self.CONSUME_SUBSTR in name
+                            and "sentinel" in name)):
+                    consumes = True
+            if consumes:
+                continue
+            for call in dispatches:
+                yield (call.lineno, call.col_offset,
+                       f"`{_terminal_name(call.func)}(...)` dispatches a "
+                       "committed solve but this function never consumes a "
+                       "sentinel verdict (GuardPlane.consume_verdict) — a "
+                       "condemned result could reach the binder; consume "
+                       "the verdict, or annotate a dispatch seam that "
+                       "returns the un-consumed sentinel to its caller")
+
+
 from kube_batch_tpu.analysis.flowrules import FLOW_RULES  # noqa: E402
 
 ALL_RULES = (
@@ -559,6 +635,7 @@ ALL_RULES = (
     HostSyncRule(),
     RawTransportRule(),
     PipelineStageRule(),
+    SentinelConsumeRule(),
 ) + FLOW_RULES
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

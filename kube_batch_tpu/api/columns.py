@@ -999,10 +999,15 @@ class ColumnStore:
         return out
 
     def drop_resident(self) -> None:
-        """Cold-start the per-cycle device residency: the next solve
-        dispatch pays a full upload + prewarm. The warm-standby path calls
-        this only when revalidation FAILS."""
+        """Cold-start the device residency — the per-cycle scatter caches
+        AND the version-keyed static feature cache: the next solve dispatch
+        pays a full upload + prewarm.  The warm-standby path calls this
+        only when revalidation FAILS; the guard plane calls it on every
+        integrity trip (the self-heal for a corrupted resident buffer —
+        a static feature column is as corruptible as a per-cycle one, so
+        both caches go)."""
         self._per_cycle_dev.clear()
+        self._dev_cache.clear()
 
     def revalidate_resident(self, cache) -> Dict:
         """Warm-standby revalidation (leader failover): decide whether the

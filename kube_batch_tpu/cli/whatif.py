@@ -68,7 +68,13 @@ def _render(resp: dict) -> str:
             f"evict claim={ev['claim_nodes']} victims={len(ev['victims'])} "
             f"covered={ev['covered']}"
         )
-    return "  ".join(parts)
+    out = "  ".join(parts)
+    # verdict honesty: model gaps the server declares for THIS request
+    # (unmodeled victim gates, backfill-only BestEffort gangs) print on
+    # their own marked lines so scripts and humans can't miss them
+    for gap in resp.get("unmodeled") or []:
+        out += f"\n  ! unmodeled: {gap}"
+    return out
 
 
 def main(argv=None) -> int:

@@ -18,6 +18,8 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
                                  Queue CRD status the CLI renders, list.go:51)
 - GET  /v1/jobs                — podgroup phases/conditions
 - GET  /v1/bindings            — pod→node decisions made so far
+- GET  /v1/guard               — result-integrity guard plane state (per-
+                                 fast-path breaker, trips, audits, bundles)
 - POST /v1/whatif              — batched what-if / admission probe against
                                  the resident snapshot (serve/; README
                                  "Query plane" for the schema)
@@ -222,6 +224,13 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 self._send(200, json.dumps(_job_status(cache)))
             elif self.path == "/v1/bindings":
                 self._send(200, json.dumps(_bindings(cache)))
+            elif self.path == "/v1/guard":
+                # result-integrity guard plane state: per-fast-path breaker
+                # (healthy|demoted|probing), trips, audits, bundle paths —
+                # the operator's first stop when a trip alert fires
+                from kube_batch_tpu.guard import guard_of
+
+                self._send(200, json.dumps(guard_of(cache).state()))
             else:
                 self._send(404, json.dumps({"error": "not found"}))
 

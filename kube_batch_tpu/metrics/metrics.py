@@ -275,6 +275,25 @@ QUEUE_ENTITLEMENT = Gauge(
     "Per-queue weight entitlement (weight / Σ weights)",
     ("queue",),
 )
+# result-integrity guard plane (kube_batch_tpu/guard): sentinel trips /
+# fail-closed solves, shadow-oracle audit outcomes, and per-fast-path
+# demotion state — the runtime twin of the KB_* oracle knobs
+GUARD_TRIPS = Counter(
+    f"{_SUBSYSTEM}_guard_trips_total",
+    "Result-integrity trips (condemned solves), by action and reason "
+    "(invariant|audit)",
+    ("action", "reason"),
+)
+GUARD_AUDITS = Counter(
+    f"{_SUBSYSTEM}_guard_audits_total",
+    "Shadow-oracle audit comparisons, by result (match|mismatch)",
+    ("result",),
+)
+GUARD_PATH_DEMOTED = Gauge(
+    f"{_SUBSYSTEM}_guard_path_demoted",
+    "1 while a fast path is demoted to its oracle (topk|shard_map|pallas)",
+    ("path",),
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -310,6 +329,9 @@ METRICS = [
     STAGED_INGEST,
     QUEUE_SHARE,
     QUEUE_ENTITLEMENT,
+    GUARD_TRIPS,
+    GUARD_AUDITS,
+    GUARD_PATH_DEMOTED,
 ]
 
 
@@ -409,6 +431,18 @@ def register_cycle_budget_exceeded() -> None:
 
 def register_leader_failover(mode: str) -> None:
     LEADER_FAILOVER.inc(mode)
+
+
+def register_guard_trip(action: str, reason: str) -> None:
+    GUARD_TRIPS.inc(action, reason)
+
+
+def register_guard_audit(result: str) -> None:
+    GUARD_AUDITS.inc(result)
+
+
+def set_guard_path_demoted(path: str, demoted: int) -> None:
+    GUARD_PATH_DEMOTED.set(demoted, path)
 
 
 def register_whatif_request(verdict: str) -> None:

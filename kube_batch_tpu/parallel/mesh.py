@@ -237,11 +237,14 @@ def allocate_solve_fn(mesh: Mesh, config: AllocateConfig,
 
 
 def sharded_allocate_solve(
-    snap: DeviceSnapshot, config: AllocateConfig, mesh: Mesh
+    snap: DeviceSnapshot, config: AllocateConfig, mesh: Mesh,
+    impl: Optional[str] = None,
 ) -> AllocateResult:
     """The allocate solve jitted over the mesh. Node-axis inputs/outputs are
-    sharded; the assignment vector comes back replicated."""
-    fn = allocate_solve_fn(mesh, config)
+    sharded; the assignment vector comes back replicated.  ``impl``
+    overrides the KB_SHARD_MAP selection — the guard plane's demotion
+    passes ``"pjit"`` here to pin a tripped shard_map path to its oracle."""
+    fn = allocate_solve_fn(mesh, config, impl=impl)
     with mesh:
         return fn(snap)
 
@@ -291,11 +294,12 @@ def allocate_topk_solve_fn(mesh: Mesh, config: AllocateConfig,
 
 
 def sharded_allocate_topk_solve(
-    snap: DeviceSnapshot, pend_rows, config: AllocateConfig, mesh: Mesh
+    snap: DeviceSnapshot, pend_rows, config: AllocateConfig, mesh: Mesh,
+    impl: Optional[str] = None,
 ) -> AllocateResult:
     """The compacted allocate solve jitted over the mesh (pending-row
     bucket replicated, node columns sharded, ledgers back node-sharded)."""
-    fn = allocate_topk_solve_fn(mesh, config)
+    fn = allocate_topk_solve_fn(mesh, config, impl=impl)
     with mesh:
         return fn(snap, pend_rows)
 
@@ -363,18 +367,119 @@ def evict_solve_fn(mesh: Mesh, config: EvictConfig,
 
 
 def sharded_evict_solve(
-    snap: DeviceSnapshot, config: EvictConfig, mesh: Mesh
+    snap: DeviceSnapshot, config: EvictConfig, mesh: Mesh,
+    impl: Optional[str] = None,
 ) -> EvictResult:
     """The eviction solve (preempt/reclaim) jitted over the mesh: node-axis
     inputs shard exactly like the allocate solve's; every EvictResult field
-    is task-axis, so outputs replicate."""
-    fn = evict_solve_fn(mesh, config)
+    is task-axis, so outputs replicate.  ``impl`` is the guard plane's
+    demotion override (``"pjit"`` = the oracle)."""
+    fn = evict_solve_fn(mesh, config, impl=impl)
     with mesh:
         return fn(snap)
 
 
 def _evict(snap: DeviceSnapshot, config: EvictConfig) -> EvictResult:
     return evict_solve(snap, config)
+
+
+# --------------------------------------------------------------------------
+# sentinel-fused sharded solves (guard plane tier 1): the memoized sharded
+# solve body plus the ops/invariants tail in ONE jitted program — the
+# invariant reductions run on the replicated result vectors and the
+# node-sharded ledgers (GSPMD partitions the O(N) cross-checks), and the
+# verdict/histogram ride the action's single readback exactly like the
+# single-device sentinel programs.
+# --------------------------------------------------------------------------
+
+
+def sentinel_allocate_solve_fn(mesh: Mesh, config: AllocateConfig,
+                               impl: Optional[str] = None):
+    from kube_batch_tpu.ops.invariants import allocate_invariants
+
+    impl = _impl(impl)
+    key = (mesh, config, "sentinel_alloc", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        inner = allocate_solve_fn(mesh, config, impl=impl)
+
+        from kube_batch_tpu.ops.invariants import eligibility_checksum
+
+        def fused(snap):
+            res = inner(snap)
+            verdict, hist = allocate_invariants(snap, res, config)
+            return res, verdict, hist, eligibility_checksum(snap)
+
+        fn = jax.jit(fused)
+        jitstats.register(f"sentinel_sharded_allocate_solve[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sentinel_sharded_allocate_solve(snap, config, mesh, impl=None):
+    fn = sentinel_allocate_solve_fn(mesh, config, impl=impl)
+    with mesh:
+        return fn(snap)
+
+
+def sentinel_allocate_topk_solve_fn(mesh: Mesh, config: AllocateConfig,
+                                    impl: Optional[str] = None):
+    from kube_batch_tpu.ops.invariants import allocate_invariants
+
+    impl = _impl(impl)
+    key = (mesh, config, "sentinel_topk", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        inner = allocate_topk_solve_fn(mesh, config, impl=impl)
+
+        from kube_batch_tpu.ops.invariants import eligibility_checksum
+
+        def fused(snap, pend_rows):
+            res = inner(snap, pend_rows)
+            verdict, hist = allocate_invariants(snap, res, config)
+            return res, verdict, hist, eligibility_checksum(snap)
+
+        fn = jax.jit(fused)
+        jitstats.register(f"sentinel_sharded_allocate_topk_solve[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sentinel_sharded_allocate_topk_solve(snap, pend_rows, config, mesh,
+                                         impl=None):
+    fn = sentinel_allocate_topk_solve_fn(mesh, config, impl=impl)
+    with mesh:
+        return fn(snap, pend_rows)
+
+
+def sentinel_evict_solve_fn(mesh: Mesh, config: EvictConfig,
+                            impl: Optional[str] = None):
+    from kube_batch_tpu.ops.invariants import evict_invariants
+
+    impl = _impl(impl)
+    key = (mesh, config, "sentinel_evict", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        inner = evict_solve_fn(mesh, config, impl=impl)
+
+        from kube_batch_tpu.ops.invariants import eligibility_checksum
+
+        def fused(snap):
+            res = inner(snap)
+            verdict, hist = evict_invariants(snap, res, config)
+            return res, verdict, hist, eligibility_checksum(snap)
+
+        fn = jax.jit(fused)
+        jitstats.register(
+            f"sentinel_sharded_evict_solve[{config.mode},{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sentinel_sharded_evict_solve(snap, config, mesh, impl=None):
+    fn = sentinel_evict_solve_fn(mesh, config, impl=impl)
+    with mesh:
+        return fn(snap)
 
 
 def probe_solve_fn(mesh: Mesh, config: AllocateConfig,

@@ -303,6 +303,12 @@ class Scheduler:
             flush = getattr(self.cache, "flush_binds", None)
             if flush is not None:
                 flush()
+        # guard-plane breaker clock: demotion cooldowns and half-open
+        # probes count in SCHEDULING CYCLES, not wall seconds, so the
+        # state machine is deterministic under the sim's virtual clock
+        guard = getattr(self.cache, "guard_plane", None)
+        if guard is not None:
+            guard.end_cycle()
         if self.on_cycle_end is not None:
             self.on_cycle_end()
 

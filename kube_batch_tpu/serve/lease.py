@@ -47,6 +47,10 @@ class SnapshotLease(NamedTuple):
     mesh: object          # the solve mesh (None = single-device)
     probe_rows: tuple     # next-free task rows (the tie-hash oracle)
     queue_rows: Dict[str, int]  # queue name → row
+    #: preempt victim gates the session's conf carries that the eviction
+    #: probe does NOT model (drf/proportion) — surfaced per response as
+    #: `unmodeled: [...]` so clients can't silently over-trust a verdict
+    unmodeled_gates: tuple = ()
 
 
 def _donation_active() -> bool:
@@ -77,6 +81,18 @@ class LeaseBroker:
             self._lease = lease
             self.published += 1
             self._cond.notify_all()
+
+    def retire(self) -> None:
+        """Drop the published lease without a swap — the guard plane's
+        condemned-snapshot path: a solve whose sentinel tripped must not
+        keep serving what-ifs from the very columns it condemned.  Readers
+        already inside a dispatch finish against their held reference; new
+        dispatches wait for the next clean cycle's publish (or 503 on
+        timeout) — failing closed beats answering from corrupt state."""
+        with self._cond:
+            if self._lease is not None:
+                self._lease = None
+                self.retired += 1
 
     @contextmanager
     def swap_guard(self):

@@ -115,9 +115,14 @@ def _parse_request(body: dict, spec) -> dict:
     from kube_batch_tpu.api.task_info import _requests_to_resource
 
     try:
-        req_vec = _requests_to_resource(
+        res = _requests_to_resource(
             {k: float(v) for k, v in requests.items()}, spec
-        ).vec.astype(np.float32)
+        )
+        req_vec = res.vec.astype(np.float32)
+        # BestEffort member (empty InitResreq, the backfill path's pods):
+        # the probe never models backfill binds, so the verdict carries an
+        # explicit `unmodeled` entry instead of a silently-wrong verdict
+        best_effort = bool(res.is_empty())
     except (TypeError, ValueError):
         raise WhatifError(400, "requests values must be numeric")
     return {
@@ -129,6 +134,7 @@ def _parse_request(body: dict, spec) -> dict:
         "tolerations": tolerations,  # parsed Toleration objects
         "min_resources": min_resources,
         "req_vec": req_vec,
+        "best_effort": best_effort,
         "evictions": bool(body.get("evictions", False)),
         "_t0": telemetry.perf_counter(),
     }
@@ -259,6 +265,7 @@ class QueryPlane:
             mesh=mesh,
             probe_rows=tuple(cols.peek_task_rows(self.max_gang)),
             queue_rows=queue_rows,
+            unmodeled_gates=tuple(sorted(gates & {"drf", "proportion"})),
         )
         self.broker.publish(lease)
         metrics.set_whatif_snapshot_version(lease.version)
@@ -531,6 +538,25 @@ class QueryPlane:
         ]
         feasible = bool(host.feasible[b])
         unplaced = int(np.sum(assigned < 0))
+        # verdict honesty: every gap between this probe's model and the
+        # committed pipeline that APPLIES to this request is surfaced per
+        # response — a client must never silently over-trust a verdict
+        # (these were one-shot process logs before; a log line is invisible
+        # to the caller who needs it)
+        unmodeled = []
+        if req["evictions"]:
+            unmodeled += [
+                f"preempt victim gate '{g}' (conf tier) is not modeled by "
+                "the eviction probe — victim sets may diverge from the "
+                "committed preempt solve"
+                for g in lease.unmodeled_gates
+            ]
+        if req.get("best_effort"):
+            unmodeled.append(
+                "all members are BestEffort (sub-quanta requests): the "
+                "committed pipeline binds them via backfill, which this "
+                "probe does not model — 'infeasible' here is expected"
+            )
         out = {
             "snapshot_version": lease.version,
             "feasible": feasible,
@@ -539,6 +565,7 @@ class QueryPlane:
             "nodes": nodes,
             "pipelined": [bool(p) for p in pipelined.tolist()],
             "unplaced": unplaced,
+            "unmodeled": unmodeled,
         }
         if unplaced:
             # fit-error reasons summed over the unplaced members — the same

@@ -24,7 +24,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--preset", default="smoke",
                     help="scenario: smoke | fault | churn | brownout | "
-                         "bind-storm | leader-failover (default smoke)")
+                         "bind-storm | leader-failover | corruption "
+                         "(default smoke)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cycles", type=int, default=None,
                     help="override the preset's virtual-cycle budget")
@@ -38,7 +39,24 @@ def main(argv=None) -> int:
                     help="event-driven pipelined cycles: wake at arrivals "
                          "(floored by the preset's min_period) instead of "
                          "the fixed tick; staged close + writeback worker")
+    ap.add_argument("--replay-bundle", default=None, metavar="DIR",
+                    help="replay a guard-plane diagnostics bundle instead "
+                         "of running a preset: re-run the condemned solve "
+                         "and its oracle on the captured snapshot, "
+                         "sentinel-fused both ways (exit 0 iff the "
+                         "integrity failure reproduces)")
     args = ap.parse_args(argv)
+
+    if args.replay_bundle:
+        from kube_batch_tpu.guard.bundle import replay_bundle
+
+        report = replay_bundle(args.replay_bundle)
+        out = json.dumps(report, indent=2, sort_keys=True)
+        if args.report:
+            with open(args.report, "w") as f:
+                f.write(out + "\n")
+        print(out, flush=True)
+        return 0 if report.get("reproduced") else 1
 
     report = run_preset(args.preset, seed=args.seed, cycles=args.cycles,
                         trace_path=args.trace, pipelined=args.pipelined)
@@ -52,7 +70,10 @@ def main(argv=None) -> int:
     errs = report.get("invariants", {}).get("errors", [])
     recovered = report.get("fault_recovery", {}).get("recovered", True)
     duplicates = report.get("bind_integrity", {}).get("duplicate_binds", 0)
-    return 0 if not errs and recovered and not duplicates else 1
+    # corruption runs additionally gate on the guard-plane invariants
+    # (zero bad binds, demotion engaged, re-promotion, bundle written)
+    guard_ok = report.get("guard", {}).get("chaos_ok", True)
+    return 0 if not errs and recovered and not duplicates and guard_ok else 1
 
 
 if __name__ == "__main__":

@@ -35,12 +35,13 @@ WATCH_FLAP = "watch-flap"            # watch reconnect: full MODIFIED replay
 BROWNOUT = "apiserver-brownout"      # every egress call fails for a window
 BROWNOUT_END = "brownout-end"
 LEADER_FAILOVER = "leader-failover"  # leadership lost; warm standby takes over
+CORRUPT = "resident-corrupt"         # flip a word in a resident DEVICE column
 # observed (recorded from scheduler effects, never scheduled)
 BIND = "bind"
 EVICT = "evict"
 
 FAULT_KINDS = frozenset({NODE_CRASH, NODE_READD, BIND_FAIL, WATCH_FLAP,
-                         BROWNOUT, BROWNOUT_END, LEADER_FAILOVER})
+                         BROWNOUT, BROWNOUT_END, LEADER_FAILOVER, CORRUPT})
 
 
 @dataclasses.dataclass

@@ -57,6 +57,27 @@ def leader_failover_script(t: float) -> List[ev.SimEvent]:
     return [ev.SimEvent(t, ev.LEADER_FAILOVER, {})]
 
 
+def corruption_script(t: float, kind: str) -> List[ev.SimEvent]:
+    """Flip a word in a resident DEVICE column at t — the HBM-bit-flip /
+    silent-divergence model the guard plane exists to catch.  The host
+    columns (the truth) stay intact; only the device copy the solves
+    consume is corrupted, and the mirror is left agreeing with the host so
+    the scatter-delta diff does NOT silently heal it.  Kinds:
+
+    - ``ledger``: zero a live node's ``node_alloc`` capacity word in the
+      static feature cache (node features only re-upload on a node-change
+      version bump, so the flip persists) — the sentinel's capacity
+      cross-check (idle+used ≤ allocatable) condemns the next solve;
+    - ``score``: NaN a live node's ``node_releasing`` ledger word (a
+      fit/score input) — the sentinel's all-finite sweep condemns the
+      next solve;
+    - ``pending``: flip a long-lived RUNNING row's ``task_pending`` on —
+      the device would re-bid an already-placed task (a duplicate bind if
+      dispatched); the host eligibility-checksum cross-check condemns the
+      solve even when a fairness gate blocks the phantom bid."""
+    return [ev.SimEvent(t, ev.CORRUPT, {"kind": kind})]
+
+
 class FaultInjector:
     """Applies fault events against a running simulation. The runner owns
     the clock/heap/trace; this class owns what a fault *means*."""
@@ -65,6 +86,7 @@ class FaultInjector:
         self.runner = runner
         self.crashed_nodes = {}   # name -> Node object to re-add
         self.displaced_jobs = set()  # job uids that lost pods to crashes
+        self.corruptions_applied = 0  # resident-corrupt faults that landed
 
     def apply(self, event: ev.SimEvent) -> None:
         handler = {
@@ -75,6 +97,7 @@ class FaultInjector:
             ev.BROWNOUT: self._brownout,
             ev.BROWNOUT_END: self._brownout_end,
             ev.LEADER_FAILOVER: self._leader_failover,
+            ev.CORRUPT: self._corrupt,
         }[event.kind]
         handler(event)
 
@@ -158,6 +181,111 @@ class FaultInjector:
         report = runner.failover()
         runner.trace.record(ev.SimEvent(event.time, ev.LEADER_FAILOVER, {
             "mode": report["mode"],
+        }))
+
+    def _corrupt(self, event: ev.SimEvent) -> None:
+        """Flip a word in a resident DEVICE column (corruption_script) —
+        the host columns stay intact, the mirror keeps agreeing with the
+        host, so only the device copy the solves consume diverges, exactly
+        like an HBM bit-flip.  A cold resident cache (nothing uploaded
+        yet) retries one virtual second later."""
+        import numpy as np
+
+        runner = self.runner
+        kind = event.data["kind"]
+        cols = runner.cache.columns
+
+        def retry():
+            runner.heap.push(ev.SimEvent(
+                event.time + 1.0, ev.CORRUPT, dict(event.data)))
+
+        import jax
+
+        live = np.flatnonzero(np.asarray(cols.n_valid))
+        # per-cycle corruptions must diverge device-from-MIRROR the way an
+        # HBM flip does: the next swap's diff compares mirror vs host, so
+        # the mirror row is pinned to the CURRENT host truth — the diff
+        # stays silent and the corrupt device word survives into the solve
+        # (a stale mirror row would make the swap scatter-heal it first)
+        if kind == "pending":
+            # flip a RUNNING row's device pending bit on; detection is the
+            # action's HOST pending cross-check when the (full-matrix)
+            # solve re-assigns the row
+            rc = cols._per_cycle_dev.get(None)
+            dev = rc._dev.get("task_pending") if rc is not None else None
+            if dev is None:
+                return retry()
+            from kube_batch_tpu.api.types import TaskStatus
+
+            rows = np.flatnonzero(
+                np.asarray(cols.t_status) == int(TaskStatus.RUNNING)
+            )
+            if rows.size == 0:
+                return retry()
+            # the flip must OUTLIVE the next few dispatches: a task that
+            # completes first frees its row (or drops out of the session),
+            # dissolving the corruption into legitimate/inert state before
+            # a solve can be condemned by it.  The heap KNOWS every
+            # running pod's scheduled completion — pick the row whose
+            # POD_SUCCEEDED is furthest out, and require ≥ 5 vt of life
+            succeed_at = {
+                e.item.data.get("key"): e.item.time
+                for e in runner.heap._pq._heap
+                if e.item.kind == ev.POD_SUCCEEDED
+            }
+            best, best_t = -1, event.time + 5.0
+            for row in rows.tolist():
+                task = cols.task_by_row[row]
+                if task is None:
+                    continue
+                # a KNOWN future completion only: a pod missing from the
+                # heap has its success event in THIS instant's due batch —
+                # it is about to be deleted, the worst possible target
+                t_done = succeed_at.get(task.pod.key())
+                if t_done is not None and t_done > best_t:
+                    best, best_t = row, t_done
+            if best < 0:
+                return retry()
+            r = best
+            host = np.array(jax.device_get(dev))
+            host[r] = True
+            rc._dev["task_pending"] = jax.device_put(host)
+            rc._mirror["task_pending"][r] = False  # host truth: not pending
+            field = "task_pending"
+        elif kind == "score":
+            # NaN a live node's releasing word — a fit/score input; the
+            # sentinel's all-finite sweep condemns the next solve.  (Task-
+            # axis feature columns re-upload on every arrival's version
+            # bump, which would silently heal the corruption before a
+            # solve ever saw it — node ledgers only scatter at moved rows)
+            rc = cols._per_cycle_dev.get(None)
+            dev = rc._dev.get("node_releasing") if rc is not None else None
+            if dev is None or live.size == 0:
+                return retry()
+            r = int(live[0])
+            host = np.array(jax.device_get(dev))
+            host[r, 0] = np.nan
+            rc._dev["node_releasing"] = jax.device_put(host)
+            rc._mirror["node_releasing"][r] = np.asarray(cols.n_rel32)[r]
+            field = "node_releasing"
+        else:
+            # static feature column (version-keyed cache): node features
+            # only re-upload on a node-change version bump, so a zeroed
+            # capacity word persists until the guard's trip-heal drops the
+            # cache.  The row must be a LIVE node (the row allocator may
+            # start live rows past 0 when the axis was pre-reserved)
+            field = "node_alloc"
+            feat = cols._dev_cache.get(None, {})
+            entry = feat.get(field)
+            if entry is None or live.size == 0:
+                return retry()
+            version, dev = entry
+            host = np.array(jax.device_get(dev))
+            host[int(live[0])] = 0.0
+            feat[field] = (version, jax.device_put(host))
+        self.corruptions_applied += 1
+        runner.trace.record(ev.SimEvent(event.time, ev.CORRUPT, {
+            "kind": kind, "field": field,
         }))
 
     def _watch_flap(self, event: ev.SimEvent) -> None:

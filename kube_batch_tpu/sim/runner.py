@@ -46,6 +46,7 @@ from kube_batch_tpu.sim.faults import (
     FaultInjector,
     bind_fail_script,
     brownout_script,
+    corruption_script,
     leader_failover_script,
     node_crash_script,
     watch_flap_script,
@@ -94,6 +95,14 @@ class SimConfig:
     # wall-clock bench measures the overlap gain.
     pipelined: bool = False
     min_period: float = 0.05
+    # column-capacity reservation (ColumnStore.reserve) — the corruption
+    # preset reserves a task bucket big enough that the KB_TOPK compacted
+    # path ENGAGES (capT ≥ 1024 → a 256-row pending bucket), so the
+    # guard's demotion/re-promotion machinery has a real fast path to act
+    # on at sim scale
+    reserve_tasks: int = 0
+    reserve_nodes: int = 0
+    reserve_jobs: int = 0
     # faults
     faults: Tuple[SimEvent, ...] = ()
     evict_delay: float = 1.0
@@ -182,9 +191,36 @@ def preset(name: str, seed: int = 0) -> SimConfig:
         cfg = SimConfig(seed=seed, cycles=70, n_jobs=14, arrival_rate=1.2)
         cfg.faults = tuple(leader_failover_script(9.0))
         return cfg
+    if name == "corruption":
+        # result-integrity chaos (the guard plane's acceptance preset):
+        # three resident-DEVICE-column corruptions land mid-run — a zeroed
+        # capacity word, a NaN score input, a flipped pending bit on a
+        # RUNNING row — while the host truth stays intact.  Invariants the
+        # CLI enforces: ZERO bad binds dispatched (no duplicate binds, no
+        # accounting drift — every condemned solve failed closed),
+        # demotion engages on trip, re-promotion recovers after the
+        # cooldown, and a diagnostics bundle lands for --replay-bundle.
+        # The reserved task bucket makes KB_TOPK engage at sim scale so
+        # demotion has a real fast path to act on.
+        cfg = SimConfig(
+            seed=seed, n_nodes=4, node_cpu=8000.0, queues=(("q0", 1),),
+            cycles=60, n_jobs=30, arrival_rate=0.75, gang_sizes=(1, 2),
+            duration_range=(6.0, 18.0),
+            reserve_tasks=1024, reserve_nodes=64,
+        )
+        cfg.faults = (
+            *corruption_script(3.3, "ledger"),
+            *corruption_script(16.3, "score"),
+            # deliberately INSIDE the score trip's demotion window: with
+            # KB_TOPK demoted the full-matrix program runs, which is the
+            # path a flipped pending bit can actually steer into a
+            # duplicate bind — the host pending cross-check must catch it
+            *corruption_script(19.3, "pending"),
+        )
+        return cfg
     raise KeyError(
         f"unknown preset {name!r} (smoke | fault | churn | brownout | "
-        "bind-storm | leader-failover)")
+        "bind-storm | leader-failover | corruption)")
 
 
 class SimRunner:
@@ -205,6 +241,11 @@ class SimRunner:
         )
         guard = GuardedBackend(self.kubelet, self.breaker)
         self.cache = SchedulerCache(binder=guard, evictor=guard)
+        if cfg.reserve_tasks or cfg.reserve_nodes or cfg.reserve_jobs:
+            self.cache.columns.reserve(
+                n_tasks=cfg.reserve_tasks, n_nodes=cfg.reserve_nodes,
+                n_jobs=cfg.reserve_jobs,
+            )
         if cfg.conf_text:
             conf = parse_scheduler_conf(cfg.conf_text)
         else:
@@ -708,7 +749,37 @@ class SimRunner:
         failover = self._failover_report(scatter)
         if failover is not None:
             report["failover"] = failover
+        guard = self._guard_report(report)
+        if guard is not None:
+            report["guard"] = guard
         return report
+
+    def _guard_report(self, report) -> Optional[Dict]:
+        """The result-integrity guard plane's longitudinal evidence.  On a
+        corruption run, ``chaos_ok`` is the CLI's exit-code invariant:
+        every injected corruption tripped the sentinel, every condemned
+        solve failed closed (zero bad binds — no duplicate bind acks, no
+        accounting drift), demotion engaged, re-promotion recovered after
+        the cooldown, and a diagnostics bundle landed for
+        ``--replay-bundle``."""
+        gp = getattr(self.cache, "guard_plane", None)
+        if gp is None:
+            return None
+        state = gp.state()
+        state["corruptions_injected"] = self.faults.corruptions_applied
+        state["trip_log"] = list(gp.trip_log)
+        if self.faults.corruptions_applied:
+            paths = state["paths"].values()
+            state["chaos_ok"] = bool(
+                state["trips_total"] >= self.faults.corruptions_applied
+                and state["failed_closed"] >= 1
+                and any(p["trips"] > 0 for p in paths)       # demotion engaged
+                and any(p["promotions"] > 0 for p in paths)  # re-promoted
+                and state["bundles"]
+                and self.duplicate_binds == 0
+                and not report["invariants"]["errors"]
+            )
+        return state
 
     def _failover_report(self, scatter_now: Dict) -> Optional[List[Dict]]:
         """Per-failover recovery evidence: how many cycles until the

@@ -31,6 +31,22 @@ env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
   --preset leader-failover --seed 5 --no-fairness-series >/dev/null
 echo "kbt-check: chaos smoke clean"
 
+# guard smoke: the result-integrity corruption preset — three resident
+# device-column corruptions must each trip the sentinel with ZERO bad
+# binds dispatched (no duplicate acks, no accounting drift), demotion
+# must engage and re-promote, and every trip's diagnostics bundle must
+# --replay-bundle deterministically (exit nonzero on any violation)
+echo "kbt-check: guard smoke (corruption preset + bundle replay)"
+GUARD_TMP="$(mktemp -d)"
+trap 'rm -rf "$GUARD_TMP"' EXIT
+env JAX_PLATFORMS=cpu KB_GUARD_DIR="$GUARD_TMP" python -m kube_batch_tpu.sim \
+  --preset corruption --seed 0 --no-fairness-series >/dev/null
+for bundle in "$GUARD_TMP"/trip-*; do
+  env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
+    --replay-bundle "$bundle" >/dev/null
+done
+echo "kbt-check: guard smoke clean"
+
 # whatif smoke: the serve/ query plane end to end — loopback AdminServer,
 # mixed feasible/infeasible gangs via the kb-ctl whatif CLI, verdict +
 # Prometheus-counter + amortization assertions (scripts/whatif_smoke.py)

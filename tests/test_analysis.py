@@ -414,6 +414,83 @@ class TestKBT006:
         """
         assert findings_for(src, "api/x.py") == []
 
+    # ---- one-level interprocedural donation tracking (ROADMAP standing
+    # item): a same-module helper that donates its parameter taints its
+    # call sites exactly like a direct donating call ------------------------
+
+    def test_helper_donating_its_param_taints_caller(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(buf, rows):
+            return scatter(buf, rows)
+
+        def cycle(dev, rows):
+            out = refresh(dev, rows)
+            return out, dev.sum()
+        """
+        findings = findings_for(src, "api/x.py")
+        assert rule_ids(findings) == ["KBT006"]
+        assert any("dev" in f.message for f in findings)
+
+    def test_helper_via_factory_form_taints_caller(self):
+        # the `_scatter_fn()()` factory form INSIDE the helper — the
+        # one-level scan resolves it through the same symbol table
+        src = """
+        import jax
+
+        _S = None
+
+        def _scatter_fn():
+            global _S
+            if _S is None:
+                _S = jax.jit(lambda d, r: d.at[r].set(0.0),
+                             donate_argnums=(0,))
+            return _S
+
+        def refresh(buf, rows):
+            return _scatter_fn()(buf, rows)
+
+        def cycle(dev, rows):
+            out = refresh(dev, rows)
+            return out, dev.sum()
+        """
+        assert rule_ids(findings_for(src, "api/x.py")) == ["KBT006"]
+
+    def test_caller_rebinding_through_helper_is_clean(self):
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def refresh(buf, rows):
+            return scatter(buf, rows)
+
+        def cycle(dev, rows):
+            dev = refresh(dev, rows)
+            return dev.sum()
+        """
+        assert findings_for(src, "api/x.py") == []
+
+    def test_helper_not_donating_its_param_is_inert(self):
+        # the helper reads its param but never feeds a donated position —
+        # its call sites must NOT taint
+        src = """
+        import jax
+
+        scatter = jax.jit(lambda d, r: d.at[r].set(0.0), donate_argnums=(0,))
+
+        def peek(buf):
+            return buf.sum()
+
+        def cycle(dev, rows):
+            total = peek(dev)
+            return total, dev.sum()
+        """
+        assert findings_for(src, "api/x.py") == []
+
 
 # ---------------------------------------------------------------------------
 # KBT007 — jit retrace hazards
@@ -708,7 +785,9 @@ class TestKBT010:
             return np.asarray(result)
         """
         findings = findings_for(src, "actions/x.py")
-        assert rule_ids(findings) == ["KBT010"]
+        # the fixture's bare dispatch also (correctly) lacks a sentinel
+        # consumer, so KBT013 fires alongside since the guard-plane PR
+        assert rule_ids(findings) == ["KBT010", "KBT013"]
 
     def test_attribute_of_result_is_still_the_result(self):
         src = """
@@ -719,7 +798,9 @@ class TestKBT010:
             result = evict_solve(snap, config)
             return np.asarray(result.claim_node)
         """
-        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT010"]
+        assert rule_ids(findings_for(src, "actions/x.py")) == [
+            "KBT010", "KBT013",  # bare dispatch: no sentinel consumer either
+        ]
 
     def test_device_get_is_always_a_choke_point(self):
         src = """
@@ -1116,6 +1197,68 @@ class TestKBT012:
 
 
 # ---------------------------------------------------------------------------
+# KBT013 — solve dispatch without a sentinel-verdict consumer
+# ---------------------------------------------------------------------------
+
+
+class TestKBT013:
+    def test_dispatch_without_consumer_triggers(self):
+        src = """
+        def execute(ssn, snap, config):
+            result, mode, topk, ginfo = dispatch_allocate_solve(
+                snap, config, cols=ssn.columns
+            )
+            return result
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert rule_ids(findings) == ["KBT013"]
+        assert "consume" in findings[0].message
+
+    def test_dispatch_with_consumer_is_clean(self):
+        src = """
+        def execute(ssn, snap, config, gp):
+            result, mode, topk, ginfo = dispatch_allocate_solve(
+                snap, config, cols=ssn.columns, guard=gp
+            )
+            if not gp.consume_verdict("allocate", ginfo["engaged"], 0):
+                return None
+            return result
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_direct_evict_solve_without_consumer_triggers(self):
+        src = """
+        def solve(ssn, snap, config):
+            return evict_solve(snap, config)
+        """
+        assert rule_ids(findings_for(src, "actions/x.py")) == ["KBT013"]
+
+    def test_dispatch_seam_layer_is_exempt(self):
+        # dispatch_*-named helpers RETURN the un-consumed sentinel — the
+        # rule holds their call sites to the consumer requirement instead
+        src = """
+        def dispatch_allocate_solve(snap, config):
+            return allocate_sentinel_solve(snap, config)
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+    def test_out_of_scope_unflagged(self):
+        src = """
+        def probe(snap, config):
+            return evict_solve(snap, config)
+        """
+        assert findings_for(src, "serve/x.py") == []
+
+    def test_annotation_suppresses(self):
+        src = """
+        def helper(snap, config):
+            # kbt: allow[KBT013] read-only diagnostic solve, never bound
+            return evict_solve(snap, config)
+        """
+        assert findings_for(src, "actions/x.py") == []
+
+
+# ---------------------------------------------------------------------------
 # self-enforcement: the package must be clean (tier-1)
 # ---------------------------------------------------------------------------
 
@@ -1131,8 +1274,8 @@ class TestSelfEnforcement:
             # each rule documents the incident that motivated it
             assert rule.__doc__ and len(rule.__doc__.strip()) > 40
 
-    def test_all_twelve_rules_are_registered(self):
-        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 13)]
+    def test_all_thirteen_rules_are_registered(self):
+        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 14)]
 
     def test_jaxpr_registry_has_zero_unsuppressed_findings(self):
         # tier B self-enforcement: every registered jitted entry point

@@ -60,6 +60,24 @@ def _add_gang(cache, serial, size=2):
         ))
 
 
+def _add_gang_cpu(cache, serial, size=2, cpu=500.0):
+    """_add_gang with a per-gang cpu request (heterogeneous occupancy for
+    the crash-recovery bit-exactness test)."""
+    g = f"g{serial}"
+    cache.add_pod_group(PodGroup(
+        name=g, namespace="fo", uid=f"pg-{g}", min_member=size,
+        queue=f"q{serial % 2}", creation_index=serial,
+    ))
+    for k in range(size):
+        cache.add_pod(Pod(
+            name=f"{g}-{k}", namespace="fo", uid=f"pod-{g}-{k}",
+            requests={"cpu": cpu, "memory": 1 * GiB},
+            annotations={GROUP_NAME_ANNOTATION: g},
+            phase=PodPhase.PENDING,
+            creation_index=serial * 100 + k,
+        ))
+
+
 def _cycle(cache, conf, check_resident=False):
     """One real scheduling cycle; optionally assert the device-resident
     per-cycle columns are bit-exact with the freshly built host columns."""
@@ -276,3 +294,185 @@ def test_failover_mid_churn_open_state_matches_full_view(seed):
             == set(expected.jobs)
     finally:
         close_session(ssn)
+
+
+# ==========================================================================
+# crash recovery: save → process "restart" → load → warm revalidate
+# (guard-plane PR satellite)
+# ==========================================================================
+
+
+class TestCrashRecovery:
+    """cache/persistence.py save → a fresh process's load →
+    ``failover_recover`` warm revalidation, under randomized churn with
+    in-flight binds: the next cycle must be BIT-EXACT against the
+    uninterrupted run, and no pod may regress to Pending after an acked
+    bind."""
+
+    CONF = None  # shipped 5-action conf (enqueue re-promotes parked jobs)
+
+    @classmethod
+    def _conf(cls):
+        if cls.CONF is None:
+            from kube_batch_tpu.framework.conf import shipped_conf_path
+
+            cls.CONF = load_scheduler_conf(shipped_conf_path())
+        return cls.CONF
+
+    def _full_cycle(self, cache):
+        conf = self._conf()
+        ssn = open_session(cache, conf.tiers)
+        ssn.action_names = list(conf.actions)
+        try:
+            for name in conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+        # binds stay IN FLIGHT here (async binder pool) — the save must
+        # drain them itself so the state file can't miss a just-acked bind
+
+    def _churn(self, cache, rng, serial):
+        """One churn step: new gangs with HETEROGENEOUS requests (node
+        occupancies then differ everywhere, so scores are strictly
+        ordered and no decision ever falls to the row-keyed tie-break —
+        the restart's row permutation must not be able to change a
+        decision), plus random progressions of bound pods to RUNNING."""
+        for g in range(int(rng.integers(1, 3))):
+            size = int(rng.integers(1, 4))
+            cpu = 300.0 + 97.0 * serial + 31.0 * g
+            _add_gang_cpu(cache, serial=serial * 10 + g, size=size, cpu=cpu)
+        for key in sorted(cache.pods):
+            pod = cache.pods[key]
+            if pod.node_name and pod.phase == PodPhase.PENDING and rng.random() < 0.4:
+                kl.set_running(cache, key, pod.node_name)
+
+    def test_restart_recovers_bit_exact_with_no_bind_regression(
+        self, tmp_path
+    ):
+        from kube_batch_tpu.cache.persistence import load_state, save_state
+
+        path = str(tmp_path / "state.json")
+        rng = np.random.default_rng(7)
+        cache_a = _mk_cache()
+        for serial in range(1, 6):
+            self._churn(cache_a, rng, serial)
+            self._full_cycle(cache_a)
+        # save mid-stream: binds dispatched by the last cycle are still in
+        # flight on the async binder — save_state drains them first
+        save_state(cache_a, path)
+        acked = {k: p.node_name for k, p in cache_a.pods.items()
+                 if p.node_name}
+        assert acked, "churn must have produced acked binds"
+
+        # "restart": a brand-new process's cache, re-listed from the state
+        # file, then warm-revalidated exactly like the standby takeover
+        cache_b = SchedulerCache()
+        cache_b.columns.reserve(n_tasks=2048, n_nodes=128, n_jobs=512)
+        assert load_state(cache_b, path)
+        report = cache_b.failover_recover()
+        assert report.get("errors", []) == []
+
+        # no pod regresses to Pending after an acked bind: every acked
+        # placement survives the restart with its node intact
+        for key, node in acked.items():
+            restored = cache_b.pods[key]
+            assert restored.node_name == node, (
+                f"{key} lost its acked bind across the restart"
+            )
+        from kube_batch_tpu.api.types import TaskStatus as TS
+
+        for job in cache_b.jobs.values():
+            for t in job.tasks.values():
+                if t.uid in {cache_a.pods[k].uid for k in acked}:
+                    assert t.status != TS.PENDING
+
+        # identical next-cycle input on both sides
+        for c in (cache_a, cache_b):
+            _add_gang_cpu(c, serial=999, size=2, cpu=777.0)
+
+        # the next cycle's SOLVE INPUT is bit-exact UP TO the row
+        # permutation the pod-store rebuild introduces (the row allocator
+        # re-deals rows; every per-task column gathered through the
+        # uid→row maps must agree exactly)
+        conf = self._conf()
+        ssn_a = open_session(cache_a, conf.tiers)
+        ssn_b = open_session(cache_b, conf.tiers)
+        try:
+            snap_a, meta_a = cache_a.columns.device_snapshot(ssn_a)
+            snap_b, meta_b = cache_b.columns.device_snapshot(ssn_b)
+            assert meta_a.n_tasks == meta_b.n_tasks
+            row_a = {
+                t.pod.uid: r
+                for r, t in enumerate(cache_a.columns.task_by_row)
+                if t is not None
+            }
+            row_b = {
+                t.pod.uid: r
+                for r, t in enumerate(cache_b.columns.task_by_row)
+                if t is not None
+            }
+            assert sorted(row_a) == sorted(row_b)
+            uids = sorted(row_a)
+            pa = np.asarray([row_a[u] for u in uids])
+            pb = np.asarray([row_b[u] for u in uids])
+            from kube_batch_tpu.api.types import TaskStatus as TS
+
+            def canon_status(arr):
+                # a restored acked bind is BOUND where the uninterrupted
+                # process still shows BINDING (its ack just landed) — the
+                # documented restart collapse; both are ready/allocated
+                # states and decision-equivalent.  PENDING is what must
+                # never appear for an acked bind (asserted above).
+                out = np.array(arr)
+                out[out == int(TS.BINDING)] = int(TS.BOUND)
+                return out
+
+            for field in ("task_req", "task_resreq", "task_prio",
+                          "task_status", "task_valid", "task_pending",
+                          "task_best_effort", "task_creation"):
+                a = np.asarray(getattr(snap_a, field))[pa]
+                b = np.asarray(getattr(snap_b, field))[pb]
+                if field == "task_status":
+                    a, b = canon_status(a), canon_status(b)
+                assert np.array_equal(a, b), (
+                    f"snapshot column {field} diverged across the restart"
+                )
+            # node columns are permutation-free (insertion order replays)
+            for field in ("node_idle", "node_releasing", "node_used",
+                          "node_alloc", "node_valid", "node_sched"):
+                a = np.asarray(getattr(snap_a, field))
+                b = np.asarray(getattr(snap_b, field))
+                assert np.array_equal(a, b), (
+                    f"snapshot column {field} diverged across the restart"
+                )
+        finally:
+            close_session(ssn_a)
+            close_session(ssn_b)
+
+        # and the next cycle's DECISIONS are identical: same binds for the
+        # new gang, same post-cycle statuses for every task
+        before_a = dict(cache_a.binder.binds)
+        self._full_cycle(cache_a)
+        cache_a.flush_binds()
+        self._full_cycle(cache_b)
+        cache_b.flush_binds()
+        new_a = {k: v for k, v in cache_a.binder.binds.items()
+                 if k not in before_a}
+        new_b = dict(cache_b.binder.binds)  # fresh binder: all new
+        assert new_a and new_a == new_b
+        from kube_batch_tpu.api.types import TaskStatus as TS2
+
+        def canon(st):
+            return TS2.BOUND if st == TS2.BINDING else st
+
+        status_a = {
+            t.uid: canon(t.status)
+            for j in cache_a.jobs.values() for t in j.tasks.values()
+        }
+        status_b = {
+            t.uid: canon(t.status)
+            for j in cache_b.jobs.values() for t in j.tasks.values()
+        }
+        assert status_a == status_b
+        assert cache_a.columns.check_consistency(cache_a) == []
+        assert cache_b.columns.check_consistency(cache_b) == []

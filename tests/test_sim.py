@@ -206,6 +206,40 @@ class TestSimFaults:
         assert r["bind_integrity"]["duplicate_binds"] == 0
         assert r["invariants"]["errors"] == []
 
+    def test_corruption_preset_guard_plane_end_to_end(
+        self, tmp_path, monkeypatch
+    ):
+        """The result-integrity chaos preset (guard-plane acceptance):
+        three resident-DEVICE-column corruptions — a zeroed capacity word,
+        a NaN score input, a flipped pending bit on a RUNNING row — land
+        mid-run while the host truth stays intact.  Every class must trip
+        the sentinel, ZERO bad binds may dispatch (no duplicate acks, no
+        accounting drift — condemned solves failed closed), the engaged
+        fast path must demote AND re-promote after the cooldown, and the
+        diagnostics bundle must --replay-bundle deterministically."""
+        monkeypatch.setenv("KB_GUARD_DIR", str(tmp_path))
+        r = run_preset("corruption", seed=0)
+        g = r["guard"]
+        assert g["corruptions_injected"] == 3
+        assert g["trips_total"] >= 3
+        assert g["failed_closed"] >= 3
+        # zero bad binds across all injected corruption classes
+        assert r["bind_integrity"]["duplicate_binds"] == 0
+        assert r["invariants"]["errors"] == []
+        # demotion engaged on trip; the half-open probe re-promoted
+        topk = g["paths"]["topk"]
+        assert topk["trips"] >= 1 and topk["promotions"] >= 1
+        assert topk["state"] == "healthy"
+        # every invariant above is what chaos_ok aggregates for the CLI
+        assert g["chaos_ok"] is True
+        # a self-contained bundle landed and reproduces the trip offline
+        assert g["bundles"]
+        from kube_batch_tpu.guard.bundle import replay_bundle
+
+        rep = replay_bundle(g["bundles"][0])
+        assert rep["reproduced"] is True
+        assert rep["original_report"]["verdict"] >= 1
+
     def test_chaos_presets_are_seed_deterministic(self):
         """Same seed ⇒ byte-identical trace holds for the chaos machinery
         too (breaker paced by the virtual clock, tick-based resync)."""

@@ -1055,3 +1055,106 @@ class TestWhatifHTTP:
         finally:
             srv.stop()
             qp.close()
+
+
+# ==========================================================================
+# verdict honesty: per-response `unmodeled: [...]` (guard-plane PR satellite)
+# ==========================================================================
+
+
+class TestUnmodeledHonesty:
+    """Probe verdicts whose conf carries preempt gates the eviction probe
+    does not model (drf/proportion victim gates), or whose gang only the
+    backfill path could bind (all-BestEffort), must say so PER RESPONSE —
+    a one-shot process log is invisible to the client that needs it."""
+
+    DRF_TIER1_CONF = """
+    actions: "enqueue, reclaim, allocate, backfill, preempt"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+      - name: conformance
+      - name: drf
+    - plugins:
+      - name: predicates
+      - name: proportion
+      - name: nodeorder
+    """
+
+    def _cache(self):
+        return build_cache(
+            queues=[Queue(name="default", weight=1)],
+            pod_groups=[],
+            nodes=[build_node("n0", cpu=8000, mem=16 * GiB)],
+            pods=[],
+        )
+
+    def _run_conf(self, cache, conf_text):
+        import textwrap
+
+        from kube_batch_tpu.framework.conf import parse_scheduler_conf
+
+        conf = parse_scheduler_conf(textwrap.dedent(conf_text))
+        ssn = open_session(cache, conf.tiers)
+        try:
+            get_action("allocate").execute(ssn)
+        finally:
+            close_session(ssn)
+        cache.flush_binds()
+
+    def test_shipped_conf_plain_probe_has_empty_unmodeled(self, plane_factory):
+        cache = self._cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {"queue": "default", "count": 1,
+                           "requests": {"cpu": 1000, "memory": GiB}})
+        assert resp["unmodeled"] == []
+
+    def test_shipped_conf_eviction_probe_has_empty_unmodeled(
+        self, plane_factory
+    ):
+        # the shipped conf's first voting preempt tier is gang+conformance
+        # — fully modeled, so the field stays empty even with evictions on
+        cache = self._cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {"queue": "default", "count": 1,
+                           "requests": {"cpu": 1000, "memory": GiB},
+                           "evictions": True})
+        assert resp["unmodeled"] == []
+
+    def test_drf_victim_gate_reported_on_eviction_probes_only(
+        self, plane_factory
+    ):
+        cache = self._cache()
+        qp = plane_factory(cache)
+        self._run_conf(cache, self.DRF_TIER1_CONF)
+        lease = qp.broker.current()
+        assert lease.unmodeled_gates == ("drf",)
+        with_ev = _probe(qp, {"queue": "default", "count": 1,
+                              "requests": {"cpu": 1000, "memory": GiB},
+                              "evictions": True})
+        assert any("drf" in gap for gap in with_ev["unmodeled"])
+        plain = _probe(qp, {"queue": "default", "count": 1,
+                            "requests": {"cpu": 1000, "memory": GiB}})
+        # the gate only affects eviction answers — plain probes stay clean
+        assert plain["unmodeled"] == []
+
+    def test_all_best_effort_gang_reports_backfill_gap(self, plane_factory):
+        cache = self._cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {"queue": "default", "count": 2, "requests": {}})
+        assert resp["feasible"] is False  # documented probe scope
+        assert any("backfill" in gap.lower() for gap in resp["unmodeled"])
+
+    def test_cli_render_surfaces_unmodeled(self):
+        from kube_batch_tpu.cli.whatif import _render
+
+        out = _render({
+            "feasible": False, "snapshot_version": 7, "nodes": [None],
+            "unmodeled": ["preempt victim gate 'drf' (conf tier) is not "
+                          "modeled by the eviction probe"],
+        })
+        assert "! unmodeled:" in out and "drf" in out
