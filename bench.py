@@ -268,6 +268,13 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         rec["topk"] = get_action("allocate").last_topk
         rec["solve_rounds"] = get_action("allocate").last_solve_rounds
         records.append(rec)
+    # span-recorder stats for the trace_overhead section: spans per cycle
+    # and per-stage counts, straight off the per-cache tracer (obs/trace)
+    tracer = getattr(cache, "tracer", None)
+    trace_stats = (
+        tracer.stage_attribution()
+        if tracer is not None and tracer.enabled else None
+    )
     cache.stop()
 
     warm, steady = records[:warmup_cycles], records[warmup_cycles:]
@@ -341,6 +348,7 @@ def multicycle_bench(conf, n_tasks, n_nodes, cycles=8, warmup_cycles=2,
         "resident_scatter": _resident_scatter_summary(
             cache.columns.resident_counters()
         ),
+        "trace": trace_stats,
     }
 
 
@@ -475,6 +483,192 @@ def guard_overhead_bench(conf, n_tasks=20_000, n_nodes=2_000, reps=13,
         "steady_cycle_e2e_p50_ms": e2e,
         "overhead_pct": round(100.0 * deltas / e2e, 2) if e2e > 0 else 0.0,
         "retraces_steady": mc.get("retraces_steady"),
+    }
+
+
+def trace_overhead_bench(conf, n_tasks=20_000, n_nodes=2_000, cycles=6,
+                         reps=20_000):
+    """Span-recorder cost vs the steady e2e p50 (<2% acceptance target),
+    with zero new steady retraces.
+
+    Methodology (the guard_overhead precedent): a full A/B multicycle
+    pair on the loaded 2-core box is noise-dominated — a sub-ms per-cycle
+    tracing cost hides under the solve's ±10% wobble — so the span
+    machinery ITSELF is micro-timed (context-manager enter/exit with ring
+    retention, plus the device-span counter probes: the jit compile-count
+    read and the resident-counter read, paid twice per device span) and
+    multiplied by the spans-per-cycle the traced multicycle run actually
+    created; the denominator is that run's steady e2e p50.  The A/B pair
+    still runs and is reported as corroboration, and the traced run's
+    retrace counter is the zero-new-retraces acceptance."""
+    import tempfile
+
+    from kube_batch_tpu.obs.recorder import FlightRecorder
+    from kube_batch_tpu.obs.trace import Tracer
+    from kube_batch_tpu.utils import jitstats
+
+    saved = os.environ.get("KB_TRACE")
+    try:
+        os.environ.pop("KB_TRACE", None)        # default = tracing on
+        on = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+        os.environ["KB_TRACE"] = "0"
+        off = multicycle_bench(conf, n_tasks, n_nodes, cycles=cycles)
+    finally:
+        if saved is None:
+            os.environ.pop("KB_TRACE", None)
+        else:
+            os.environ["KB_TRACE"] = saved
+    e2e_on = on["steady"].get("e2e", {}).get("p50", 0.0)
+    e2e_off = off["steady"].get("e2e", {}).get("p50", 0.0)
+    trace = on.get("trace") or {}
+    n_cycles = 2 + cycles  # multicycle_bench's warmup + steady cycles
+    spans_per_cycle = trace.get("spans_total", 0) / n_cycles
+    device_span_names = {"solve_dispatch", "device_wait", "gate_dispatch",
+                         "fit_histogram_dispatch", "fit_errors",
+                         "audit_dispatch"}
+    dev_spans_per_cycle = sum(
+        c for name, c in (trace.get("stages") or {}).items()
+        if name in device_span_names
+    ) / n_cycles
+
+    # micro: span enter/exit with full retention (ring + stage counters)
+    tr = Tracer(
+        recorder=FlightRecorder(
+            ring=256, directory=tempfile.mkdtemp(prefix="kb-flight-bench-")
+        ),
+        enabled=True,
+    )
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with tr.span("bench"):
+            pass
+    span_ms = (time.perf_counter() - t0) / reps * 1e3
+    # micro: the device-span counter probes (sampled at enter AND exit)
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        jitstats.total_compiles()
+    jit_probe_ms = (time.perf_counter() - t0) / 1000 * 1e3
+    probe_cache = synthetic_cluster(n_tasks=256, n_nodes=32, gang_size=4,
+                                    n_queues=1)
+    cols = probe_cache.columns
+    t0 = time.perf_counter()
+    for _ in range(1000):
+        cols.resident_counters()
+    scat_probe_ms = (time.perf_counter() - t0) / 1000 * 1e3
+    probe_cache.stop()
+
+    modeled_ms = (
+        spans_per_cycle * span_ms
+        + dev_spans_per_cycle * 2.0 * (jit_probe_ms + scat_probe_ms)
+    )
+    return {
+        "pods": n_tasks, "nodes": n_nodes,
+        "target": "overhead_pct < 2",
+        "spans_per_cycle": round(spans_per_cycle, 1),
+        "device_spans_per_cycle": round(dev_spans_per_cycle, 1),
+        "span_cost_us": round(span_ms * 1e3, 3),
+        "device_probe_cost_us": round(
+            (jit_probe_ms + scat_probe_ms) * 1e3, 3),
+        "trace_delta_ms_per_cycle": round(modeled_ms, 3),
+        "steady_cycle_e2e_p50_ms": e2e_on,
+        "overhead_pct": round(100.0 * modeled_ms / e2e_on, 3)
+        if e2e_on > 0 else 0.0,
+        # corroborating A/B pair (noise-dominated on a loaded CPU box —
+        # the modeled number above is the acceptance figure)
+        "e2e_p50_ms_trace_on": e2e_on,
+        "e2e_p50_ms_trace_off": e2e_off,
+        "ab_delta_pct": round(100.0 * (e2e_on - e2e_off) / e2e_off, 2)
+        if e2e_off > 0 else 0.0,
+        # zero NEW steady retraces with tracing on (the inertness half)
+        "retraces_steady_trace_on": on.get("retraces_steady"),
+        "retraces_attributed": trace.get("retraces_attributed"),
+    }
+
+
+def lock_profile_bench(conf, n_tasks=2_000, n_nodes=200, cycles=8,
+                       feeders=2):
+    """Lock-hold / acquire-wait profile over the pipelined cycle under
+    concurrent staged ingest — the measurement the ROADMAP's 'striped
+    per-kind ingest locks (profile first)' item asks for.  lockdep's
+    TrackedLock accumulates per-lock-class wait/hold (per-thread, merged
+    at report time); feeder threads stage gang arrivals through the real
+    ingest surface while the pipelined loop cycles, so the profile shows
+    whether the single staging buffer (or the cache big lock) actually
+    contends before anyone pays for striping."""
+    import threading
+
+    from kube_batch_tpu.analysis import lockdep
+    from kube_batch_tpu.api.pod import GROUP_NAME_ANNOTATION, Pod, PodGroup
+    from kube_batch_tpu.api.types import PodPhase
+    from kube_batch_tpu.scheduler import Scheduler
+
+    was_installed = lockdep.current_state() is not None
+    state = lockdep.install()
+    try:
+        # the cache is built AFTER install so its locks are tracked
+        cache = synthetic_cluster(
+            n_tasks=n_tasks, n_nodes=n_nodes, gang_size=4, n_queues=2
+        )
+        cache.columns.reserve(
+            n_tasks=n_tasks + 4 * feeders * cycles * 4,
+            n_jobs=n_tasks // 4 + feeders * cycles * 4 + 8,
+        )
+        sched = Scheduler(cache, conf=conf)
+        sched.run_once()  # warm the compiles outside the profiled window
+        cache.enable_ingest_staging()
+        stop_evt = threading.Event()
+
+        def feeder(fid: int):
+            i = 0
+            while not stop_evt.is_set():
+                name = f"lf{fid}-{i}"
+                cache.add_pod_group(PodGroup(
+                    name=name, namespace="lp", min_member=1, queue="q0",
+                    creation_index=9_000_000 + fid * 100_000 + i,
+                ))
+                cache.add_pod(Pod(
+                    name=f"{name}-0", namespace="lp",
+                    requests={"cpu": 100.0, "memory": float(2 ** 28)},
+                    annotations={GROUP_NAME_ANNOTATION: name},
+                    phase=PodPhase.PENDING,
+                    creation_index=90_000_000 + fid * 100_000 + i,
+                ))
+                i += 1
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=feeder, args=(f,), daemon=True)
+                   for f in range(feeders)]
+        for t in threads:
+            t.start()
+        for _ in range(cycles):
+            sched.run_once_pipelined()
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=10)
+        sched.drain_pipeline()
+        cache.disable_ingest_staging()
+        if sched._wb_pool is not None:
+            sched._wb_pool.shutdown(wait=True)
+            sched._wb_pool = None
+        cache.stop()
+        prof = state.profile_report()
+    finally:
+        if not was_installed:
+            lockdep.uninstall()
+    # rank by total acquire-wait: the contention signal striping would fix
+    top = dict(list(prof.items())[:10])
+    cache_sites = {
+        site: rec for site, rec in prof.items()
+        if "cache.cache" in site
+    }
+    total_wait = sum(r["wait_ms_total"] for r in prof.values())
+    ingest_wait = sum(r["wait_ms_total"] for r in cache_sites.values())
+    return {
+        "pods": n_tasks, "nodes": n_nodes, "cycles": cycles,
+        "feeder_threads": feeders,
+        "total_wait_ms": round(total_wait, 3),
+        "cache_lock_wait_ms": round(ingest_wait, 3),
+        "top_sites_by_wait": top,
     }
 
 
@@ -950,6 +1144,19 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001
             result["topk_compare_error"] = f"{type(e).__name__}: {e}"
+        # span-recorder overhead (<2% of steady p50, zero new retraces) +
+        # the lockdep contention profile — modeled-cost methodology, valid
+        # on any backend (ISSUE 13 acceptance)
+        try:
+            result["trace_overhead"] = trace_overhead_bench(
+                conf, cycles=4
+            )
+        except Exception as e:  # noqa: BLE001
+            result["trace_overhead_error"] = f"{type(e).__name__}: {e}"
+        try:
+            result["lock_profile"] = lock_profile_bench(conf, cycles=6)
+        except Exception as e:  # noqa: BLE001
+            result["lock_profile_error"] = f"{type(e).__name__}: {e}"
         # sharded steady-state evidence on a forced 4-device host mesh — a
         # child process, because the device count must be fixed before the
         # child's jax initializes (this process is already single-device)
@@ -1048,6 +1255,16 @@ def main() -> None:
     if section("guard_overhead", margin_s=150):
         with guarded("guard_overhead"):
             result["guard_overhead"] = guard_overhead_bench(conf)
+
+    # ---- cycle tracing plane (ISSUE 13): the span recorder's cost vs the
+    # steady p50 must stay under 2% with zero new steady retraces, and the
+    # lockdep contention profile answers the striped-ingest-lock question
+    if section("trace_overhead", margin_s=200):
+        with guarded("trace_overhead"):
+            result["trace_overhead"] = trace_overhead_bench(conf)
+    if section("lock_profile", margin_s=60):
+        with guarded("lock_profile"):
+            result["lock_profile"] = lock_profile_bench(conf)
 
     # ---- the SHARDED steady-state regime: same persistent-cache churn
     # cycle over the device mesh — the per-shard scatter-delta residency's

@@ -397,6 +397,9 @@ class AllocateAction(Action):
             )
             return
 
+        from kube_batch_tpu.obs.trace import tracer_of
+
+        tracer = tracer_of(ssn.cache)
         t0 = telemetry.perf_counter()
         if cols is not None and not cols.has_schedulable_pending():
             # steady-state idle cycle: nothing schedulable anywhere — skip
@@ -415,7 +418,8 @@ class AllocateAction(Action):
                 ssn, build=lambda: build_session_snapshot(ssn)
             )
             return
-        snap, meta = build_session_snapshot(ssn)
+        with tracer.span("snapshot_build"):
+            snap, meta = build_session_snapshot(ssn)
         t1 = telemetry.perf_counter()
         # multi-chip parts shard the node axis over the ICI mesh — the
         # production analog of the reference's always-on 16-worker fan-out
@@ -424,9 +428,19 @@ class AllocateAction(Action):
 
         gp = guard_of(ssn.cache)
         config = session_allocate_config(ssn)
-        result, self.last_solve_mode, topk_info, ginfo = (
-            dispatch_allocate_solve(snap, config, cols=cols, guard=gp)
-        )
+        # device-attributed span: a retrace or an unexpected full resident
+        # upload is annotated onto THIS dispatch, not smeared into a p50
+        with tracer.device_span("solve_dispatch", cols=cols) as sp_solve:
+            result, self.last_solve_mode, topk_info, ginfo = (
+                dispatch_allocate_solve(snap, config, cols=cols, guard=gp)
+            )
+        sp_solve.set(mode=self.last_solve_mode,
+                     engaged=list(ginfo["engaged"]))
+        if self.last_solve_mode == "sharded":
+            tracer.annotate_collectives(
+                sp_solve, ginfo["config"], snap,
+                pend_rows=ginfo.get("pend_rows"),
+            )
         # shadow-oracle audit (guard tier 2): every KB_AUDIT_EVERY-th
         # dispatch re-runs the committed solve through its oracle path,
         # DISPATCHED here so the oracle re-solve overlaps the readback +
@@ -434,9 +448,10 @@ class AllocateAction(Action):
         # replay — audit cycles pay device time, never critical-path time
         audit_dev = None
         if ginfo["engaged"] and gp.audit_due("allocate"):
-            audit_dev = dispatch_allocate_oracle(
-                snap, config, cols, self.last_solve_mode
-            )
+            with tracer.device_span("audit_dispatch"):
+                audit_dev = dispatch_allocate_oracle(
+                    snap, config, cols, self.last_solve_mode
+                )
         # the lease shares this dispatch's resident swap (memoized on the
         # same snap object), so publication is bookkeeping-only
         republish_query_lease(ssn, snap, meta)
@@ -445,14 +460,16 @@ class AllocateAction(Action):
         # transfer for everything the host replay reads — the sentinel
         # verdict + violation histogram ride it (the AllocateResult-
         # counters idiom), so the guard adds zero extra transfers
-        (assigned, pipelined, rounds_run, topk_exh, topk_reent,
-         verdict, vhist, echeck) = jax.device_get(  # kbt: allow[KBT010] ^
-            (result.assigned, result.pipelined, result.rounds_run,
-             result.topk_exhausted, result.topk_reentries,
-             sentinel[0] if sentinel is not None else np.int32(0),
-             sentinel[1] if sentinel is not None else None,
-             sentinel[2] if sentinel is not None else np.int32(0))
-        )
+        with tracer.device_span("device_wait") as sp_wait:
+            (assigned, pipelined, rounds_run, topk_exh, topk_reent,
+             verdict, vhist, echeck) = jax.device_get(  # kbt: allow[KBT010] ^
+                (result.assigned, result.pipelined, result.rounds_run,
+                 result.topk_exhausted, result.topk_reentries,
+                 sentinel[0] if sentinel is not None else np.int32(0),
+                 sentinel[1] if sentinel is not None else None,
+                 sentinel[2] if sentinel is not None else np.int32(0))
+            )
+        sp_wait.set(rounds=int(rounds_run))
         # convergence diagnostic (round-cap tuning); NOT in last_phase_ms —
         # that dict is ms-typed for the bench phases map
         self.last_solve_rounds = int(rounds_run)
@@ -505,35 +522,40 @@ class AllocateAction(Action):
         t_fit0 = telemetry.perf_counter()
         fail_hist_dev = None
         if bool(np.any(pending & (assigned < 0))):
-            if self.last_solve_mode == "sharded":
-                from kube_batch_tpu.parallel.mesh import (
-                    default_mesh as _dm, sharded_failure_histogram,
-                )
+            with tracer.device_span("fit_histogram_dispatch"):
+                if self.last_solve_mode == "sharded":
+                    from kube_batch_tpu.parallel.mesh import (
+                        default_mesh as _dm, sharded_failure_histogram,
+                    )
 
-                mesh = _dm()
-                fail_hist_dev = sharded_failure_histogram(
-                    resident_snap(cols, snap, mesh), mesh
-                )
-            else:
-                from kube_batch_tpu.ops.assignment import failure_histogram_solve
+                    mesh = _dm()
+                    fail_hist_dev = sharded_failure_histogram(
+                        resident_snap(cols, snap, mesh), mesh
+                    )
+                else:
+                    from kube_batch_tpu.ops.assignment import (
+                        failure_histogram_solve,
+                    )
 
-                fail_hist_dev = failure_histogram_solve(
-                    resident_snap(cols, snap)
-                )
+                    fail_hist_dev = failure_histogram_solve(
+                        resident_snap(cols, snap)
+                    )
         t_fit1 = telemetry.perf_counter()
-        self._replay(ssn, snap, meta, assigned, pipelined, task_job)
+        with tracer.span("host_replay"):
+            self._replay(ssn, snap, meta, assigned, pipelined, task_job)
         t3 = telemetry.perf_counter()
         if fail_hist_dev is not None:
             # blocks only on whatever the device hasn't finished during the
             # replay; fit-error recording touches job diagnostic dicts the
             # replay never reads, so the reordering is invisible to it
-            self._record_fit_errors(
-                # kbt: allow[KBT010] sanctioned post-replay readback: the
-                # histogram was dispatched before the replay precisely so
-                # this read overlaps host work instead of stalling
-                ssn, meta, np.asarray(fail_hist_dev), assigned, task_job,
-                pending,
-            )
+            with tracer.device_span("fit_errors"):
+                self._record_fit_errors(
+                    # kbt: allow[KBT010] sanctioned post-replay readback: the
+                    # histogram was dispatched before the replay precisely so
+                    # this read overlaps host work instead of stalling
+                    ssn, meta, np.asarray(fail_hist_dev), assigned, task_job,
+                    pending,
+                )
         t4 = telemetry.perf_counter()
         # update, not replace: _replay already folded its replay_* sub-phases in
         self.last_phase_ms.update(

@@ -127,12 +127,16 @@ class BackfillAction(Action):
             job_schedulable=snap.job_schedulable & jnp.asarray(safe_np)
         )
         from kube_batch_tpu.guard import guard_of
+        from kube_batch_tpu.obs.trace import tracer_of
 
         gp = guard_of(ssn.cache)
+        tracer = tracer_of(ssn.cache)
         config = session_allocate_config(ssn)
-        result, _mode, _topk, ginfo = dispatch_allocate_solve(
-            snap, config, cols=cols, guard=gp
-        )
+        with tracer.device_span("solve_dispatch", cols=cols,
+                                action="backfill"):
+            result, _mode, _topk, ginfo = dispatch_allocate_solve(
+                snap, config, cols=cols, guard=gp
+            )
         # this swap retired the what-if lease on donating backends — re-arm
         # it off the same (memoized) resident snapshot.  The gang-safe
         # job_schedulable mask above is probe-invisible: a probe's task
@@ -143,14 +147,15 @@ class BackfillAction(Action):
 
         republish_query_lease(ssn, snap, meta)
         sentinel = ginfo["sentinel"]
-        # kbt: allow[KBT010] the backfill pass's one sanctioned readback —
-        # the guard sentinel's verdict + histogram ride it
-        assigned, pipelined, verdict, vhist, echeck = jax.device_get(
-            (result.assigned, result.pipelined,
-             sentinel[0] if sentinel is not None else np.int32(0),
-             sentinel[1] if sentinel is not None else None,
-             sentinel[2] if sentinel is not None else np.int32(0))
-        )
+        with tracer.device_span("device_wait", action="backfill"):
+            # kbt: allow[KBT010] the backfill pass's one sanctioned
+            # readback — the guard sentinel's verdict + histogram ride it
+            assigned, pipelined, verdict, vhist, echeck = jax.device_get(
+                (result.assigned, result.pipelined,
+                 sentinel[0] if sentinel is not None else np.int32(0),
+                 sentinel[1] if sentinel is not None else None,
+                 sentinel[2] if sentinel is not None else np.int32(0))
+            )
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
         if sentinel is not None:

@@ -81,10 +81,22 @@ class EnqueueAction(Action):
             cols.j_phase[row] = PHASE_CODE[PodGroupPhase.INQUEUE]
             cols.j_touched[row] = True
 
+    def _promote_rows(self, ssn, cols, rows) -> None:
+        job_by_row = cols.job_by_row
+        for r in rows:
+            self._promote(cols, job_by_row[r])
+
     # ------------------------------------------------------------------
     def _execute_columnar(self, ssn, cols) -> bool:
         """The column-gate path; returns False when an exactness guard
-        trips (the caller then runs the object walk)."""
+        trips (the caller then runs the object walk).
+
+        Promotions are DEFERRED to the end: nothing mutates until the
+        admitted set is final, so (a) every fallback return leaves the
+        object walk a pristine re-decide, and (b) the sampled shadow audit
+        can run the walk ORACLE over the same unmutated state and compare
+        decision sets — the guard-plane coverage for this gate that the
+        solve paths already have via their shadow oracles."""
         import jax
 
         spec = ssn.spec
@@ -101,11 +113,12 @@ class EnqueueAction(Action):
             return True
         job_by_row = cols.job_by_row
         # unconditional promotions (enqueue.go:102-105): admission order is
-        # unobservable for jobs that consume no budget
-        for r in np.flatnonzero(cand & ~cols.j_has_minres).tolist():
-            self._promote(cols, job_by_row[r])
+        # unobservable for jobs that consume no budget — decided here,
+        # APPLIED at the end with the admitted rows
+        uncond_rows = np.flatnonzero(cand & ~cols.j_has_minres).tolist()
         minres_rows = np.flatnonzero(cand & cols.j_has_minres)
         if minres_rows.size == 0:
+            self._promote_rows(ssn, cols, uncond_rows)
             return True
 
         # idle = Σ allocatable × 1.2 − used (enqueue.go:74-81) over the
@@ -225,58 +238,94 @@ class EnqueueAction(Action):
         candv = np.zeros(capJ, bool)
         candv[:k] = enq_ok[order]
         from kube_batch_tpu.guard import guard_of
+        from kube_batch_tpu.obs.trace import tracer_of
         from kube_batch_tpu.parallel.mesh import (
             shard_map_enabled,
             should_shard,
         )
 
         gp = guard_of(ssn.cache)
+        tracer = tracer_of(ssn.cache)
         idle_v = idle.vec.astype(np.float32)
         quanta_v = spec.quanta.astype(np.float32)
         use_mesh = should_shard(cols.nodes.cap) and shard_map_enabled()
-        if gp.enabled and not use_mesh:
-            # the FUSED gate sentinel (ops/invariants): admitted ⊆
-            # candidates + the all-finite budget sweep run in the same
-            # compiled program as the admission scan, verdict riding the
-            # one readback — the single-device twin of the solve sentinels
-            from kube_batch_tpu.ops.invariants import (
-                enqueue_gate_sentinel_solve,
-            )
+        with tracer.device_span("gate_dispatch", cols=cols) as sp_gate:
+            if gp.enabled and not use_mesh:
+                # the FUSED gate sentinel (ops/invariants): admitted ⊆
+                # candidates + the all-finite budget sweep run in the same
+                # compiled program as the admission scan, verdict riding the
+                # one readback — the single-device twin of the solve
+                # sentinels
+                from kube_batch_tpu.ops.invariants import (
+                    enqueue_gate_sentinel_solve,
+                )
 
-            admitted_dev, v_dev, _hist = enqueue_gate_sentinel_solve(
-                minr, candv, idle_v, quanta_v
-            )
-            # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback:
-            # the admitted-rows mask + the fused sentinel verdict
-            admitted, verdict = jax.device_get((admitted_dev, v_dev))
-            admitted = np.asarray(admitted)[:k]
-            bad = int(verdict)
-        else:
-            admitted_dev = dispatch_enqueue_gate(
-                minr, candv, idle_v, quanta_v,
-                n_nodes_padded=cols.nodes.cap,
-            )
-            # kbt: allow[KBT010] the enqueue gate's ONE sanctioned readback:
-            # the admitted-rows mask the promotions below consume
-            admitted = np.asarray(jax.device_get(admitted_dev))[:k]
-            bad = 0
-            if gp.enabled:
-                # mesh path (the replicated shard_map gate has no fused
-                # variant): the invariant is host-checkable from the
-                # dispatch's own host-built inputs
-                bad = int(np.sum(admitted & ~candv[:k]))
-                if (not np.isfinite(minr).all()
-                        or not np.isfinite(idle_v).all()
-                        or not np.isfinite(quanta_v).all()):
-                    bad += 1
-        # a violation fails CLOSED: no promotions from a condemned verdict
-        # (the Pending walk re-decides next cycle)
+                admitted_dev, v_dev, _hist = enqueue_gate_sentinel_solve(
+                    minr, candv, idle_v, quanta_v
+                )
+                # kbt: allow[KBT010] the enqueue gate's ONE sanctioned
+                # readback: the admitted-rows mask + the fused verdict
+                admitted, verdict = jax.device_get((admitted_dev, v_dev))
+                admitted = np.asarray(admitted)[:k]
+                bad = int(verdict)
+            else:
+                admitted_dev = dispatch_enqueue_gate(
+                    minr, candv, idle_v, quanta_v,
+                    n_nodes_padded=cols.nodes.cap,
+                )
+                # kbt: allow[KBT010] the enqueue gate's ONE sanctioned
+                # readback: the admitted-rows mask the promotions consume
+                admitted = np.asarray(jax.device_get(admitted_dev))[:k]
+                bad = 0
+                if gp.enabled:
+                    # mesh path (the replicated shard_map gate has no fused
+                    # variant): the invariant is host-checkable from the
+                    # dispatch's own host-built inputs
+                    bad = int(np.sum(admitted & ~candv[:k]))
+                    if (not np.isfinite(minr).all()
+                            or not np.isfinite(idle_v).all()
+                            or not np.isfinite(quanta_v).all()):
+                        bad += 1
+        sp_gate.set(candidates=int(k))
+        # a violation fails CLOSED: no scan-derived promotions from a
+        # condemned verdict (the Pending walk re-decides next cycle); the
+        # unconditional promotions never consumed the condemned scan
         if gp.enabled and not gp.consume_verdict(
             "enqueue", [], bad, detail=f"enqueue gate verdict={bad}",
         ):
+            self._promote_rows(ssn, cols, uncond_rows)
             return True
-        for r in ordered[admitted].tolist():
-            self._promote(cols, job_by_row[r])
+        admitted_rows = ordered[admitted].tolist()
+        # sampled shadow audit (guard tier 2, the object-walk coverage the
+        # ROADMAP standing item asks for): every KB_AUDIT_EVERY-th gate
+        # dispatch re-derives the admission through the reference walk —
+        # the same oracle the gate-equivalence tests pin — over the still
+        # UNMUTATED session, and compares decision SETS.  On mismatch the
+        # guard trips (unattributable → conservative demotion + resident
+        # heal) and the WALK's decisions are applied: the oracle is
+        # authoritative, exactly like a demoted solve path running pjit.
+        if gp.enabled and gp.audit_due("enqueue"):
+            from kube_batch_tpu.guard import make_heal
+
+            walk_jobs = self._walk_decisions(ssn)
+            expected = {job.uid for job in walk_jobs}
+            actual = {job_by_row[r].uid
+                      for r in uncond_rows + admitted_rows}
+            matched = expected == actual
+            gp.note_audit(
+                "enqueue", [], matched,
+                detail=(
+                    "enqueue gate vs object-walk divergence: "
+                    f"gate-only={sorted(actual - expected)[:8]} "
+                    f"walk-only={sorted(expected - actual)[:8]}"
+                ) if not matched else "",
+                heal=make_heal(ssn),
+            )
+            if not matched:
+                for job in walk_jobs:
+                    self._promote(cols, job)
+                return True
+        self._promote_rows(ssn, cols, uncond_rows + admitted_rows)
         return True
 
     # ------------------------------------------------------------------
@@ -284,6 +333,16 @@ class EnqueueAction(Action):
         """The reference walk (enqueue.go:74-117) — the always-correct
         fallback for non-columnar sessions and exotic plugin sets, and the
         oracle the gate-equivalence tests compare against."""
+        for job in self._walk_decisions(ssn):
+            self._promote(cols, job)
+
+    def _walk_decisions(self, ssn) -> list:
+        """The reference walk's admission DECISIONS, with no mutation:
+        the promotion list in walk order.  Shared by the walk execution
+        path and the columnar gate's sampled shadow audit (which must run
+        the oracle over the still-unmutated session and diff decision
+        sets)."""
+        decisions = []
         queues = PriorityQueue(less=ssn.queue_order_fn)
         queue_set = set()
         jobs_map = {}
@@ -299,7 +358,7 @@ class EnqueueAction(Action):
                 # they skip the priority-queue machinery entirely — at 12.5k
                 # Pending podgroups the tiered order comparisons alone were
                 # ~0.8s of host time
-                self._promote(cols, job)
+                decisions.append(job)
                 continue
             any_min_res = True
             queue = ssn.queues[job.queue]
@@ -309,7 +368,7 @@ class EnqueueAction(Action):
             jobs_map.setdefault(queue.name, PriorityQueue(less=ssn.job_order_fn)).push(job)
 
         if not any_min_res:
-            return
+            return decisions
 
         # idle = total × 1.2 − used (enqueue.go:74-81)
         total = ssn.spec.empty()
@@ -334,6 +393,7 @@ class EnqueueAction(Action):
                 if name in ssn.spec:
                     min_req.vec[ssn.spec.index(name)] = float(v)
             if ssn.job_enqueueable(job) and min_req.less_equal(idle):
-                self._promote(cols, job)
+                decisions.append(job)
                 idle.sub_(min_req)
             queues.push(queue)
+        return decisions

@@ -116,6 +116,7 @@ def solve_claims(ssn, mode: str):
     )
     from kube_batch_tpu.api.columns import resident_snap
     from kube_batch_tpu.guard import guard_of
+    from kube_batch_tpu.obs.trace import tracer_of
     from kube_batch_tpu.parallel.mesh import (
         default_mesh,
         sentinel_sharded_evict_solve,
@@ -124,42 +125,46 @@ def solve_claims(ssn, mode: str):
     )
 
     gp = guard_of(ssn.cache)
+    tracer = tracer_of(ssn.cache)
     sentinel = None
     audit_dev = None
     engaged: List[str] = []
     mesh = None
     # device-resident feature cache (see allocate's dispatch): the decode
     # below keeps reading the ORIGINAL host-backed snap
-    if should_shard(snap.node_alloc.shape[0]):
-        mesh = default_mesh()
-        from kube_batch_tpu.parallel.mesh import _impl as _resolve_impl
+    with tracer.device_span("solve_dispatch", cols=cols, action=mode) as sp:
+        if should_shard(snap.node_alloc.shape[0]):
+            mesh = default_mesh()
+            from kube_batch_tpu.parallel.mesh import _impl as _resolve_impl
 
-        # demotion-aware path selection: a tripped shard_map path runs the
-        # pjit oracle until its half-open probe re-promotes it
-        impl = None if gp.allow("shard_map") else "pjit"
-        if _resolve_impl(impl) == "shard_map":
-            engaged = ["shard_map"]
-        dev = resident_snap(cols, snap, mesh)
-        if gp.enabled:
-            result, v_dev, h_dev, e_dev = sentinel_sharded_evict_solve(
-                dev, config, mesh, impl=impl
-            )
-            sentinel = (v_dev, h_dev, e_dev)
+            # demotion-aware path selection: a tripped shard_map path runs
+            # the pjit oracle until its half-open probe re-promotes it
+            impl = None if gp.allow("shard_map") else "pjit"
+            if _resolve_impl(impl) == "shard_map":
+                engaged = ["shard_map"]
+            dev = resident_snap(cols, snap, mesh)
+            if gp.enabled:
+                result, v_dev, h_dev, e_dev = sentinel_sharded_evict_solve(
+                    dev, config, mesh, impl=impl
+                )
+                sentinel = (v_dev, h_dev, e_dev)
+            else:
+                result = sharded_evict_solve(dev, config, mesh, impl=impl)
+            if engaged and gp.audit_due(mode):
+                # shadow oracle (tier 2): the pjit program on the same
+                # snapshot, read back only after the host decode below
+                audit_dev = sharded_evict_solve(dev, config, mesh,
+                                                impl="pjit")
         else:
-            result = sharded_evict_solve(dev, config, mesh, impl=impl)
-        if engaged and gp.audit_due(mode):
-            # shadow oracle (tier 2): the pjit program on the same
-            # snapshot, read back only after the host decode below
-            audit_dev = sharded_evict_solve(dev, config, mesh, impl="pjit")
-    else:
-        dev = resident_snap(cols, snap)
-        if gp.enabled:
-            from kube_batch_tpu.ops.invariants import evict_sentinel_solve
+            dev = resident_snap(cols, snap)
+            if gp.enabled:
+                from kube_batch_tpu.ops.invariants import evict_sentinel_solve
 
-            result, v_dev, h_dev, e_dev = evict_sentinel_solve(dev, config)
-            sentinel = (v_dev, h_dev, e_dev)
-        else:
-            result = evict_solve(dev, config)
+                result, v_dev, h_dev, e_dev = evict_sentinel_solve(dev, config)
+                sentinel = (v_dev, h_dev, e_dev)
+            else:
+                result = evict_solve(dev, config)
+    sp.set(engaged=list(engaged))
     # this swap retired the what-if lease on donating backends — re-arm it
     # off the same (memoized) resident snapshot so serving doesn't stay
     # dark until the next cycle's allocate
@@ -170,14 +175,15 @@ def solve_claims(ssn, mode: str):
     # (three per-field np.asarray reads were three blocking transfers;
     # flagged by KBT010's first dogfood run); the guard sentinel's verdict
     # + histogram ride it
-    claim_node, evicted, victim_claimant, verdict, vhist, echeck = (
-        jax.device_get(  # kbt: allow[KBT010] the annotated choke point above
-            (result.claim_node, result.evicted, result.victim_claimant,
-             sentinel[0] if sentinel is not None else np.int32(0),
-             sentinel[1] if sentinel is not None else None,
-             sentinel[2] if sentinel is not None else np.int32(0))
+    with tracer.device_span("device_wait", action=mode):
+        claim_node, evicted, victim_claimant, verdict, vhist, echeck = (
+            jax.device_get(  # kbt: allow[KBT010] the annotated choke point ^
+                (result.claim_node, result.evicted, result.victim_claimant,
+                 sentinel[0] if sentinel is not None else np.int32(0),
+                 sentinel[1] if sentinel is not None else None,
+                 sentinel[2] if sentinel is not None else np.int32(0))
+            )
         )
-    )
     claim_node = claim_node[: meta.n_tasks]
     evicted = evicted[: meta.n_tasks]
     victim_claimant = victim_claimant[: meta.n_tasks]

@@ -19,6 +19,16 @@ the lock-acquisition-order graph:
 - **Blocking under lock**: `time.sleep` / `Future.result` / `Event.wait`
   reached while the thread holds any tracked lock (the TokenBucket bug, as
   a runtime check).
+- **Lock-hold / contention profile**: every tracked acquire records its
+  acquire-WAIT (time blocked entering the lock) and, on release, its
+  HOLD time, accumulated per lock class (creation site).  This is the
+  profile the ROADMAP's "striped per-kind ingest locks (profile first)"
+  item asks for: ``profile_report()`` ranks sites by total wait, so the
+  bench's ``lock_profile`` section (and any lockdep-instrumented test
+  run) can say whether the single staging buffer actually contends
+  before anyone pays for striping.  Accumulation is PER-THREAD (merged
+  at report time), so profiling adds no cross-thread synchronization to
+  the very contention it measures.
 
 `install()` patches `threading.Lock`/`RLock` with factories that return
 instrumented locks ONLY when the creating frame belongs to one of the
@@ -59,6 +69,12 @@ DEFAULT_MODULE_PREFIXES = (
     # dirty-advance hook notifies UNDER the cache's big lock, so the
     # big→trigger edge — and any future reverse nesting — must be observed
     "kube_batch_tpu.scheduler",
+    # the observability plane (tracer/recorder/alerts leaf locks) and the
+    # guard plane: spans close from the cycle AND writeback threads, and
+    # alert evaluation reads the guard's lock — their edges belong in the
+    # graph
+    "kube_batch_tpu.obs",
+    "kube_batch_tpu.guard",
 )
 
 _REAL_LOCK = threading.Lock
@@ -66,6 +82,9 @@ _REAL_RLOCK = threading.RLock
 _REAL_SLEEP = time.sleep
 _REAL_FUTURE_RESULT = concurrent.futures.Future.result
 _REAL_EVENT_WAIT = threading.Event.wait
+#: wall clock for the contention profile — captured at import so the
+#: profile is immune to any clock patching (lockdep itself patches sleep)
+_REAL_PERF = time.perf_counter
 
 # re-exported for detector-side callers; runtime code imports it from
 # utils/blocking.py directly so annotating a region never pulls the lint
@@ -105,6 +124,11 @@ class LockdepState:
         # report per site, not one per occurrence)
         self._nested_sites: set = set()
         self._local = threading.local()
+        # per-thread contention/hold accumulators (merged by
+        # profile_report); entries: site → [n, wait_s, wait_max, hold_s,
+        # hold_max] — per-thread so profiling never serializes the very
+        # contention it measures
+        self._profs: List[Dict[str, list]] = []
 
     def _path(self, src: str, dst: str) -> Optional[List[str]]:
         """A site path src → … → dst over the recorded acquisition edges
@@ -126,13 +150,34 @@ class LockdepState:
         held = getattr(self._local, "held", None)
         if held is None:
             held = self._local.held = []
-        return held  # entries: [site, lock_id, depth]
+        return held  # entries: [site, lock_id, depth, t_acquired]
+
+    def _prof(self) -> Dict[str, list]:
+        prof = getattr(self._local, "prof", None)
+        if prof is None:
+            prof = self._local.prof = {}
+            with self._mu:
+                self._profs.append(prof)
+        return prof
+
+    def _note_wait(self, site: str, wait: float) -> None:
+        prof = self._prof()
+        rec = prof.get(site)
+        if rec is None:
+            rec = prof[site] = [0, 0.0, 0.0, 0.0, 0.0]
+        rec[0] += 1
+        rec[1] += wait
+        if wait > rec[2]:
+            rec[2] = wait
+
 
     def held_sites(self) -> List[str]:
         return [e[0] for e in self._held()]
 
     # -- events ------------------------------------------------------------
-    def on_acquired(self, site: str, lock_id: int) -> None:
+    def on_acquired(self, site: str, lock_id: int,
+                    wait: float = 0.0) -> None:
+        self._note_wait(site, wait)
         held = self._held()
         for entry in held:
             if entry[1] == lock_id:
@@ -168,7 +213,7 @@ class LockdepState:
         # locked re-check below closes the race
         candidates = [
             (hsite, site)
-            for hsite, _hid, _d in held
+            for hsite, _hid, _d, _t in held
             # same-site pairs never enter the graph: a self-edge would be
             # an instant cycle, and declared nesting (allow_nesting) is an
             # instance-level claim, not a class-order edge
@@ -221,7 +266,7 @@ class LockdepState:
                     self.violations.append(
                         Violation("order-inversion", desc, detail)
                     )
-        held.append([site, lock_id, 1])
+        held.append([site, lock_id, 1, _REAL_PERF()])
 
     def on_released(self, lock_id: int) -> None:
         held = self._held()
@@ -229,6 +274,12 @@ class LockdepState:
             if held[i][1] == lock_id:
                 held[i][2] -= 1
                 if held[i][2] == 0:
+                    hold = _REAL_PERF() - held[i][3]
+                    rec = self._prof().get(held[i][0])
+                    if rec is not None:
+                        rec[3] += hold
+                        if hold > rec[4]:
+                            rec[4] = hold
                     del held[i]
                 return
 
@@ -252,6 +303,34 @@ class LockdepState:
             lines.append(v.render())
         return "\n".join(lines)
 
+    def profile_report(self) -> Dict[str, Dict[str, float]]:
+        """Merged per-site contention/hold profile: site → {acquires,
+        wait_ms_total, wait_ms_max, hold_ms_total, hold_ms_max}, the
+        per-thread accumulators folded together."""
+        with self._mu:
+            profs = list(self._profs)
+        merged: Dict[str, list] = {}
+        for prof in profs:
+            for site, rec in list(prof.items()):
+                m = merged.setdefault(site, [0, 0.0, 0.0, 0.0, 0.0])
+                m[0] += rec[0]
+                m[1] += rec[1]
+                m[2] = max(m[2], rec[2])
+                m[3] += rec[3]
+                m[4] = max(m[4], rec[4])
+        return {
+            site: {
+                "acquires": m[0],
+                "wait_ms_total": round(m[1] * 1e3, 3),
+                "wait_ms_max": round(m[2] * 1e3, 3),
+                "hold_ms_total": round(m[3] * 1e3, 3),
+                "hold_ms_max": round(m[4] * 1e3, 3),
+            }
+            for site, m in sorted(
+                merged.items(), key=lambda kv: -kv[1][1]
+            )
+        }
+
 
 class TrackedLock:
     """A Lock/RLock wrapper feeding the lockdep state. `site` is the
@@ -263,9 +342,11 @@ class TrackedLock:
         self._lock = _REAL_RLOCK() if reentrant else _REAL_LOCK()
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = _REAL_PERF()
         ok = self._lock.acquire(blocking, timeout)
         if ok:
-            self._state.on_acquired(self.site, id(self))
+            self._state.on_acquired(self.site, id(self),
+                                    wait=_REAL_PERF() - t0)
         return ok
 
     def release(self) -> None:

@@ -625,6 +625,92 @@ class SentinelConsumeRule(Rule):
                        "returns the un-consumed sentinel to its caller")
 
 
+# --------------------------------------------------------------------------
+# KBT014 — span discipline: spans via obs.trace only, no clock reads in
+# span bodies
+# --------------------------------------------------------------------------
+
+
+class SpanDisciplineRule(Rule):
+    """Guard for the cycle tracing plane (kube_batch_tpu/obs): spans in the
+    clock-seamed paths are created ONLY through the ``obs.trace`` context
+    managers (``tracer.span`` / ``device_span`` / ``cycle_span``), and a
+    span body contains no clock reads of its own — the span IS the
+    measurement.  Two bug classes this kills: (1) a hand-rolled Span (or a
+    begin/end pair) that skips the context manager loses exception-safe
+    closing and the per-thread nesting stack, producing unbalanced trace
+    trees that the Chrome-export validation then rejects at smoke time;
+    (2) an ad-hoc ``telemetry.perf_counter`` pair (or worse, raw
+    ``time.*``) lexically inside a ``with ...span(...):`` body re-creates
+    exactly the scattered-timer drift this plane replaced — the span's own
+    stamps and the ad-hoc pair silently diverge, and the virtual-time
+    seam is bypassed.  Metrics that want a span's duration read
+    ``sp.dur_ms`` / ``sp.dur_us`` AFTER the block (the scheduler's action
+    and plugin histograms are the shipped examples)."""
+
+    id = "KBT014"
+    title = "span discipline: manual span or clock read in a span body"
+    #: the clock-seamed core PLUS every module that may adopt spans later —
+    #: obs/ itself is exempt (it IS the implementation)
+    scope = ("scheduler.py", "actions/", "cache/", "sim/", "framework/",
+             "serve/", "guard/", "plugins/")
+
+    SPAN_FACTORIES = {"span", "device_span", "cycle_span"}
+    TIME_ATTRS = WallClockRule.TIME_ATTRS
+    DATETIME_ATTRS = WallClockRule.DATETIME_ATTRS
+
+    def _is_span_with(self, node) -> bool:
+        for item in node.items:
+            ctx = item.context_expr
+            if (isinstance(ctx, ast.Call)
+                    and isinstance(ctx.func, ast.Attribute)
+                    and ctx.func.attr in self.SPAN_FACTORIES):
+                return True
+        return False
+
+    def check(self, tree: ast.Module, relpath: str):
+        imports = _ImportMap()
+        imports.visit(tree)
+        for node in ast.walk(tree):
+            # (1) manual span construction outside the context managers
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name == "Span" or name in ("begin_span", "end_span"):
+                    yield (node.lineno, node.col_offset,
+                           "manual span construction bypasses the obs.trace "
+                           "context managers (nesting stack, exception-safe "
+                           "close); use `with tracer.span(...)`")
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not self._is_span_with(node):
+                continue
+            # (2) clock reads lexically inside the span body
+            for inner in _walk_skipping_defs(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                func = inner.func
+                what = None
+                if isinstance(func, ast.Attribute):
+                    base = _leftmost_name(func)
+                    if (base in imports.time_names
+                            and func.attr in self.TIME_ATTRS):
+                        what = f"`{base}.{func.attr}()`"
+                    elif (base in imports.datetime_names
+                            and func.attr in self.DATETIME_ATTRS):
+                        what = f"`{base}.{func.attr}()`"
+                    elif base == "telemetry" and func.attr == "perf_counter":
+                        what = "`telemetry.perf_counter()`"
+                elif isinstance(func, ast.Name):
+                    if imports.from_time.get(func.id) in self.TIME_ATTRS:
+                        what = f"`{func.id}()`"
+                if what is not None:
+                    yield (inner.lineno, inner.col_offset,
+                           f"clock read {what} inside a span body — the "
+                           "span already stamps its own wall/virtual time; "
+                           "read `sp.dur_ms`/`sp.dur_us` after the block or "
+                           "open a child span")
+
+
 from kube_batch_tpu.analysis.flowrules import FLOW_RULES  # noqa: E402
 
 ALL_RULES = (
@@ -636,6 +722,7 @@ ALL_RULES = (
     RawTransportRule(),
     PipelineStageRule(),
     SentinelConsumeRule(),
+    SpanDisciplineRule(),
 ) + FLOW_RULES
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -961,9 +961,14 @@ class SchedulerCache:
         if t0 is not None:
             from kube_batch_tpu import metrics
 
-            metrics.observe_decision_latencies(
-                [(telemetry.perf_counter() - t0) * 1e3]
-            )
+            lat_ms = [(telemetry.perf_counter() - t0) * 1e3]
+            metrics.observe_decision_latencies(lat_ms)
+            tr = getattr(self, "tracer", None)
+            if tr is not None:
+                # span-stamped twin of the histogram sample (obs/trace.py):
+                # the cycle's trace tree carries the same values, and an
+                # SLO breach arms a flight-recorder dump
+                tr.note_decision_latencies(lat_ms)
         try:
             if pod is not None:
                 self.binder.bind(pod, hostname)
@@ -1017,6 +1022,10 @@ class SchedulerCache:
             from kube_batch_tpu import metrics
 
             metrics.observe_decision_latencies(lat_ms)
+            tr = getattr(self, "tracer", None)
+            if tr is not None:
+                # the trace-tree twin of the histogram samples (obs/trace)
+                tr.note_decision_latencies(lat_ms)
         self._dispatch_async(staged)
 
     def _note_bind_decisions_locked(self, staged) -> list:

@@ -20,6 +20,9 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
 - GET  /v1/bindings            — pod→node decisions made so far
 - GET  /v1/guard               — result-integrity guard plane state (per-
                                  fast-path breaker, trips, audits, bundles)
+- GET  /v1/trace               — cycle tracing plane: last cycle's span
+                                 tree + flight-recorder ring stats
+- GET  /v1/alerts              — guard trip-rate SLO alert state
 - POST /v1/whatif              — batched what-if / admission probe against
                                  the resident snapshot (serve/; README
                                  "Query plane" for the schema)
@@ -231,6 +234,18 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 from kube_batch_tpu.guard import guard_of
 
                 self._send(200, json.dumps(guard_of(cache).state()))
+            elif self.path == "/v1/trace":
+                # cycle tracing plane: the last completed cycle's span tree
+                # + the flight-recorder ring stats (obs/trace, obs/recorder)
+                from kube_batch_tpu.obs.trace import tracer_of
+
+                self._send(200, json.dumps(tracer_of(cache).state()))
+            elif self.path == "/v1/alerts":
+                # guard trip-rate SLO alerts (obs/alerts): firing state,
+                # windowed trip counts, thresholds
+                from kube_batch_tpu.obs.alerts import alerts_of
+
+                self._send(200, json.dumps(alerts_of(cache).state()))
             else:
                 self._send(404, json.dumps({"error": "not found"}))
 

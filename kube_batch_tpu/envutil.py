@@ -13,9 +13,35 @@ env hardening takes effect.
 
 from __future__ import annotations
 
+import logging
 import os
 
 _DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
+
+_logger = logging.getLogger("kube_batch_tpu")
+
+
+def env_int(name: str, default: int) -> int:
+    """Parse an integer knob; an unparsable value logs and keeps the
+    default (the ONE shared implementation — guard/plane, serve/batcher,
+    and the obs/ modules all read knobs this way)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        _logger.warning("unparsable %s=%r; using %d", name, raw, default)
+        return default
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Parse a boolean knob: unset → default; anything but
+    0/false/off/no → True."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "false", "off", "no")
 
 
 def hardened_cpu_env(n_devices: int | None = None, base: dict | None = None) -> dict:

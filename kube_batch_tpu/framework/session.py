@@ -30,7 +30,6 @@ from kube_batch_tpu.api.types import (
 )
 from kube_batch_tpu.framework.conf import Tier
 from kube_batch_tpu import metrics
-from kube_batch_tpu.utils import telemetry
 
 # fn-kind names used in the per-plugin registries
 JOB_ORDER, QUEUE_ORDER, TASK_ORDER = "job_order", "queue_order", "task_order"
@@ -739,15 +738,19 @@ def open_session(cache, tiers: List[Tier], plugin_options=None,
             else:
                 cols.sync_session_rows(ssn)
             ssn.rows_synced = True
+        from kube_batch_tpu.obs.trace import tracer_of
+
+        tracer = tracer_of(cache)
         for tier in tiers:
             for opt in tier.plugins:
                 plugin = get_plugin_builder(opt.name)(opt.arguments)
                 ssn.plugins.append(plugin)
-                t0 = telemetry.perf_counter()
-                plugin.on_session_open(ssn)
+                # the span IS the measurement (rule KBT014): the plugin
+                # latency histogram feeds from its stamps
+                with tracer.span("plugin:" + opt.name + ".open") as sp:
+                    plugin.on_session_open(ssn)
                 metrics.observe_plugin_latency(
-                    opt.name, "OnSessionOpen",
-                    (telemetry.perf_counter() - t0) * 1e6,
+                    opt.name, "OnSessionOpen", sp.dur_us
                 )
         # gang-validity gate after plugins registered their JobValid fns.
         # Columnar sessions prefilter with one counts-matrix expression when
@@ -1056,14 +1059,16 @@ def close_session(ssn: Session, stage_flush: bool = False):
     that same stage (``_inflight_bind_hosts`` protects deferred ingest
     against the unacked window).  Serial callers get ``None`` and identical
     behavior to before the split — stage + run back-to-back."""
+    from kube_batch_tpu.obs.trace import tracer_of
+
+    tracer = tracer_of(ssn.cache)
     flush = None
     try:
         for plugin in ssn.plugins:
-            t0 = telemetry.perf_counter()
-            plugin.on_session_close(ssn)
+            with tracer.span("plugin:" + plugin.name + ".close") as sp:
+                plugin.on_session_close(ssn)
             metrics.observe_plugin_latency(
-                plugin.name, "OnSessionClose",
-                (telemetry.perf_counter() - t0) * 1e6,
+                plugin.name, "OnSessionClose", sp.dur_us
             )
         if ssn.columns is not None and ssn.rows_synced and ssn.jobs:
             updates, qcounts = _close_status_columnar(ssn)

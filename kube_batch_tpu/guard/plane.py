@@ -42,6 +42,7 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from kube_batch_tpu import metrics
+from kube_batch_tpu.envutil import env_int
 
 logger = logging.getLogger("kube_batch_tpu")
 
@@ -51,17 +52,6 @@ logger = logging.getLogger("kube_batch_tpu")
 FAST_PATHS = ("topk", "shard_map", "pallas")
 
 HEALTHY, DEMOTED, PROBING = "healthy", "demoted", "probing"
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        logger.warning("unparsable %s=%r; using %d", name, raw, default)
-        return default
 
 
 class PathHealth:
@@ -95,11 +85,11 @@ class GuardPlane:
         self.enabled = enabled
         self.audit_every = (
             audit_every if audit_every is not None
-            else _env_int("KB_AUDIT_EVERY", 64)
+            else env_int("KB_AUDIT_EVERY", 64)
         )
         self.cooldown = (
             cooldown if cooldown is not None
-            else max(1, _env_int("KB_GUARD_COOLDOWN", 8))
+            else max(1, env_int("KB_GUARD_COOLDOWN", 8))
         )
         self.bundle_dir = bundle_dir  # None → guard/bundle.py's env default
         self._lock = threading.Lock()
@@ -239,7 +229,18 @@ class GuardPlane:
             action, reason, detail, targets or "no fast path",
         )
         # outside the lock: the heal touches the column store, the dump
-        # serializes the snapshot and writes files
+        # serializes the snapshot and writes files.  A trip is also the
+        # flight recorder's primary trigger — the cycle trace trees around
+        # the condemned solve dump beside the guard bundle (obs/recorder).
+        flight = getattr(getattr(self, "host_cache", None),
+                         "flight_recorder", None)
+        if flight is not None:
+            try:
+                flight.trigger(
+                    "guard_trip", detail=f"{action}/{reason}: {detail}"
+                )
+            except Exception:  # noqa: BLE001 — diagnostics only
+                logger.exception("flight-recorder trigger failed")
         if heal is not None:
             try:
                 heal()
@@ -285,6 +286,12 @@ class GuardPlane:
             self._cycle_engaged.clear()
             self._cycle_tripped.clear()
 
+    def trip_series(self, since: int):
+        """(cycle, trip_log[since:], new_len) under the plane's lock — the
+        alert evaluator's incremental read (obs/alerts.py)."""
+        with self._lock:
+            return self.cycle, list(self.trip_log[since:]), len(self.trip_log)
+
     # ------------------------------------------------------------------
     def state(self) -> Dict:
         with self._lock:
@@ -319,6 +326,9 @@ def guard_of(cache) -> GuardPlane:
             gp = getattr(cache, "guard_plane", None)
             if gp is None:
                 gp = GuardPlane.from_env()
+                # back-pointer for the flight-recorder trigger (trip());
+                # the plane's own state machine never reads through it
+                gp.host_cache = cache
                 cache.guard_plane = gp
     return gp
 

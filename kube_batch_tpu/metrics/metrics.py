@@ -294,6 +294,24 @@ GUARD_PATH_DEMOTED = Gauge(
     "1 while a fast path is demoted to its oracle (topk|shard_map|pallas)",
     ("path",),
 )
+# cycle tracing plane (kube_batch_tpu/obs): per-stage latency straight off
+# the span recorder (the histogram twin of the trace tree), flight-recorder
+# dumps by trigger reason, and the guard trip-rate SLO alerts
+STAGE_LATENCY = Histogram(
+    f"{_SUBSYSTEM}_cycle_stage_latency_milliseconds",
+    "Per-stage scheduling-cycle latency (span recorder) in milliseconds",
+    ("stage",),
+)
+FLIGHT_DUMPS = Counter(
+    f"{_SUBSYSTEM}_flight_recorder_dumps_total",
+    "Flight-recorder trace dumps, by trigger reason",
+    ("reason",),
+)
+ALERTS_FIRING = Gauge(
+    f"{_SUBSYSTEM}_alerts_firing",
+    "1 while the named SLO alert fires (guard trip-rate thresholds)",
+    ("alert",),
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -332,6 +350,9 @@ METRICS = [
     GUARD_TRIPS,
     GUARD_AUDITS,
     GUARD_PATH_DEMOTED,
+    STAGE_LATENCY,
+    FLIGHT_DUMPS,
+    ALERTS_FIRING,
 ]
 
 
@@ -443,6 +464,18 @@ def register_guard_audit(result: str) -> None:
 
 def set_guard_path_demoted(path: str, demoted: int) -> None:
     GUARD_PATH_DEMOTED.set(demoted, path)
+
+
+def observe_stage_latency(stage: str, ms: float) -> None:
+    STAGE_LATENCY.observe(ms, stage)
+
+
+def register_flight_dump(reason: str) -> None:
+    FLIGHT_DUMPS.inc(reason)
+
+
+def set_alert_firing(alert: str, firing: int) -> None:
+    ALERTS_FIRING.set(float(firing), alert)
 
 
 def register_whatif_request(verdict: str) -> None:

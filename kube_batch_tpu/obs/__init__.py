@@ -1,0 +1,23 @@
+"""Observability plane — structured cycle tracing, the anomaly-triggered
+flight recorder, and guard-trip SLO alerting.
+
+Three modules:
+
+- :mod:`kube_batch_tpu.obs.trace` — the span recorder: context-manager
+  spans with nesting over every stage of the (pipelined) scheduling cycle,
+  wall time through the ``telemetry`` seam, virtual time through the
+  injected clock, device-time attribution via ``utils/jitstats``.
+- :mod:`kube_batch_tpu.obs.recorder` — the flight recorder: a bounded
+  ring of complete per-cycle trace trees that dumps the cycles AROUND an
+  anomaly (guard trip, budget shed, arrival→decision SLO breach,
+  duplicate bind) as Chrome trace-event JSON.
+- :mod:`kube_batch_tpu.obs.alerts` — the guard trip-rate SLO evaluator
+  feeding ``GET /v1/alerts`` and the ``volcano_alerts_firing`` gauge.
+
+Everything attaches lazily per cache (the ``guard_of`` idiom) so multiple
+scheduler instances in one process never cross wires.
+"""
+
+from kube_batch_tpu.obs.trace import Tracer, tracer_of  # noqa: F401
+from kube_batch_tpu.obs.recorder import FlightRecorder  # noqa: F401
+from kube_batch_tpu.obs.alerts import AlertEvaluator, alerts_of  # noqa: F401

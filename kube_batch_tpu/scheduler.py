@@ -38,18 +38,14 @@ from kube_batch_tpu import plugins as _plugins  # registers plugin builders
 from kube_batch_tpu.cache.cache import SchedulerCache
 from kube_batch_tpu.framework.conf import SchedulerConfiguration, load_scheduler_conf
 from kube_batch_tpu.framework.interface import Action, get_action
+from kube_batch_tpu.envutil import env_flag
 from kube_batch_tpu.framework.session import close_session, open_session
 from kube_batch_tpu import metrics
+from kube_batch_tpu.obs.alerts import alerts_of
+from kube_batch_tpu.obs.trace import tracer_of
 from kube_batch_tpu.utils import telemetry
 
 logger = logging.getLogger("kube_batch_tpu")
-
-
-def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name, "").strip().lower()
-    if not raw:
-        return default
-    return raw not in ("0", "false", "off", "no")
 
 
 class CycleTrigger:
@@ -151,7 +147,7 @@ class Scheduler:
         self.cycle_budget = float(os.environ.get("KB_CYCLE_BUDGET", "0") or 0)
         # event-driven pipelined loop (the default; KB_PIPELINE=0 restores
         # the serial wait.Until loop as the bit-exactness oracle)
-        self.pipelined = _env_flag("KB_PIPELINE", True)
+        self.pipelined = env_flag("KB_PIPELINE", True)
         # cycle-start spacing: bursts coalesce to one cycle per min_period;
         # an idle cluster ticks every max_period (default: today's period).
         # The floor is ADAPTIVE by default: it tracks an EWMA of the
@@ -170,6 +166,10 @@ class Scheduler:
         # estimator; None until the first pipelined cycle completes
         self.cycle_cost_ewma: Optional[float] = None
         self.trigger = CycleTrigger(clock=self.clock)
+        # the cycle tracing plane (kube_batch_tpu/obs): per-cache span
+        # recorder + flight-recorder ring; virtual-time stamping follows
+        # the injected clock so sim traces attribute on the report's clock
+        self.tracer = tracer_of(cache, clock=self.clock)
         # the writeback stage: one worker, double-buffered — at most one
         # cycle's (status flush + binder drain) in flight while the next
         # cycle computes; _await_writeback is the stage barrier
@@ -228,6 +228,18 @@ class Scheduler:
         self._cycle(pipelined=True)
 
     def _cycle(self, pipelined: bool) -> None:
+        tracer = self.tracer
+        # the cycle's trace record: every stage below runs inside a span;
+        # the pipelined writeback attaches to THIS record from its worker
+        # thread, so the exported trace shows the overlap structure
+        record = tracer.begin_cycle("pipelined" if pipelined else "serial")
+        try:
+            self._cycle_body(pipelined, record)
+        finally:
+            tracer.end_cycle()
+
+    def _cycle_body(self, pipelined: bool, record) -> None:
+        tracer = self.tracer
         if pipelined:
             # ingest stage: everything the watch/ingest threads staged since
             # the last cycle applies under ONE cache-lock acquisition —
@@ -235,7 +247,10 @@ class Scheduler:
             # pod store
             drain = getattr(self.cache, "drain_staged_ingest", None)
             if drain is not None:
-                metrics.register_staged_ingest(drain())
+                with tracer.span("ingest_drain") as sp:
+                    n_staged = drain()
+                    sp.set(events=n_staged)
+                metrics.register_staged_ingest(n_staged)
         # drain the resync queue at the cycle boundary: the background repair
         # tick (cache.go:563-581) skips while an exclusive session owns the
         # cache, and at small schedule periods sessions run nearly
@@ -243,25 +258,28 @@ class Scheduler:
         # repaired within one cycle instead of racing for a gap
         resync = getattr(self.cache, "process_resync_tasks", None)
         if resync is not None:
-            resync()
+            with tracer.span("resync"):
+                resync()
         self._maybe_reload_conf()
         start = telemetry.perf_counter()
         # the soft budget reads the INJECTED clock (virtual elapsed inside
         # one run_once is 0 by construction, so simulated cycles never shed
         # nondeterministically; production's clock is the wall)
         budget_start = self.clock.monotonic() if self.cycle_budget > 0 else 0.0
-        ssn = open_session(self.cache, self.conf.tiers)
+        with tracer.span("session_open"):
+            ssn = open_session(self.cache, self.conf.tiers)
         # the configured pipeline, for actions whose behavior depends on
         # what runs after them (reclaim's idle-fit claimant gate)
         ssn.action_names = [a.name for a in self.actions]
         staged_flush = None
         try:
             for action in self.actions:
-                a_start = telemetry.perf_counter()
-                action.execute(ssn)
-                metrics.observe_action_latency(
-                    action.name, (telemetry.perf_counter() - a_start) * 1e6
-                )
+                # the span IS the measurement (rule KBT014): the action
+                # latency histogram feeds from its stamps instead of an
+                # ad-hoc perf_counter pair around the same region
+                with tracer.span("action:" + action.name) as sp:
+                    action.execute(ssn)
+                metrics.observe_action_latency(action.name, sp.dur_us)
         finally:
             shed = (
                 self.cycle_budget > 0
@@ -272,12 +290,19 @@ class Scheduler:
                     "cycle over its %.2fs soft budget before close; shedding "
                     "the status flush", self.cycle_budget)
                 metrics.register_cycle_budget_exceeded()
+                # a shed is a flight-recorder anomaly: the cycles around it
+                # show WHERE the budget went
+                tracer.anomaly(
+                    "budget_shed",
+                    detail=f"cycle over KB_CYCLE_BUDGET={self.cycle_budget}s",
+                )
                 self.cache.shed_status_writes = True
             try:
                 # pipelined: the close stages the flush (degraded verdict
                 # captured NOW, while the shed flag is visible) and skips
                 # the inline binder drain — both run on the writeback worker
-                staged_flush = close_session(ssn, stage_flush=pipelined)
+                with tracer.span("status_derive"):
+                    staged_flush = close_session(ssn, stage_flush=pipelined)
             finally:
                 if shed:
                     self.cache.shed_status_writes = False
@@ -293,8 +318,9 @@ class Scheduler:
                     # the flush — recover it from the session stash.
                     if staged_flush is None:
                         staged_flush = getattr(ssn, "staged_flush", None)
-                    self._await_writeback()
-                    self._submit_writeback(staged_flush)
+                    with tracer.span("writeback_barrier"):
+                        self._await_writeback()
+                    self._submit_writeback(staged_flush, record)
         metrics.observe_e2e_latency((telemetry.perf_counter() - start) * 1e3)
         if not pipelined:
             # drain async binder dispatch (cache.go:478's goroutines) outside
@@ -302,34 +328,40 @@ class Scheduler:
             # post-cycle state
             flush = getattr(self.cache, "flush_binds", None)
             if flush is not None:
-                flush()
+                with tracer.span("bind_drain"):
+                    flush()
         # guard-plane breaker clock: demotion cooldowns and half-open
         # probes count in SCHEDULING CYCLES, not wall seconds, so the
         # state machine is deterministic under the sim's virtual clock
         guard = getattr(self.cache, "guard_plane", None)
         if guard is not None:
             guard.end_cycle()
+            # trip-rate SLO alerting rides the same deterministic clock
+            alerts_of(self.cache).evaluate(guard)
         if self.on_cycle_end is not None:
             self.on_cycle_end()
 
     # ---- writeback stage (the overlapped half of the pipeline) ----------
-    def _writeback(self, staged_flush) -> None:
-        t0 = telemetry.perf_counter()
-        if staged_flush:
-            self.cache.run_status_flush(staged_flush)
-        drain = getattr(self.cache, "flush_binds", None)
-        if drain is not None:
-            drain()
-        metrics.observe_pipeline_overlap(
-            (telemetry.perf_counter() - t0) * 1e3
-        )
+    def _writeback(self, staged_flush, record=None) -> None:
+        # the span targets the ORIGINATING cycle's record (already in the
+        # ring) from this worker thread — chrome://tracing then shows it
+        # overlapping the next cycle's compute on a separate track
+        with self.tracer.cycle_span("writeback", record) as sp:
+            if staged_flush:
+                self.cache.run_status_flush(staged_flush)
+            drain = getattr(self.cache, "flush_binds", None)
+            if drain is not None:
+                drain()
+        metrics.observe_pipeline_overlap(sp.dur_ms)
 
-    def _submit_writeback(self, staged_flush) -> None:
+    def _submit_writeback(self, staged_flush, record=None) -> None:
         if self._wb_pool is None:
             self._wb_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="kb-writeback"
             )
-        self._wb_future = self._wb_pool.submit(self._writeback, staged_flush)
+        self._wb_future = self._wb_pool.submit(
+            self._writeback, staged_flush, record
+        )
 
     def _await_writeback(self) -> None:
         fut, self._wb_future = self._wb_future, None

@@ -27,12 +27,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Tuple
 
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+from kube_batch_tpu.envutil import env_int
 
 
 def _env_float(name: str, default: float) -> float:
@@ -57,11 +52,11 @@ class MicroBatcher:
         start_thread: bool = True,
     ):
         self._flush = flush
-        self.max_batch = max_batch if max_batch is not None else _env_int(
+        self.max_batch = max_batch if max_batch is not None else env_int(
             "KB_WHATIF_BATCH", 16)
         self.window_s = window_s if window_s is not None else _env_float(
             "KB_WHATIF_WINDOW_MS", 5.0) / 1e3
-        self.max_queue = max_queue if max_queue is not None else _env_int(
+        self.max_queue = max_queue if max_queue is not None else env_int(
             "KB_WHATIF_QUEUE", 1024)
         self.clock = clock
         self._cond = threading.Condition()

@@ -31,6 +31,10 @@ def main(argv=None) -> int:
                     help="override the preset's virtual-cycle budget")
     ap.add_argument("--trace", default=None,
                     help="write the JSONL event trace to this path")
+    ap.add_argument("--chrome-trace", default=None, metavar="PATH",
+                    help="export the cycle span trees (the flight-recorder "
+                         "ring) as Chrome trace-event JSON for "
+                         "chrome://tracing / Perfetto")
     ap.add_argument("--report", default=None,
                     help="also write the JSON report to this path")
     ap.add_argument("--no-fairness-series", action="store_true",
@@ -59,7 +63,8 @@ def main(argv=None) -> int:
         return 0 if report.get("reproduced") else 1
 
     report = run_preset(args.preset, seed=args.seed, cycles=args.cycles,
-                        trace_path=args.trace, pipelined=args.pipelined)
+                        trace_path=args.trace, pipelined=args.pipelined,
+                        chrome_trace_path=args.chrome_trace)
     if args.no_fairness_series:
         report.pop("fairness_series", None)
     out = json.dumps(report, indent=2, sort_keys=True)

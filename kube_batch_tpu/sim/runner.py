@@ -479,6 +479,14 @@ class SimRunner:
                 tag = (key, stored.uid)
                 if tag in self.bound_uids:
                     self.duplicate_binds += 1
+                    # a duplicate bind is ALWAYS a bug — capture the cycle
+                    # traces around it for offline triage
+                    flight = getattr(self.cache, "flight_recorder", None)
+                    if flight is not None:
+                        flight.trigger(
+                            "duplicate_bind",
+                            detail=f"pod {key} uid {stored.uid}",
+                        )
                 else:
                     self.bound_uids.add(tag)
             if stored is not None:
@@ -700,12 +708,23 @@ class SimRunner:
                     )
             except Exception:  # noqa: BLE001 — report must still land
                 solve_collectives = {"error": "collective trace failed"}
+        # cycle tracing plane: publish any still-armed flight dumps (a
+        # trigger near the end of the horizon must not lose its capture)
+        # and carry the SEED-STABLE stage-attribution summary — span
+        # counts per stage + attributed retraces are functions of the
+        # event stream, so they reproduce per seed like the trace hash
+        tracer = getattr(self.cache, "tracer", None)
+        flight = getattr(self.cache, "flight_recorder", None)
+        if flight is not None:
+            flight.flush()
         report.update({
             "unit": "virtual_seconds",
             "seed": cfg.seed,
             "cycle_mode": "pipelined" if cfg.pipelined else "serial",
             "cycles_run": cycles_run,
             "resident_scatter": scatter,
+            **({"stage_attribution": tracer.stage_attribution()}
+               if tracer is not None and tracer.enabled else {}),
             # candidate-compaction longitudinal evidence: how many cycles
             # ran compacted, and whether K was sized right (exhaustion /
             # re-entry totals near zero over the whole scenario)
@@ -768,14 +787,32 @@ class SimRunner:
         state = gp.state()
         state["corruptions_injected"] = self.faults.corruptions_applied
         state["trip_log"] = list(gp.trip_log)
+        # trip-rate SLO alerting (obs/alerts) + the flight-recorder dumps
+        # the trips triggered — both part of the corruption acceptance
+        alert_ev = getattr(self.cache, "alert_evaluator", None)
+        if alert_ev is not None:
+            state["alerts"] = alert_ev.state()
+        tracer = getattr(self.cache, "tracer", None)
+        flight = getattr(self.cache, "flight_recorder", None)
+        trace_on = tracer is not None and tracer.enabled
+        if flight is not None:
+            state["flight_dumps"] = list(flight.dumps)
         if self.faults.corruptions_applied:
             paths = state["paths"].values()
+            alert_fired = bool(
+                alert_ev is not None
+                and alert_ev.state()["alerts"]
+                .get("guard_trips", {}).get("fired_total", 0) >= 1
+            )
             state["chaos_ok"] = bool(
                 state["trips_total"] >= self.faults.corruptions_applied
                 and state["failed_closed"] >= 1
                 and any(p["trips"] > 0 for p in paths)       # demotion engaged
                 and any(p["promotions"] > 0 for p in paths)  # re-promoted
                 and state["bundles"]
+                and alert_fired                              # SLO alert fired
+                # every trip armed a flight dump (trace plane on)
+                and (not trace_on or state.get("flight_dumps"))
                 and self.duplicate_binds == 0
                 and not report["invariants"]["errors"]
             )
@@ -816,7 +853,8 @@ class SimRunner:
 
 def run_preset(name: str, seed: int = 0, cycles: Optional[int] = None,
                trace_path: Optional[str] = None,
-               pipelined: bool = False) -> Dict:
+               pipelined: bool = False,
+               chrome_trace_path: Optional[str] = None) -> Dict:
     """One-call entrypoint used by the CLI and the tests."""
     cfg = preset(name, seed=seed)
     if cycles is not None:
@@ -830,4 +868,16 @@ def run_preset(name: str, seed: int = 0, cycles: Optional[int] = None,
     if trace_path:
         runner.trace.write(trace_path)
         report["trace_path"] = trace_path
+    if chrome_trace_path:
+        # export the flight-recorder ring (the whole run at sim scale) as
+        # Chrome trace-event JSON — chrome://tracing / Perfetto render it
+        import json as _json
+
+        from kube_batch_tpu.obs.trace import chrome_trace
+
+        flight = getattr(runner.cache, "flight_recorder", None)
+        records = flight.records() if flight is not None else []
+        with open(chrome_trace_path, "w") as f:
+            _json.dump(chrome_trace(records), f)
+        report["chrome_trace_path"] = chrome_trace_path
     return report

@@ -58,3 +58,10 @@ env JAX_PLATFORMS=cpu python scripts/whatif_smoke.py
 # chaos preset pipelined with zero duplicate binds and a full drain
 echo "kbt-check: pipeline smoke (event-driven cycles)"
 env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py
+
+# trace smoke: the cycle tracing plane — traced sim run with a validating
+# Chrome trace-event export, corruption-trip flight-recorder dumps that
+# validate, and the pipelined overlap rendered as overlapping spans
+# (scripts/trace_smoke.py; KBT014 keeps span bodies clock-free statically)
+echo "kbt-check: trace smoke (spans + flight recorder)"
+env JAX_PLATFORMS=cpu python scripts/trace_smoke.py

@@ -25,6 +25,15 @@ apply_hardened_cpu_env(n_devices=None if _has_count else 8)
 # first backend init or a wedged tunnel hangs even CPU work.
 deregister_axon_backend()
 
+import tempfile  # noqa: E402
+
+# Flight-recorder dumps (obs/recorder.py) triggered by tests — budget
+# sheds, guard trips, duplicate binds — must never land in the checkout;
+# route them to a per-session temp dir unless a test overrides the knob.
+os.environ.setdefault(
+    "KB_TRACE_DIR", tempfile.mkdtemp(prefix="kb-flight-test-")
+)
+
 import pytest  # noqa: E402
 
 # Run the whole suite under the lockdep runtime lock-order validator (the

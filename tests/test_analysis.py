@@ -1274,8 +1274,8 @@ class TestSelfEnforcement:
             # each rule documents the incident that motivated it
             assert rule.__doc__ and len(rule.__doc__.strip()) > 40
 
-    def test_all_thirteen_rules_are_registered(self):
-        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 14)]
+    def test_all_fourteen_rules_are_registered(self):
+        assert sorted(RULES_BY_ID) == [f"KBT{i:03d}" for i in range(1, 15)]
 
     def test_jaxpr_registry_has_zero_unsuppressed_findings(self):
         # tier B self-enforcement: every registered jitted entry point
@@ -1356,3 +1356,91 @@ class TestCli:
         proc = self._run("--list-rules")
         assert proc.returncode == 0
         assert "KBT010" in proc.stdout and "KBT101" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# KBT014 — span discipline (obs.trace context managers, no clocks in bodies)
+# ---------------------------------------------------------------------------
+
+
+class TestKBT014:
+    def test_perf_counter_pair_inside_span_body_flagged(self):
+        src = """
+        from kube_batch_tpu import metrics
+        from kube_batch_tpu.utils import telemetry
+
+        def f(tracer, action):
+            with tracer.span("a"):
+                t0 = telemetry.perf_counter()
+                action()
+                metrics.observe_action_latency(
+                    "a", (telemetry.perf_counter() - t0) * 1e6)
+        """
+        findings = findings_for(src, "actions/x.py")
+        assert "KBT014" in rule_ids(findings)
+        assert sum(1 for f in findings if f.rule == "KBT014") == 2
+
+    def test_raw_time_inside_span_body_flagged(self):
+        # serve/ is outside KBT001's scope — the span-body ban still holds
+        src = """
+        import time
+
+        def f(tracer):
+            with tracer.device_span("probe"):
+                time.monotonic()
+        """
+        findings = findings_for(src, "serve/x.py")
+        assert rule_ids(findings) == ["KBT014"]
+
+    def test_manual_span_construction_flagged(self):
+        src = """
+        from kube_batch_tpu.obs.trace import Span
+
+        def f(tracer):
+            sp = Span(tracer, "x")
+            return sp
+        """
+        findings = findings_for(src, "cache/x.py")
+        assert "KBT014" in rule_ids(findings)
+
+    def test_span_duration_read_after_block_is_the_sanctioned_form(self):
+        src = """
+        from kube_batch_tpu import metrics
+
+        def f(tracer, action):
+            with tracer.span("a") as sp:
+                action()
+            metrics.observe_action_latency("a", sp.dur_us)
+        """
+        assert findings_for(src, "scheduler.py") == []
+
+    def test_injected_clock_inside_span_body_is_sanctioned(self):
+        src = """
+        class S:
+            def f(self):
+                with self.tracer.span("pace"):
+                    t = self.clock.monotonic()
+                return t
+        """
+        assert findings_for(src, "scheduler.py") == []
+
+    def test_out_of_scope_paths_unflagged(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def f(tracer):
+            with tracer.span("a"):
+                return telemetry.perf_counter()
+        """
+        assert findings_for(src, "analysis/x.py") == []
+
+    def test_annotation_suppresses(self):
+        src = """
+        from kube_batch_tpu.utils import telemetry
+
+        def f(tracer):
+            with tracer.span("a"):
+                # kbt: allow[KBT014] migration shim measured both ways
+                return telemetry.perf_counter()
+        """
+        assert findings_for(src, "actions/x.py") == []

@@ -206,3 +206,89 @@ def test_gate_and_walk_promotions_visible_to_allocate():
     cache.flush_binds()
     assert cache.binder.binds, "promoted job's pod did not bind"
     cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# guard-plane shadow audit of the gate vs the object-walk oracle
+# ---------------------------------------------------------------------------
+
+
+class TestWalkShadowAudit:
+    """The sampled shadow audit (guard tier 2) for the enqueue gate: every
+    KB_AUDIT_EVERY-th columnar dispatch re-derives the admission through
+    the reference object walk over the still-unmutated session and diffs
+    decision sets — the ROADMAP standing item's coverage for the gate's
+    fallback path."""
+
+    def _session(self, spec):
+        cache = _build(spec)
+        conf = load_scheduler_conf(None)
+        ssn = open_session(cache, conf.tiers)
+        return cache, ssn
+
+    def test_audit_matches_on_healthy_columns(self):
+        from kube_batch_tpu.guard import guard_of
+
+        spec = ([_q("q0")], [
+            ("a", "q0", {"cpu": 1000.0}),
+            ("free", "q0", None),          # unconditional promotion
+        ])
+        cache, ssn = self._session(spec)
+        gp = guard_of(cache)
+        gp.audit_every = 1  # audit every dispatch
+        action = get_action("enqueue")
+        a0, m0 = gp.audits_run, gp.audits_mismatched
+        try:
+            action.execute(ssn)
+            assert action.last_path == "columnar"
+            phases = _phases(cache)
+        finally:
+            close_session(ssn)
+        assert gp.audits_run == a0 + 1, "the gate dispatch must audit"
+        assert gp.audits_mismatched == m0
+        assert phases["eq/a"] == PodGroupPhase.INQUEUE
+        assert phases["eq/free"] == PodGroupPhase.INQUEUE
+        cache.stop()
+
+    def test_corrupted_minres_column_trips_and_walk_decides(self):
+        """A corrupted j_minres word makes the gate deny a job the walk
+        admits: the audit must trip (mismatch) and the WALK's decisions —
+        the oracle — must be the ones applied."""
+        from kube_batch_tpu.guard import guard_of
+
+        spec = ([_q("q0")], [("a", "q0", {"cpu": 1000.0})])
+        cache, ssn = self._session(spec)
+        gp = guard_of(cache)
+        gp.audit_every = 1
+        job = cache.jobs["eq/a"]
+        # the corruption: the device-facing minres row claims 1e9 cpu while
+        # the authoritative PodGroup asks 1000 — the gate denies, the walk
+        # admits
+        cache.columns.j_minres[job._row, 0] = 1e9
+        action = get_action("enqueue")
+        t0 = gp.trips_total
+        try:
+            action.execute(ssn)
+            assert action.last_path == "columnar"
+        finally:
+            close_session(ssn)
+        assert gp.audits_mismatched >= 1, "divergence must be caught"
+        assert gp.trips_total == t0 + 1
+        # fail over to the oracle: the walk's admission applied
+        assert job.pod_group.phase == PodGroupPhase.INQUEUE
+        cache.stop()
+
+    def test_no_audit_when_cadence_not_due(self):
+        from kube_batch_tpu.guard import guard_of
+
+        spec = ([_q("q0")], [("a", "q0", {"cpu": 1000.0})])
+        cache, ssn = self._session(spec)
+        gp = guard_of(cache)
+        gp.audit_every = 1000  # far beyond one dispatch
+        a0 = gp.audits_run
+        try:
+            get_action("enqueue").execute(ssn)
+        finally:
+            close_session(ssn)
+        assert gp.audits_run == a0
+        cache.stop()

@@ -338,3 +338,75 @@ class TestPipelineLockRegistration:
             with big:
                 pass
         assert [v.kind for v in state.violations] == ["order-inversion"]
+
+
+class TestLockProfile:
+    """Lock-hold / contention profiling on TrackedLock (the ROADMAP's
+    'striped per-kind ingest locks (profile first)' item): acquire-wait
+    and hold times accumulate per lock class, merged across threads."""
+
+    def test_hold_time_recorded(self):
+        import time as _t
+
+        state = LockdepState()
+        (lk,) = _locks(state, "mod.cache:1")
+        with lk:
+            _t.sleep(0.01)
+        rec = state.profile_report()["mod.cache:1"]
+        assert rec["acquires"] == 1
+        assert rec["hold_ms_total"] >= 8.0
+        assert rec["hold_ms_max"] >= 8.0
+        assert rec["wait_ms_total"] < 8.0, "uncontended acquire ~free"
+
+    def test_contended_acquire_records_wait(self):
+        import time as _t
+
+        state = LockdepState()
+        (lk,) = _locks(state, "mod.cache:2")
+        entered = threading.Event()
+
+        def holder():
+            with lk:
+                entered.set()
+                _t.sleep(0.05)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        entered.wait(timeout=5)
+        with lk:          # blocks until the holder releases
+            pass
+        t.join(timeout=5)
+        rec = state.profile_report()["mod.cache:2"]
+        assert rec["acquires"] == 2
+        assert rec["wait_ms_max"] >= 20.0, (
+            "the contended acquire's wait must be attributed"
+        )
+
+    def test_reentrant_acquires_count_once_for_hold(self):
+        state = LockdepState()
+        lk = TrackedLock(state, "mod.cache:3", reentrant=True)
+        with lk:
+            with lk:
+                pass
+        rec = state.profile_report()["mod.cache:3"]
+        assert rec["acquires"] == 2      # each acquire's wait is recorded
+        assert rec["hold_ms_total"] >= 0.0
+
+    def test_suite_installed_state_profiles_cache_locks(self):
+        """The pytest-plugin-installed lockdep (the whole-suite watcher)
+        carries the profile too — the cache's big lock shows up after any
+        ingest."""
+        import pytest as _pytest
+
+        from kube_batch_tpu.api.pod import Queue
+        from kube_batch_tpu.cache.cache import SchedulerCache
+
+        state = lockdep.current_state()
+        if state is None:
+            _pytest.skip("lockdep disabled (KBT_LOCKDEP=0)")
+        cache = SchedulerCache()
+        cache.add_queue(Queue(name="lp", uid="ulp", weight=1))
+        prof = state.profile_report()
+        assert any("kube_batch_tpu.cache.cache" in site for site in prof), (
+            "the cache big lock's class must appear in the merged profile"
+        )

@@ -1,0 +1,602 @@
+"""Cycle tracing plane (kube_batch_tpu/obs): span semantics, Chrome
+export validity, the flight recorder's anomaly windows, trace-on vs
+trace-off decision bit-exactness over randomized churn, the pipelined
+writeback overlap rendered as overlapping spans, the span-stamped
+arrival→decision latencies, and the guard trip-rate alert evaluator."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu import actions as _actions  # noqa: F401 — registers
+from kube_batch_tpu import metrics as prom_metrics
+from kube_batch_tpu import plugins as _plugins  # noqa: F401 — registers
+from kube_batch_tpu.api.pod import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    Queue,
+)
+from kube_batch_tpu.api.types import PodPhase
+from kube_batch_tpu.cache.cache import SchedulerCache
+from kube_batch_tpu.cache.fake import FakeBinder, FakeEvictor, FakeStatusUpdater
+from kube_batch_tpu.framework.conf import load_scheduler_conf
+from kube_batch_tpu.obs.alerts import AlertEvaluator
+from kube_batch_tpu.obs.recorder import FlightRecorder
+from kube_batch_tpu.obs.trace import (
+    Tracer,
+    chrome_trace,
+    tracer_of,
+    validate_chrome_trace,
+)
+from kube_batch_tpu.scheduler import Scheduler
+from kube_batch_tpu.sim import kubelet as kl
+from kube_batch_tpu.testing.synthetic import GiB
+
+
+def _mk_cache(n_nodes=4, n_queues=2):
+    cache = SchedulerCache(
+        binder=FakeBinder(), evictor=FakeEvictor(),
+        status_updater=FakeStatusUpdater(),
+    )
+    for q in range(n_queues):
+        cache.add_queue(Queue(name=f"q{q}", uid=f"uq{q}", weight=q + 1))
+    for i in range(n_nodes):
+        cache.add_node(Node(
+            name=f"n{i}",
+            allocatable={"cpu": 16000.0, "memory": 64 * GiB, "pods": 110.0},
+        ))
+    return cache
+
+
+def _mk_scheduler(cache) -> Scheduler:
+    return Scheduler(cache, conf=load_scheduler_conf(None))
+
+
+def _add_gang(cache, serial, size=2, n_queues=2):
+    g = f"g{serial}"
+    cache.add_pod_group(PodGroup(
+        name=g, namespace="tr", uid=f"pg-{g}", min_member=size,
+        queue=f"q{serial % n_queues}", creation_index=serial,
+    ))
+    for k in range(size):
+        cache.add_pod(Pod(
+            name=f"{g}-{k}", namespace="tr", uid=f"pod-{g}-{k}",
+            requests={"cpu": 500.0, "memory": 1 * GiB},
+            annotations={GROUP_NAME_ANNOTATION: g},
+            phase=PodPhase.PENDING,
+            creation_index=serial * 100 + k,
+        ))
+
+
+class _Churner:
+    """Seed-deterministic churn through the real ingest surface (the
+    test_pipeline idiom) — applied identically to both caches."""
+
+    def __init__(self, cache, seed, n_queues=2):
+        self.cache = cache
+        self.rng = np.random.default_rng(seed)
+        self.n_queues = n_queues
+        self.serial = 0
+        self.gangs = []
+
+    def add_gang(self):
+        self.serial += 1
+        g = f"g{self.serial}"
+        size = int(self.rng.integers(1, 4))
+        self.cache.add_pod_group(PodGroup(
+            name=g, namespace="tr", uid=f"pg-{g}", min_member=size,
+            queue=f"q{int(self.rng.integers(self.n_queues))}",
+            creation_index=self.serial,
+        ))
+        for k in range(size):
+            self.cache.add_pod(Pod(
+                name=f"{g}-{k}", namespace="tr", uid=f"pod-{g}-{k}",
+                requests={"cpu": float(self.rng.choice([250.0, 500.0, 1000.0])),
+                          "memory": 1 * GiB},
+                annotations={GROUP_NAME_ANNOTATION: g},
+                phase=PodPhase.PENDING,
+                creation_index=self.serial * 100 + k,
+            ))
+        self.gangs.append(g)
+
+    def complete_gang(self):
+        if not self.gangs:
+            return
+        g = self.gangs.pop(int(self.rng.integers(len(self.gangs))))
+        job_uid = f"tr/{g}"
+        job = self.cache.jobs.get(job_uid)
+        keys = sorted(job.tasks.keys()) if job is not None else []
+        for key in keys:
+            kl.delete_pod(self.cache, key)
+        self.cache.delete_pod_group(job_uid)
+
+    def flip_statuses(self):
+        pods = [p for p in self.cache.pods.values() if p.node_name]
+        if not pods:
+            return
+        pods.sort(key=lambda p: p.key())
+        for p in pods[: int(self.rng.integers(1, 3))]:
+            if p.phase == PodPhase.PENDING:
+                kl.set_running(self.cache, p.key(), p.node_name)
+            elif p.phase == PodPhase.RUNNING and self.rng.random() < 0.5:
+                kl.set_succeeded(self.cache, p.key())
+
+    def step(self):
+        r = self.rng.random()
+        if r < 0.45:
+            self.add_gang()
+        elif r < 0.70:
+            self.complete_gang()
+        else:
+            self.flip_statuses()
+
+
+def _observable_state(cache) -> dict:
+    pg_status = {}
+    for uid, job in sorted(cache.jobs.items()):
+        pg = job.pod_group
+        if pg is not None:
+            pg_status[uid] = (pg.phase, pg.running, pg.failed, pg.succeeded)
+    return {
+        "binds": dict(cache.binder.binds),
+        "pods": {k: (p.node_name, p.phase)
+                 for k, p in sorted(cache.pods.items())},
+        "pg_status": pg_status,
+        "conditions": dict(cache.pod_conditions),
+        "queue_statuses": dict(cache.status_updater.queue_statuses),
+    }
+
+
+# ---------------------------------------------------------------------------
+# span mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def _tracer(self, tmp_path, **kw):
+        rec = FlightRecorder(ring=16, directory=str(tmp_path),
+                             post_cycles=0)
+        return Tracer(recorder=rec, enabled=True, **kw), rec
+
+    def test_nesting_builds_a_tree(self, tmp_path):
+        tr, rec = self._tracer(tmp_path)
+        tr.begin_cycle("test")
+        with tr.span("outer"):
+            with tr.span("inner_a"):
+                pass
+            with tr.span("inner_b") as sp:
+                sp.set(k=1)
+        tr.end_cycle()
+        records = rec.records()
+        assert len(records) == 1
+        spans = records[0].spans
+        assert [s.name for s in spans] == ["outer"]
+        assert [c.name for c in spans[0].children] == ["inner_a", "inner_b"]
+        assert spans[0].children[1].attrs == {"k": 1}
+        assert spans[0].t1 >= spans[0].children[1].t1
+
+    def test_exception_closes_the_span(self, tmp_path):
+        tr, rec = self._tracer(tmp_path)
+        tr.begin_cycle("test")
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        tr.end_cycle()
+        sp = rec.records()[0].spans[0]
+        assert sp.t1 >= sp.t0
+        assert sp.attrs["error"] == "RuntimeError"
+        # the per-thread stack unwound — a follow-up span is a root again
+        tr.begin_cycle("test2")
+        with tr.span("after"):
+            pass
+        tr.end_cycle()
+        assert [s.name for s in rec.records()[1].spans] == ["after"]
+
+    def test_disabled_tracer_still_times_but_retains_nothing(self, tmp_path):
+        rec = FlightRecorder(ring=4, directory=str(tmp_path))
+        tr = Tracer(recorder=rec, enabled=False)
+        tr.begin_cycle("test")
+        with tr.span("stage") as sp:
+            time.sleep(0.002)
+        tr.end_cycle()
+        assert sp.dur_ms > 0, "spans always stamp (metrics feed from them)"
+        assert rec.records() == []
+        assert tr.spans_total == 0
+
+    def test_implicit_record_rolls_over(self, tmp_path):
+        from kube_batch_tpu.obs.trace import IMPLICIT_ROLL
+
+        tr, rec = self._tracer(tmp_path)
+        for _ in range(IMPLICIT_ROLL + 5):
+            with tr.span("direct"):
+                pass
+        assert rec.records(), "direct-driven spans must reach the ring"
+        assert rec.records()[0].reason == "implicit"
+
+    def test_virtual_time_stamps_follow_the_injected_clock(self, tmp_path):
+        from kube_batch_tpu.sim.clock import VirtualClock
+
+        clock = VirtualClock(start=7.0)
+        tr, rec = self._tracer(tmp_path, clock=clock)
+        tr.begin_cycle("vt")
+        with tr.span("stage") as sp:
+            clock.sleep(2.5)
+        tr.end_cycle()
+        assert sp.vt0 == 7.0 and sp.vt1 == 9.5
+        assert rec.records()[0].vt0 == 7.0
+
+
+# ---------------------------------------------------------------------------
+# chrome export + validation
+# ---------------------------------------------------------------------------
+
+
+class TestChromeExport:
+    def test_real_cycles_export_validates(self):
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        for s in range(1, 4):
+            _add_gang(cache, s)
+            sched.run_once()
+        doc = chrome_trace(cache.flight_recorder.records())
+        assert validate_chrome_trace(doc) == []
+        names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert {"session_open", "status_derive", "action:allocate",
+                "solve_dispatch"} <= names
+        cache.stop()
+
+    def test_validator_rejects_unbalanced_and_negative(self):
+        bad = {"traceEvents": [
+            {"name": "outer", "ph": "X", "ts": 0.0, "dur": 10.0,
+             "pid": 1, "tid": 1},
+            {"name": "child-too-long", "ph": "X", "ts": 5.0, "dur": 50.0,
+             "pid": 1, "tid": 1},
+        ]}
+        assert validate_chrome_trace(bad), "nesting violation must report"
+        neg = {"traceEvents": [
+            {"name": "n", "ph": "X", "ts": 0.0, "dur": -1.0,
+             "pid": 1, "tid": 1},
+        ]}
+        assert validate_chrome_trace(neg)
+        assert validate_chrome_trace({"traceEvents": []})
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def _record(self, tr):
+        tr.begin_cycle("t")
+        with tr.span("s"):
+            pass
+        tr.end_cycle()
+
+    def test_dump_captures_cycles_before_and_after(self, tmp_path):
+        rec = FlightRecorder(ring=8, directory=str(tmp_path), post_cycles=2)
+        tr = Tracer(recorder=rec, enabled=True)
+        for _ in range(5):
+            self._record(tr)
+        rec.trigger("test_anomaly", detail="planted")
+        assert rec.dumps == [], "dump waits out the post-trigger window"
+        for _ in range(2):
+            self._record(tr)
+        assert len(rec.dumps) == 1
+        meta = json.loads(
+            (tmp_path / "flight-test_anomaly-0000" / "meta.json").read_text()
+        )
+        assert meta["reason"] == "test_anomaly"
+        assert meta["cycles_before"] == 5
+        assert meta["cycles_after"] == 2
+        doc = json.loads(
+            (tmp_path / "flight-test_anomaly-0000" / "trace.json").read_text()
+        )
+        assert validate_chrome_trace(doc) == []
+        # atomic publish: no temp residue next to the dump
+        assert not [p for p in tmp_path.iterdir()
+                    if p.name.startswith(".tmp-")]
+
+    def test_flush_publishes_armed_captures(self, tmp_path):
+        rec = FlightRecorder(ring=8, directory=str(tmp_path), post_cycles=10)
+        tr = Tracer(recorder=rec, enabled=True)
+        self._record(tr)
+        rec.trigger("end_of_run")
+        assert rec.dumps == []
+        out = rec.flush()
+        assert len(out) == 1 and rec.dumps == out
+
+    def test_ring_is_bounded(self, tmp_path):
+        rec = FlightRecorder(ring=4, directory=str(tmp_path))
+        tr = Tracer(recorder=rec, enabled=True)
+        for _ in range(10):
+            self._record(tr)
+        stats = rec.stats()
+        assert stats["cycles_resident"] == 4
+        assert stats["cycles_recorded"] == 10
+
+    def test_budget_shed_triggers_a_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KB_CYCLE_BUDGET", "0.000001")
+        monkeypatch.setenv("KB_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("KB_TRACE_POST", "1")
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        _add_gang(cache, 1)
+        sched.run_once_pipelined()  # overruns the 1µs budget → shed
+        sched.run_once_pipelined()  # the post-trigger cycle
+        sched.drain_pipeline()
+        assert cache.flight_recorder.dumps, "shed must arm a flight dump"
+        reasons = [t["reason"] for t in cache.flight_recorder.triggers]
+        assert "budget_shed" in reasons
+        cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# inertness: trace on vs off — bit-identical decisions
+# ---------------------------------------------------------------------------
+
+
+class TestTraceInert:
+    @pytest.mark.parametrize("seed", [3, 17])
+    def test_trace_on_vs_off_decisions_identical(self, seed, monkeypatch):
+        """Tracing must be provably inert: the same churn stream under
+        KB_TRACE=1 and KB_TRACE=0 produces identical binds, statuses,
+        conditions, and queue writebacks (serial and pipelined bodies)."""
+        monkeypatch.setenv("KB_TRACE", "0")
+        c_off = _mk_cache()
+        s_off = _mk_scheduler(c_off)
+        assert not c_off.tracer.enabled
+        monkeypatch.setenv("KB_TRACE", "1")
+        c_on = _mk_cache()
+        s_on = _mk_scheduler(c_on)
+        assert c_on.tracer.enabled
+        ch_off, ch_on = _Churner(c_off, seed), _Churner(c_on, seed)
+        for _ in range(3):
+            ch_off.add_gang()
+            ch_on.add_gang()
+        for cycle in range(8):
+            ch_off.step()
+            ch_on.step()
+            if cycle % 2:
+                s_off.run_once()
+                s_on.run_once()
+            else:
+                s_off.run_once_pipelined()
+                s_off.drain_pipeline()
+                s_on.run_once_pipelined()
+                s_on.drain_pipeline()
+        assert _observable_state(c_on) == _observable_state(c_off)
+        # and the traced side actually traced
+        assert c_on.tracer.cycles_total >= 8
+        assert c_on.tracer.spans_total > 0
+        c_off.stop()
+        c_on.stop()
+
+
+# ---------------------------------------------------------------------------
+# the pipelined overlap, visible in the trace
+# ---------------------------------------------------------------------------
+
+
+class TestPipelinedOverlap:
+    def test_writeback_span_overlaps_next_cycle_compute(self):
+        """Cycle N's writeback span (its own worker-thread track) must
+        overlap cycle N+1's session_open span in wall time — the exported
+        trace renders the pipeline's overlap structure directly."""
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        _add_gang(cache, 1)
+        sched.run_once_pipelined()  # warm compile out of the way
+        orig_flush = cache.flush_binds
+
+        def slow_flush():
+            time.sleep(0.08)
+            return orig_flush()
+
+        cache.flush_binds = slow_flush
+        _add_gang(cache, 2)
+        sched.run_once_pipelined()   # cycle N: hands writeback to worker
+        _add_gang(cache, 3)
+        sched.run_once_pipelined()   # cycle N+1 computes under N's egress
+        sched.drain_pipeline()
+        records = cache.flight_recorder.records()
+        wb = None
+        nxt_open = None
+        for i, rec in enumerate(records):
+            wb_spans = [s for s in rec.spans if s.name == "writeback"]
+            if wb_spans and i + 1 < len(records):
+                opens = [s for s in records[i + 1].spans
+                         if s.name == "session_open"]
+                if opens:
+                    wb, nxt_open = wb_spans[-1], opens[0]
+                    if wb.t0 < nxt_open.t1 and nxt_open.t0 < wb.t1:
+                        break
+        assert wb is not None and nxt_open is not None
+        assert wb.t0 < nxt_open.t1 and nxt_open.t0 < wb.t1, (
+            "writeback must overlap the next cycle's compute"
+        )
+        assert wb.tid != nxt_open.tid, "writeback rides its own thread track"
+        # and the chrome export of exactly this structure validates
+        assert validate_chrome_trace(chrome_trace(records)) == []
+        cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# span-stamped arrival→decision latencies (satellite: latency-sink tests)
+# ---------------------------------------------------------------------------
+
+
+def _span_stamped_latencies(cache):
+    out = []
+    for rec in cache.flight_recorder.records():
+        out.extend(rec.attrs.get("decision_lat_ms", ()))
+    tr = cache.tracer
+    with tr._mu:
+        cur = tr.current
+    if cur is not None:
+        out.extend(cur.attrs.get("decision_lat_ms", ()))
+    return out
+
+
+class TestDecisionLatencySink:
+    def test_direct_path_sink_and_spans_agree(self):
+        """Direct (unstaged) ingest: every histogram/sink sample has a
+        span-stamped twin on the cycle's trace record."""
+        sink = []
+        prom_metrics.set_decision_latency_sink(sink)
+        try:
+            cache = _mk_cache()
+            sched = _mk_scheduler(cache)
+            _add_gang(cache, 1)
+            _add_gang(cache, 2)
+            sched.run_once()
+        finally:
+            prom_metrics.set_decision_latency_sink(None)
+        assert len(sink) == 4, "both 2-gangs decided"
+        stamped = _span_stamped_latencies(cache)
+        assert sorted(round(v, 3) for v in sink) == sorted(stamped)
+        cache.stop()
+
+    def test_staged_path_sink_and_spans_agree(self):
+        """Staged ingest (the pipelined mode's path): the sink drains the
+        same samples, and the stage-time arrival clock means the latency
+        covers the stage→drain wait; span stamps match exactly."""
+        sink = []
+        prom_metrics.set_decision_latency_sink(sink)
+        try:
+            cache = _mk_cache()
+            sched = _mk_scheduler(cache)
+            cache.enable_ingest_staging()
+            _add_gang(cache, 1)           # staged, not applied
+            assert "tr/g1-0" in cache._arrival_ts
+            time.sleep(0.01)              # a real stage→drain wait
+            sched.run_once_pipelined()
+            sched.drain_pipeline()
+        finally:
+            prom_metrics.set_decision_latency_sink(None)
+            cache.disable_ingest_staging()
+        assert len(sink) == 2
+        assert min(sink) * 1.0 >= 10.0, (
+            "stage-time clock must cover the stage→drain wait"
+        )
+        stamped = _span_stamped_latencies(cache)
+        assert sorted(round(v, 3) for v in sink) == sorted(stamped)
+        cache.stop()
+
+    def test_slo_breach_arms_a_flight_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KB_TRACE_SLO_MS", "0.000001")
+        monkeypatch.setenv("KB_TRACE_DIR", str(tmp_path))
+        # the breach fires MID-cycle (at the bind decision), so the
+        # triggering cycle itself is the first post-trigger capture
+        monkeypatch.setenv("KB_TRACE_POST", "1")
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        _add_gang(cache, 1)
+        sched.run_once()
+        reasons = [t["reason"] for t in cache.flight_recorder.triggers]
+        assert "slo_breach" in reasons
+        assert cache.flight_recorder.dumps
+        cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# guard trip-rate alerting (obs/alerts)
+# ---------------------------------------------------------------------------
+
+
+class TestAlerts:
+    def _plane(self):
+        from kube_batch_tpu.guard.plane import GuardPlane
+
+        return GuardPlane(enabled=True, audit_every=0, cooldown=4)
+
+    def test_threshold_fires_and_resolves(self):
+        gp = self._plane()
+        ev = AlertEvaluator(threshold=2, window=4)
+        gp.trip("allocate", ["topk"], reason="invariant", detail="t1")
+        gp.end_cycle()
+        fire = ev.evaluate(gp)
+        assert fire.get("guard_trips") is False, "one trip under threshold"
+        gp.trip("allocate", ["topk"], reason="invariant", detail="t2")
+        gp.end_cycle()
+        fire = ev.evaluate(gp)
+        assert fire["guard_trips"] is True
+        assert fire["guard_trips:topk"] is True
+        assert ev.state()["alerts"]["guard_trips"]["fired_total"] == 1
+        # the window slides past both trips → the alert resolves
+        for _ in range(6):
+            gp.end_cycle()
+        fire = ev.evaluate(gp)
+        assert fire["guard_trips"] is False
+        assert ev.state()["alerts"]["guard_trips"]["fired_total"] == 1
+
+    def test_gauge_follows_firing_state(self):
+        from kube_batch_tpu.metrics.metrics import ALERTS_FIRING
+
+        gp = self._plane()
+        ev = AlertEvaluator(threshold=1, window=8)
+        gp.trip("reclaim", ["shard_map"], reason="audit", detail="x")
+        gp.end_cycle()
+        ev.evaluate(gp)
+        assert ALERTS_FIRING._values[("guard_trips",)] == 1.0
+        assert ALERTS_FIRING._values[("guard_trips:shard_map",)] == 1.0
+
+    def test_scheduler_cycle_evaluates_alerts(self, monkeypatch):
+        """The L1 loop evaluates alerts on the guard's cycle clock — a
+        corruption-style trip surfaces at /v1/alerts with no extra
+        wiring."""
+        monkeypatch.setenv("KB_ALERT_GUARD_TRIPS", "1")
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        _add_gang(cache, 1)
+        sched.run_once()  # attaches the guard plane via the dispatch
+        gp = cache.guard_plane
+        gp.trip("allocate", ["topk"], reason="invariant", detail="planted")
+        sched.run_once()
+        st = cache.alert_evaluator.state()
+        assert st["alerts"]["guard_trips"]["firing"] is True
+        cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestTraceEndpoints:
+    def test_v1_trace_and_alerts(self):
+        from kube_batch_tpu.cmd.server import AdminServer
+
+        cache = _mk_cache()
+        sched = _mk_scheduler(cache)
+        _add_gang(cache, 1)
+        sched.run_once()
+        admin = AdminServer(cache, "127.0.0.1", 0)
+        admin.start()
+        try:
+            base = f"http://127.0.0.1:{admin.port}"
+            with urllib.request.urlopen(base + "/v1/trace") as r:
+                trace = json.loads(r.read())
+            assert trace["enabled"] is True
+            assert trace["cycles_traced"] >= 1
+            assert trace["last_cycle"] is not None
+            names = {s["name"] for s in trace["last_cycle"]["spans"]}
+            assert "session_open" in names
+            assert trace["ring"]["capacity"] >= 2
+            with urllib.request.urlopen(base + "/v1/alerts") as r:
+                alerts = json.loads(r.read())
+            assert "alerts" in alerts and "window_cycles" in alerts
+            # the per-stage histogram rides /metrics
+            with urllib.request.urlopen(base + "/metrics") as r:
+                text = r.read().decode()
+            assert "volcano_cycle_stage_latency_milliseconds" in text
+        finally:
+            admin.stop()
+            cache.stop()
