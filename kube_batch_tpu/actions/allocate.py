@@ -80,6 +80,92 @@ def resolve_topk() -> int:
         return 0
 
 
+def resolve_warm() -> bool:
+    """KB_WARM: carry the candidate table across cycles and repair it from
+    the resident-scatter deltas (default ON whenever compaction runs);
+    KB_WARM=0 rebuilds the table cold every solve — the bit-exactness
+    oracle, same contract as KB_TOPK=0 / KB_SHARD_MAP=0 / KB_PIPELINE=0.
+    Any value other than an explicit enable counts as OFF (the KB_TOPK
+    garbage-disables discipline: a typo'd disable attempt must not
+    silently re-enable the fast path under an oracle comparison)."""
+    raw = os.environ.get("KB_WARM", "").strip().lower()
+    if not raw:
+        return True
+    return raw in ("1", "true", "on", "yes")
+
+
+def _warm_state(cols, mesh, impl, config, guard, warm: bool, k: int):
+    """The carried-table state for this dispatch slot, or None when the
+    warm path must not run: opt-out (KB_WARM=0), guard demotion, the
+    Pallas head (its fused build is a cold-build kernel), no ColumnStore,
+    or an explicitly cold caller (the backfill real-request pass solves a
+    mid-cycle snapshot and must not consume the allocate carry's deltas).
+
+    Called BEFORE the resident swap so a fresh state still absorbs this
+    cycle's delta record and cold-builds the same dispatch."""
+    if (
+        not warm or cols is None or k <= 0
+        or not resolve_warm()
+        or config.use_pallas
+        # a custom score row may read ANY snapshot field (the seam's
+        # contract) — including per-cycle state the carry's invalidation
+        # sources don't track (queue_alloc, job rows, statuses), which
+        # would silently stale the carried keys.  Same policy as the
+        # columnar host fast path: custom scoring defers to the general
+        # machinery (here: the cold per-solve build).
+        or config.weights.extra_rows
+        or (guard is not None and not guard.allow("warm"))
+    ):
+        return None
+    return cols.warm_table_state(mesh=mesh, impl=impl)
+
+
+def _warm_commit(wstate, call):
+    """Run one warm solve thunk and adopt its refreshed table (the last
+    two outputs of every warm program).  ANY failure drops the carried
+    state wholesale — plan() already consumed the invalidation
+    accumulators, and off-CPU the solve donated the stale table buffers,
+    so a carried-on state would pair stale (or deleted) entries with the
+    new bucket order."""
+    try:
+        out = call()
+    except BaseException:
+        wstate.drop()
+        raise
+    wstate.commit(out[-2], out[-1])
+    return out
+
+
+def warm_k_min(k: int) -> int:
+    """The erosion floor of the carried table: a row re-ranks when its
+    valid prefix thins below (a per-row staggered threshold above) this.
+    K/4, not K: a thin table still answers EXACTLY — the head's argmax
+    over an exact prefix equals the full argmax while any entry fits, and
+    exhaustion re-enters the full-matrix head the same round — so the
+    floor trades re-rank traffic against fallback probability, and
+    ``topk_exhausted`` (read back every cycle) monitors the latter."""
+    return max(4, k // 4)
+
+
+def _warm_plan(state, cols, pend_rows, k: int, config, tracer):
+    """The post-swap invalidation plan (api/resident.WarmTableState.plan),
+    span-attributed as table maintenance under the owning solve_dispatch
+    span.  None = the delta chain is broken this cycle (no per-cycle
+    resident cache, or a swap the state did not absorb) — the dispatch
+    falls back to the cold per-solve build."""
+    if state is None:
+        return None
+    if tracer is None:
+        return state.plan(cols, pend_rows, k, config)
+    with tracer.span("table_invalidate") as sp:
+        plan = state.plan(cols, pend_rows, k, config)
+        if plan is not None:
+            sp.set(cold=bool(plan["cold"]),
+                   reranked=int(state.last.get("reranked", 0)),
+                   changed=int(state.last.get("changed", 0)))
+    return plan
+
+
 def topk_bucket_for(capT: int):
     """The ONE pending bucket a task capacity of ``capT`` compacts into —
     the largest ladder value at or below capT/4, or None below the
@@ -173,9 +259,18 @@ def session_allocate_config(ssn) -> AllocateConfig:
     )
 
 
-def dispatch_allocate_solve(snap, config, cols=None, guard=None):
+def dispatch_allocate_solve(snap, config, cols=None, guard=None,
+                            warm=False, tracer=None):
     """Shard-or-local solve dispatch; returns (result, mode, topk_info,
     ginfo).
+
+    ``warm=True`` (the allocate action's steady path) lets the compacted
+    program run WARM-STARTED: the [P, K] candidate table carries across
+    cycles on device, invalidated from the resident-scatter delta records
+    and repaired in-program (ops.assignment.warm_allocate_solve) instead
+    of re-ranked from scratch — ``topk_info["warm"]`` records the plan
+    (cold / re-ranked rows / changed nodes).  ``tracer`` attributes the
+    table maintenance as children of the caller's solve_dispatch span.
 
     With a ColumnStore, the ingest-static feature columns ride the
     device-resident cache (columns.resident_features) so per-cycle
@@ -243,7 +338,44 @@ def dispatch_allocate_solve(snap, config, cols=None, guard=None):
         if pend_rows is not None and dict(mesh.shape).get(TASK_AXIS, 1) == 1:
             info = {"k": k, "bucket": int(pend_rows.shape[0])}
             cfg = config._replace(topk=k)
+            wstate = _warm_state(cols, mesh, resolve_impl(impl), config,
+                                 guard, warm, k)
             dev = resident_snap(cols, snap, mesh)
+            wplan = _warm_plan(wstate, cols, pend_rows, k, config, tracer)
+            if wplan is not None:
+                from kube_batch_tpu.parallel.mesh import (
+                    sentinel_sharded_warm_allocate_solve,
+                    sharded_warm_allocate_solve,
+                )
+
+                info["warm"] = dict(wstate.last)
+                cfg_w = config._replace(topk=wplan["w"])
+                ptuple = (wplan["row_map"], wplan["changed"],
+                          wplan["rerank_rows"], wplan["rerank_slots"])
+                if sentinel_on:
+                    res, v, h, e, _t, _er = _warm_commit(
+                        wstate,
+                        lambda: sentinel_sharded_warm_allocate_solve(
+                            dev, pend_rows, wplan["table"], ptuple, cfg_w,
+                            warm_k_min(k), mesh, impl=impl,
+                        ),
+                    )
+                    # ginfo carries the EFFECTIVE config (topk=W): a trip
+                    # bundle replays the cold compacted program at the
+                    # condemned program's own width (the carry itself is
+                    # not replayable — the table is cross-cycle state)
+                    return (res, "sharded", info,
+                            ginfo(engaged + ["topk", "warm"], (v, h, e),
+                                  dev, cfg_w))
+                res, _t, _er = _warm_commit(
+                    wstate,
+                    lambda: sharded_warm_allocate_solve(
+                        dev, pend_rows, wplan["table"], ptuple, cfg_w,
+                        warm_k_min(k), mesh, impl=impl,
+                    ),
+                )
+                return (res, "sharded", info,
+                        ginfo(engaged + ["topk", "warm"], None, dev, cfg_w))
             if sentinel_on:
                 res, v, h, e = sentinel_sharded_allocate_topk_solve(
                     dev, pend_rows, cfg, mesh, impl=impl
@@ -270,7 +402,41 @@ def dispatch_allocate_solve(snap, config, cols=None, guard=None):
     if pend_rows is not None:
         info = {"k": k, "bucket": int(pend_rows.shape[0])}
         cfg = config._replace(topk=k)
+        wstate = _warm_state(cols, None, None, config, guard, warm, k)
         dev = resident_snap(cols, snap)
+        wplan = _warm_plan(wstate, cols, pend_rows, k, config, tracer)
+        if wplan is not None:
+            from kube_batch_tpu.ops.assignment import warm_allocate_solve
+
+            info["warm"] = dict(wstate.last)
+            cfg_w = config._replace(topk=wplan["w"])
+            ptuple = (wplan["row_map"], wplan["changed"],
+                      wplan["rerank_rows"], wplan["rerank_slots"])
+            if sentinel_on:
+                from kube_batch_tpu.ops.invariants import (
+                    warm_allocate_sentinel_solve,
+                )
+
+                res, v, h, e, _t, _er = _warm_commit(
+                    wstate,
+                    lambda: warm_allocate_sentinel_solve(
+                        dev, pend_rows, wplan["table"], ptuple, cfg_w,
+                        warm_k_min(k),
+                    ),
+                )
+                # effective config (topk=W) — see the sharded site
+                return (res, "single", info,
+                        ginfo(engaged + ["topk", "warm"], (v, h, e), dev,
+                              cfg_w))
+            res, _t, _er = _warm_commit(
+                wstate,
+                lambda: warm_allocate_solve(
+                    dev, pend_rows, wplan["table"], ptuple, cfg_w,
+                    warm_k_min(k),
+                ),
+            )
+            return (res, "single", info,
+                    ginfo(engaged + ["topk", "warm"], None, dev, cfg_w))
         if sentinel_on:
             from kube_batch_tpu.ops.invariants import (
                 allocate_topk_sentinel_solve,
@@ -362,6 +528,9 @@ class AllocateAction(Action):
         # {"k", "bucket", "exhausted", "reentries"} when the KB_TOPK
         # compacted program ran, None otherwise (bench/sim evidence)
         self.last_topk = None
+        # warm-carry record ({"cold", "reranked", "changed", ...}) when
+        # the KB_WARM carried-table program ran, None otherwise
+        self.last_warm = None
         # fallback pressure of the most recent execute() (VERDICT r2 #6)
         self.last_fallback: Dict[str, int] = {}
         # jobs whose placements were DISCARDED host-side this execute()
@@ -379,6 +548,7 @@ class AllocateAction(Action):
         self.last_host_discards = 0
         self.last_solve_rounds = 0
         self.last_topk = None
+        self.last_warm = None
         self._host_place_count = 0
         self._n_applied = 0
         self._ports_by_node = None
@@ -432,7 +602,8 @@ class AllocateAction(Action):
         # upload is annotated onto THIS dispatch, not smeared into a p50
         with tracer.device_span("solve_dispatch", cols=cols) as sp_solve:
             result, self.last_solve_mode, topk_info, ginfo = (
-                dispatch_allocate_solve(snap, config, cols=cols, guard=gp)
+                dispatch_allocate_solve(snap, config, cols=cols, guard=gp,
+                                        warm=True, tracer=tracer)
             )
         sp_solve.set(mode=self.last_solve_mode,
                      engaged=list(ginfo["engaged"]))
@@ -478,6 +649,10 @@ class AllocateAction(Action):
                 topk_info, exhausted=int(topk_exh), reentries=int(topk_reent)
             )
         self.last_topk = topk_info
+        # warm-carry record of this execute ({"cold", "reranked",
+        # "changed", "bucket_live", "w"} when the carried-table program
+        # ran, None otherwise) — bench incremental_solve / sim evidence
+        self.last_warm = (topk_info or {}).get("warm")
         assigned = assigned[: meta.n_tasks]
         pipelined = pipelined[: meta.n_tasks]
         if sentinel is not None and not self._consume_sentinel(
@@ -522,15 +697,42 @@ class AllocateAction(Action):
         t_fit0 = telemetry.perf_counter()
         fail_hist_dev = None
         if bool(np.any(pending & (assigned < 0))):
+            # the compacted dispatch's [P] pending bucket covers every
+            # schedulable-pending row, and the histogram is only ever read
+            # at unplaced pending rows — so failure cycles walk [P, N]
+            # instead of [T, N] whenever a bucket exists (ROADMAP standing
+            # item: the PR 10 bucket applies to the histogram verbatim)
+            p_rows = ginfo.get("pend_rows")
             with tracer.device_span("fit_histogram_dispatch"):
                 if self.last_solve_mode == "sharded":
                     from kube_batch_tpu.parallel.mesh import (
-                        default_mesh as _dm, sharded_failure_histogram,
+                        TASK_AXIS as _TA,
+                        default_mesh as _dm,
+                        sharded_failure_histogram,
+                        sharded_failure_histogram_bucket,
                     )
 
                     mesh = _dm()
-                    fail_hist_dev = sharded_failure_histogram(
-                        resident_snap(cols, snap, mesh), mesh
+                    # the bucketed body requires a 1-D node mesh, exactly
+                    # like the compacted solve (which also declined on a
+                    # 2-D grid even though the bucket was planned)
+                    if dict(mesh.shape).get(_TA, 1) != 1:
+                        p_rows = None
+                    if p_rows is not None:
+                        fail_hist_dev = sharded_failure_histogram_bucket(
+                            resident_snap(cols, snap, mesh), p_rows, mesh
+                        )
+                    else:
+                        fail_hist_dev = sharded_failure_histogram(
+                            resident_snap(cols, snap, mesh), mesh
+                        )
+                elif p_rows is not None:
+                    from kube_batch_tpu.ops.assignment import (
+                        failure_histogram_bucket_solve,
+                    )
+
+                    fail_hist_dev = failure_histogram_bucket_solve(
+                        resident_snap(cols, snap), p_rows
                     )
                 else:
                     from kube_batch_tpu.ops.assignment import (

@@ -602,12 +602,16 @@ class TelemetryMisuseRule(Rule):
 _DEVICE_SOURCES = {
     "kube_batch_tpu.ops.assignment.allocate_solve",
     "kube_batch_tpu.ops.assignment.allocate_topk_solve",
+    "kube_batch_tpu.ops.assignment.warm_allocate_solve",
     "kube_batch_tpu.ops.assignment.failure_histogram_solve",
+    "kube_batch_tpu.ops.assignment.failure_histogram_bucket_solve",
     "kube_batch_tpu.ops.eviction.evict_solve",
     "kube_batch_tpu.ops.probe.probe_solve",
     "kube_batch_tpu.parallel.mesh.sharded_allocate_solve",
     "kube_batch_tpu.parallel.mesh.sharded_allocate_topk_solve",
+    "kube_batch_tpu.parallel.mesh.sharded_warm_allocate_solve",
     "kube_batch_tpu.parallel.mesh.sharded_failure_histogram",
+    "kube_batch_tpu.parallel.mesh.sharded_failure_histogram_bucket",
     "kube_batch_tpu.parallel.mesh.sharded_evict_solve",
     "kube_batch_tpu.parallel.mesh.sharded_probe_solve",
     "kube_batch_tpu.api.columns.resident_snap",

@@ -151,6 +151,63 @@ def _build_topk_allocate():
     )
 
 
+#: warm-carry audit shapes: stored width W, changed-node slots, rerank
+#: rung — small audit extents like _T/_N, NOT the dispatch's real sizing
+#: (W = K + WARM_WIDTH_MARGIN there); the traced primitives don't depend
+#: on the extents
+_WARM_W, _WARM_C, _WARM_PI = 2 * _TOPK, 4, 4
+
+
+def _abstract_warm_args(P=_P, W=_WARM_W, C=_WARM_C, Pi=_WARM_PI):
+    """(pend_rows, table×4, plan×4) ShapeDtypeStructs of the warm solve."""
+    import jax.numpy as jnp
+    from jax import ShapeDtypeStruct as S
+
+    return (
+        S((P,), jnp.int32),
+        S((P, W), jnp.int32), S((P, W), jnp.int32), S((P, W), jnp.int32),
+        S((P,), jnp.bool_),
+        S((P,), jnp.int32), S((C,), jnp.int32),
+        S((Pi,), jnp.int32), S((Pi,), jnp.int32),
+    )
+
+
+def _warm_donation() -> Dict[str, Tuple[int, ...]]:
+    # the warm solve donates the stale carried-table buffers into the
+    # refresh everywhere donation is supported; CPU skips it.  Literal
+    # positions (no ops.assignment import — the registry is built before
+    # jax loads): must match ops.assignment.WARM_TABLE_ARGNUMS, which the
+    # warm entry's KBT104 check pins per backend.
+    return {"cpu": (), "*": (2, 3, 4, 5)}
+
+
+def _build_warm_allocate():
+    from kube_batch_tpu.ops.assignment import AllocateConfig, warm_solve_fn
+
+    return warm_solve_fn(), (
+        abstract_snapshot(), *_abstract_warm_args(),
+        AllocateConfig(topk=_WARM_W), _TOPK,
+    )
+
+
+def _build_warm_sentinel():
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.ops.invariants import warm_sentinel_solve_fn
+
+    return warm_sentinel_solve_fn(), (
+        abstract_snapshot(), *_abstract_warm_args(),
+        AllocateConfig(topk=_WARM_W), _TOPK,
+    )
+
+
+def _build_bucket_histogram():
+    from kube_batch_tpu.ops.assignment import failure_histogram_bucket_solve
+
+    return failure_histogram_bucket_solve, (
+        abstract_snapshot(), _abstract_pend_rows(),
+    )
+
+
 def _build_topk_probe():
     """The probe traced with a topk>0 config: the query plane reuses the
     session's AllocateConfig, and the probe's [G, N] head ignores the
@@ -323,8 +380,12 @@ def _build_sentinel_gate():
 REGISTRY: Tuple[EntryPoint, ...] = (
     EntryPoint("ops.assignment.allocate_solve", _build_allocate),
     EntryPoint("ops.assignment.allocate_topk_solve", _build_topk_allocate),
+    EntryPoint("ops.assignment.warm_allocate_solve", _build_warm_allocate,
+               donate=_warm_donation()),
     EntryPoint("ops.assignment.failure_histogram_solve",
                _build_failure_histogram),
+    EntryPoint("ops.assignment.failure_histogram_bucket_solve",
+               _build_bucket_histogram),
     EntryPoint("ops.eviction.evict_solve[reclaim]", _build_evict_reclaim),
     EntryPoint("ops.eviction.evict_solve[preempt]", _build_evict_preempt),
     EntryPoint("api.resident.scatter", _build_resident_scatter,
@@ -340,6 +401,8 @@ REGISTRY: Tuple[EntryPoint, ...] = (
                _build_sentinel_allocate),
     EntryPoint("ops.invariants.allocate_topk_sentinel_solve",
                _build_sentinel_topk),
+    EntryPoint("ops.invariants.warm_allocate_sentinel_solve",
+               _build_warm_sentinel, donate=_warm_donation()),
     EntryPoint("ops.invariants.evict_sentinel_solve[reclaim]",
                lambda: _build_sentinel_evict("reclaim")),
     EntryPoint("ops.invariants.evict_sentinel_solve[preempt]",
@@ -372,6 +435,33 @@ def _build_sharded_topk(mesh, impl):
     from kube_batch_tpu.parallel.mesh import allocate_topk_solve_fn
 
     fn = allocate_topk_solve_fn(mesh, AllocateConfig(topk=_TOPK), impl=impl)
+    return fn, (abstract_snapshot(), _abstract_pend_rows())
+
+
+def _build_sharded_warm(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import warm_allocate_solve_fn
+
+    fn = warm_allocate_solve_fn(
+        mesh, AllocateConfig(topk=_WARM_W), _TOPK, impl=impl)
+    return fn, (abstract_snapshot(), *_abstract_warm_args())
+
+
+def _build_sharded_sentinel_warm(mesh, impl):
+    from kube_batch_tpu.ops.assignment import AllocateConfig
+    from kube_batch_tpu.parallel.mesh import (
+        sentinel_warm_allocate_solve_fn,
+    )
+
+    fn = sentinel_warm_allocate_solve_fn(
+        mesh, AllocateConfig(topk=_WARM_W), _TOPK, impl=impl)
+    return fn, (abstract_snapshot(), *_abstract_warm_args())
+
+
+def _build_sharded_bucket_histogram(mesh, impl):
+    from kube_batch_tpu.parallel.mesh import failure_histogram_bucket_fn
+
+    fn = failure_histogram_bucket_fn(mesh, impl=impl)
     return fn, (abstract_snapshot(), _abstract_pend_rows())
 
 
@@ -500,8 +590,16 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
                        p(_build_sharded_allocate, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_allocate_topk_solve{tag}",
                        p(_build_sharded_topk, mesh, impl)),
+            EntryPoint(f"parallel.mesh.sharded_warm_allocate_solve{tag}",
+                       p(_build_sharded_warm, mesh, impl)),
+            EntryPoint(
+                f"parallel.mesh.sentinel_sharded_warm_allocate_solve{tag}",
+                p(_build_sharded_sentinel_warm, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_failure_histogram{tag}",
                        p(_build_sharded_histogram, mesh, impl)),
+            EntryPoint(
+                f"parallel.mesh.sharded_failure_histogram_bucket{tag}",
+                p(_build_sharded_bucket_histogram, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[reclaim]{tag}",
                        p(_build_sharded_evict, mesh, "reclaim", impl)),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[preempt]{tag}",

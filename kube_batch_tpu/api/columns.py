@@ -285,6 +285,13 @@ class ColumnStore:
         # which path the most recent session row-sync took ("delta"|"full")
         # — surfaced in the bench JSON and the sim's longitudinal report
         self.last_snapshot_path = "full"
+        # warm-started allocate (KB_WARM): carried candidate-table states,
+        # one per (mesh, impl) dispatch slot (api/resident.WarmTableState).
+        # Dropped wholesale on axis growth, resident drops, and mesh
+        # changes — the table's node/task indices must never outlive the
+        # coordinate system they were ranked in (ISSUE 14 satellite: a
+        # reserve()-triggered re-grow must invalidate, never index-shift).
+        self._warm_tables: Dict = {}
 
     # ==================================================================
     # task axis
@@ -373,6 +380,10 @@ class ColumnStore:
         self.t_node = tn
         self.task_by_row.extend([None] * (cap - self.tasks.cap))
         self.tasks.on_grown(cap)
+        # a task-axis re-grow moves the bucket rung the warm allocate
+        # compacts into — drop the carried candidate tables wholesale
+        # rather than index-shift them (plan_topk_bucket lifetime gap)
+        self.drop_warm_tables()
 
     def _fill_sel_bits(self, row: int, task) -> None:
         """Required label pairs → bits (the device predicate's sound
@@ -581,6 +592,9 @@ class ColumnStore:
         self.node_by_row.extend([None] * (cap - self.nodes.cap))
         self.node_names.extend([""] * (cap - self.nodes.cap))
         self.nodes.on_grown(cap)
+        # node-axis growth changes the node-index space the carried
+        # candidate tables rank over — wholesale drop, never index-shift
+        self.drop_warm_tables()
         for row, node in enumerate(self.node_by_row):
             if node is not None:
                 node.idle.vec = self.n_idle[row]
@@ -979,6 +993,11 @@ class ColumnStore:
             # full-uploads once and deltas resume.
             for stale in [k for k in self._per_cycle_dev if k is not mesh]:
                 del self._per_cycle_dev[stale]
+                # the abandoned path's carried candidate tables rank over
+                # the dropped cache's coordinate system — drop with it
+                for wkey in [k for k, st in self._warm_tables.items()
+                             if st.mesh is stale]:
+                    del self._warm_tables[wkey]
             self._per_cycle_dev[mesh] = cache
         guard = self.resident_swap_guard
         if guard is not None:
@@ -987,8 +1006,16 @@ class ColumnStore:
             # excludes probe dispatches for the swap's duration and retires
             # the stale lease on donating backends
             with guard():
-                return cache.swap(snap)
-        return cache.swap(snap)
+                out = cache.swap(snap)
+        else:
+            out = cache.swap(snap)
+        # feed this swap's row-exact delta record to the warm-table carry
+        # (idempotent per cache version — the memoized repeat swap above
+        # re-notifies the same record harmlessly)
+        for st in self._warm_tables.values():
+            if st.mesh is mesh:
+                st.absorb(cache.delta_record, cache.version)
+        return out
 
     def resident_counters(self) -> Dict[str, Dict[str, int]]:
         """Per-path scatter-delta counters ("single" / "sharded") for the
@@ -1005,9 +1032,39 @@ class ColumnStore:
         only when revalidation FAILS; the guard plane calls it on every
         integrity trip (the self-heal for a corrupted resident buffer —
         a static feature column is as corruptible as a per-cycle one, so
-        both caches go)."""
+        both caches go).  The carried warm-allocate candidate tables go
+        with them: they were ranked against the dropped buffers, and a
+        guard heal must not leave a possibly-corrupt ranking behind."""
         self._per_cycle_dev.clear()
         self._dev_cache.clear()
+        self.drop_warm_tables()
+
+    # ---- warm-started allocate: carried candidate tables (KB_WARM) ----
+    def warm_table_state(self, mesh=None, impl=None):
+        """The carried candidate-table state for one (mesh, impl) dispatch
+        slot — created lazily; the state self-resets on shape/config key
+        changes (api/resident.WarmTableState)."""
+        from kube_batch_tpu.api.resident import WarmTableState
+
+        key = (mesh, impl)
+        st = self._warm_tables.get(key)
+        if st is None:
+            st = self._warm_tables[key] = WarmTableState(mesh=mesh,
+                                                         impl=impl)
+        return st
+
+    def drop_warm_tables(self) -> None:
+        """Wholesale drop of every carried candidate table (axis growth,
+        resident drops, guard heals): the next warm dispatch cold-builds."""
+        self._warm_tables.clear()
+
+    def warm_counters(self) -> Dict[str, Dict]:
+        """Per-slot warm-table counters for the bench / sim evidence."""
+        return {
+            f"{'single' if mesh is None else 'sharded'}"
+            f"{'' if impl is None else ':' + impl}": st.counters()
+            for (mesh, impl), st in self._warm_tables.items()
+        }
 
     def revalidate_resident(self, cache) -> Dict:
         """Warm-standby revalidation (leader failover): decide whether the

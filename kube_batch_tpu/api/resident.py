@@ -55,7 +55,7 @@ traced update.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -259,6 +259,13 @@ class PerCycleDeviceCache:
     def __init__(self) -> None:
         self._mirror: Dict[str, np.ndarray] = {}
         self._dev: Dict[str, object] = {}
+        # per-swap delta record: field → changed row indices (np.ndarray)
+        # for a scatter refresh, None for a full upload; clean fields are
+        # absent.  The warm-started allocate's table invalidation
+        # (WarmTableState.absorb) consumes this — the scatter diff already
+        # knows exactly where state moved, so the candidate-table carry
+        # rides the same knowledge instead of re-deriving it.
+        self.delta_record: Dict[str, object] = {}
         # last (input snap, swapped result): the failure-histogram dispatch
         # re-swaps the SAME snap the solve dispatch just synced — a
         # guaranteed all-clean diff over every field, skipped by identity
@@ -312,6 +319,7 @@ class PerCycleDeviceCache:
         ):
             self.full_uploads += 1
             self.bytes_full += host.nbytes
+            self.delta_record[field] = None
             dev = jax.device_put(host)
             # pre-warm EVERY slot-bucket specialization for this (shape,
             # dtype) NOW — an all-out-of-range index vector writes nothing,
@@ -337,6 +345,10 @@ class PerCycleDeviceCache:
         if changed.size == 0:
             self.clean_hits += 1
             return self._dev[field]
+        # the delta is known row-exactly from here down — either path
+        # moves exactly `changed`, which is what the warm-table carry's
+        # invalidation consumes
+        self.delta_record[field] = changed
         slots = _slot_bucket(changed.size, SCATTER_SLOT_BUCKETS)
         if (
             changed.size > SCATTER_SLOTS
@@ -376,6 +388,7 @@ class PerCycleDeviceCache:
         if snap is self._last_in:
             return self._last_out
         self.version += 1
+        self.delta_record = {}
         updates = {
             field: self._refresh(field, np.asarray(getattr(snap, field)))
             for field in PER_CYCLE_FIELDS
@@ -536,6 +549,9 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
         divisible by any power-of-two mesh."""
         sharded_axis = field in NODE_AXIS_FIELDS
         self.full_uploads += 1
+        # a full upload with no recorded row delta invalidates wholesale
+        # (the warm-table carry treats an unrecorded field as all-moved)
+        self.delta_record.setdefault(field, None)
         self.bytes_full += int(
             host.nbytes * (self._host_fraction() if sharded_axis else 1.0)
         )
@@ -581,6 +597,8 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
         if changed.size == 0:
             self.clean_hits += 1
             return self._dev[field]
+        # row-exact delta known from here down (warm-table invalidation)
+        self.delta_record[field] = changed
         if sharded_axis:
             s = host.shape[0] // self.n_shards
             shard_ids = changed // s  # ascending: flatnonzero sorts rows
@@ -634,3 +652,417 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
         self.scatter_updates += 1
         self.bytes_scatter += rows.nbytes + vals.nbytes
         return dev
+
+
+# ==========================================================================
+# Warm-started allocate: the cross-cycle candidate-table planner (KB_WARM)
+# ==========================================================================
+#
+# The device side (ops/assignment.py warm_allocate_solve) carries the
+# [P, W] candidate table between solves; this is the HOST side — the
+# per-row invalidation bookkeeping that turns "what moved since the last
+# solve" into the (row_map, changed_nodes, rerank_rows, rerank_slots)
+# plan the warm program consumes.  The invalidation sources:
+#
+#   per-cycle node columns (ledgers, valid, sched) — the resident scatter
+#     delta records above (``delta_record``): the diff that sizes the
+#     scatter IS the row-exact "these nodes moved" set, absorbed into the
+#     state between solves (multiple swaps per cycle accumulate);
+#   ingest-static features (task requests/bitsets, node allocatable /
+#     label / taint bits) — version-keyed uploads carry no row deltas, so
+#     the state keeps its own mirrors and diffs them at plan time;
+#   a row's own bucket churn — membership/position handled by row_map;
+#   sparse affinity/preference rows — conservatively re-ranked every
+#     cycle (their score/predicate corrections are rebuilt per cycle from
+#     object state, invisible to both sources above);
+#   erosion — the solve's per-row ``eroded`` output (θ-cut rows whose
+#     valid prefix fell below the nominal K) re-ranks next cycle.
+#
+# Any wholesale movement (full upload, version gap, shape change, config
+# change) escalates to a COLD plan: every live bucket row re-ranks, which
+# is the carry's self-rebuild — bit-exact like every other path.
+
+#: changed-node slot rungs of the warm merge's fresh [P, C] block —
+#: coarse ×8 steps (the scatter-slot discipline) so steady churn cannot
+#: flap a shape boundary; churn past the top rung escalates to cold
+WARM_CHANGED_BUCKETS: Tuple[int, ...] = (64, 512, 4096)
+
+#: stored-width margin: the carried table keeps W = K + margin entries so
+#: θ/φ-cut erosion rarely reaches the nominal K before the re-rank
+#: catches up.  Additive, not multiplicative: every extraction step of
+#: the re-rank build costs ~the same regardless of M, so doubling W would
+#: double the one genuinely extraction-bound piece of a warm cycle
+WARM_WIDTH_MARGIN = 16
+
+
+def warm_rerank_rungs(P: int) -> Tuple[int, ...]:
+    """The sub-bucket rungs for a [P] pending bucket — ×2 steps from 128
+    up to P (always ending at P).  Shared by the invalidated-row re-rank
+    rung and the merge rung (the [M] live prefix the table refresh
+    operates on — padding rows past the live count pay nothing).  The
+    ratchets make each rung a one-time compile, so the finer ladder buys
+    tighter compute without steady-state retrace risk."""
+    out = []
+    v = min(128, P)
+    while v < P:
+        out.append(v)
+        v = min(v * 2, P)
+    out.append(P)
+    return tuple(out)
+
+
+def _rung(n: int, rungs: Tuple[int, ...]) -> int:
+    for r in rungs:
+        if n <= r:
+            return r
+    return rungs[-1]
+
+
+#: consecutive under-rung plans before a ratcheted rung drops back to fit
+WARM_RUNG_DECAY = 3
+
+
+def _ratchet(current: int, needed: int, low_streak: int, floor: int = 0):
+    """Sticky rung with hysteresis decay: grow immediately, drop straight
+    to the needed rung after WARM_RUNG_DECAY consecutive plans that
+    needed less — a burst pins its rung only until the regime provably
+    ended, so steady cycles stop paying burst-sized compute.  Every rung
+    ever visited stays in the jit cache, so later oscillation between
+    known rungs compiles nothing; the hysteresis only bounds how many
+    DISTINCT rungs a noisy workload visits.  Returns (rung, streak')."""
+    if needed >= current:
+        return needed, 0
+    low_streak += 1
+    if low_streak < WARM_RUNG_DECAY:
+        return current, low_streak
+    return max(needed, floor), 0
+
+
+class WarmTableState:
+    """One solve path's carried candidate table + invalidation planner.
+
+    Owned by the ColumnStore (one per (mesh, impl) dispatch slot — see
+    ``ColumnStore.warm_table_state``); dropped wholesale on axis growth,
+    resident-cache drops (guard heals), and mesh changes, so a carried
+    table can never outlive the coordinate system its indices live in."""
+
+    #: per-cycle snapshot fields whose row deltas invalidate node keys
+    NODE_DELTA_FIELDS = (
+        "node_idle", "node_releasing", "node_used", "node_valid",
+        "node_sched",
+    )
+
+    def __init__(self, mesh=None, impl=None):
+        self.mesh = mesh
+        self.impl = impl
+        self._reset()
+        # lifetime counters (bench incremental_solve / sim evidence)
+        self.plans = 0
+        self.cold_builds = 0
+        self.reranked_total = 0
+        self.changed_total = 0
+
+    def _reset(self) -> None:
+        self.shape_key = None       # (P, W, capN, capT, config)
+        self.rows: Optional[np.ndarray] = None
+        self.table = None           # (idx, skey, hash, trunc) device
+        self.eroded_dev = None
+        self._changed: Optional[np.ndarray] = None  # np bool [capN]
+        self._node_full = True
+        self._absorbed_version = -1
+        self._consumed_version = -1
+        self._t_mirror: Optional[Dict[str, np.ndarray]] = None
+        self._n_mirror: Optional[Dict[str, np.ndarray]] = None
+        self._t_feat_ver = -1   # mirror-diff short circuits (see plan)
+        self._n_feat_ver = -1
+        # sticky rung ratchets (the TOPK bucket-ratchet discipline): a
+        # rung, once visited, stays — churn oscillating across a rung
+        # boundary must not retrace every other steady cycle.  The
+        # rerank ratchet excludes the top (=P, cold-plan) rung: pinning
+        # it would make every later merge cycle pay a cold-sized build.
+        # The merge rung additionally may only decay down to the PREVIOUS
+        # bucket's live count: carried row_map values index old live
+        # slots, which must stay inside the sliced prefix.
+        self._c_rung = 0
+        self._r_rung = 0
+        self._m_rung = 0
+        self._c_low = 0   # consecutive plans under the current rung
+        self._r_low = 0
+        self._m_low = 0
+        self.last: Dict = {}
+
+    # ------------------------------------------------------------------
+    def absorb(self, record: Dict, version: int) -> None:
+        """Fold one resident swap's delta record into the pending
+        invalidation (called from ColumnStore.per_cycle_resident after
+        every swap of this state's mesh path).  A version the planner has
+        already CONSUMED is skipped — the same cycle's later dispatches
+        (the failure-histogram re-swap is memoized at the same version)
+        re-notify the same record, and re-marking it after plan() cleared
+        the accumulators would double every delta into the next merge."""
+        if version <= self._consumed_version:
+            return
+        for field in self.NODE_DELTA_FIELDS:
+            if field not in record:
+                continue
+            rows = record[field]
+            if rows is None:
+                self._node_full = True
+            elif self._changed is not None:
+                if rows.size and rows[-1] < self._changed.shape[0]:
+                    self._changed[rows] = True
+                else:
+                    self._node_full = True  # shape drift — cold
+        self._absorbed_version = version
+
+    # ------------------------------------------------------------------
+    def _ensure(self, key, cols) -> None:
+        if key != self.shape_key:
+            self._reset()
+            self.shape_key = key
+
+    def _diff_mirror(self, mirror_slot: str, ver_slot: str, version: int,
+                     sources) -> np.ndarray:
+        """Union of changed-row masks across the named ColumnStore arrays
+        (ingest-static features carry no scatter deltas — the state keeps
+        its own mirrors).  Returns a bool mask over the axis; a shape
+        change (bitset width growth, axis growth) reads as all-changed.
+        Short-circuits on the ColumnStore's per-axis feature VERSION (the
+        resident_features upload-cache key): an unmoved version means no
+        ingest-static column changed, so the megabytes of copy+compare
+        are skipped on every steady cycle."""
+        mirror = getattr(self, mirror_slot)
+        n = sources[0][1].shape[0]
+        if mirror is not None and getattr(self, ver_slot) == version:
+            return np.zeros(n, bool)
+        out = np.zeros(n, bool)
+        fresh = {}
+        for name, arr in sources:
+            fresh[name] = arr.copy()
+            if mirror is None:
+                out[:] = True
+                continue
+            old = mirror.get(name)
+            if old is None or old.shape != arr.shape:
+                out[:] = True
+                continue
+            if arr.ndim == 1:
+                out |= old != arr
+            else:
+                out |= np.any(old != arr, axis=1)
+        setattr(self, mirror_slot, fresh)
+        setattr(self, ver_slot, version)
+        return out
+
+    # ------------------------------------------------------------------
+    def plan(self, cols, pend_rows: np.ndarray, k: int,
+             config) -> Optional[Dict]:
+        """The per-solve invalidation plan, or None when warm cannot run
+        this cycle (no per-cycle resident cache, or a swap this state did
+        not absorb — both mean the delta chain is broken).
+
+        Returns {"row_map", "changed", "rerank_rows", "rerank_slots",
+        "table", "w", "cold"} — numpy plan arrays, the carried (or
+        freshly zeroed) table, and the stored width."""
+        cache = cols._per_cycle_dev.get(self.mesh)
+        if cache is None or cache.version != self._absorbed_version:
+            return None
+        P = int(pend_rows.shape[0])
+        capN = cols.nodes.cap
+        capT = cols.tasks.cap
+        W = k + WARM_WIDTH_MARGIN
+        key = (P, W, capN, capT, config)
+        self._ensure(key, cols)
+        self.plans += 1
+        if self._changed is None:
+            self._changed = np.zeros(capN, bool)
+
+        new_live = pend_rows[pend_rows >= 0]
+        # ---- ingest-static feature diffs (no scatter deltas to ride) --
+        task_dirty = self._diff_mirror(
+            "_t_mirror", "_t_feat_ver", cols.task_feature_version, (
+                ("t_init32", cols.t_init32),
+                ("t_sel_bits", cols.t_sel_bits),
+                ("t_sel_impossible", cols.t_sel_impossible),
+                ("t_tol_bits", cols.t_tol_bits),
+            ))
+        node_feat_dirty = self._diff_mirror(
+            "_n_mirror", "_n_feat_ver", cols.node_feature_version, (
+                ("n_alloc32", cols.n_alloc32),
+                ("n_label_bits", cols.n_label_bits),
+                ("n_taint_bits", cols.n_taint_bits),
+            ))
+
+        # C rungs past the node capacity would make the fresh block wider
+        # than the cold build it replaces — they escalate to cold instead
+        c_rungs = tuple(
+            r for r in WARM_CHANGED_BUCKETS if r < capN
+        ) or (WARM_CHANGED_BUCKETS[0],)
+        cold = (
+            self.table is None
+            or self.rows is None
+            or self._node_full
+            or bool(node_feat_dirty.all())
+        )
+        changed_mask = self._changed
+        if not cold:
+            changed_mask = changed_mask | node_feat_dirty
+            n_changed = int(changed_mask.sum())
+            if n_changed > min(c_rungs[-1], capN - 1):
+                cold = True
+
+        rerank_mask = np.zeros(P, bool)
+        n_live = int(new_live.size)
+        rungs = warm_rerank_rungs(P)
+        # the merge rung: the [M] live prefix the device-side refresh
+        # slices to (row_map's length IS the rung) — ratcheted with decay;
+        # the decay floor covers the PREVIOUS bucket's live count so
+        # carried old-slot indices always stay inside the prefix
+        old_live = (
+            int((self.rows >= 0).sum()) if self.rows is not None else 0
+        )
+        m_need = _rung(max(n_live, old_live, 1), rungs)
+        self._m_rung, self._m_low = _ratchet(
+            self._m_rung, m_need, self._m_low
+        )
+        m_rung = self._m_rung
+        n_new = n_dirty = n_eroded = 0
+        if cold:
+            self.cold_builds += 1
+            row_map = np.full(m_rung, -1, np.int32)
+            rerank_mask[:n_live] = True
+            changed = np.full(max(self._c_rung, c_rungs[0]), -1, np.int32)
+            n_changed = 0
+        else:
+            # ---- bucket permutation (old slot per new slot) ----------
+            old_live = self.rows[self.rows >= 0]
+            pos = np.searchsorted(old_live, new_live)
+            safe = np.minimum(pos, max(old_live.size - 1, 0))
+            carried = (
+                (pos < old_live.size) & (old_live[safe] == new_live)
+                if old_live.size else np.zeros(n_live, bool)
+            )
+            row_map = np.full(m_rung, -1, np.int32)
+            row_map[:n_live][carried] = pos[carried].astype(np.int32)
+            # ---- the re-rank set -------------------------------------
+            rerank_mask[:n_live] = ~carried                 # new rows
+            n_new = int(np.sum(~carried))
+            rerank_mask[:n_live] |= task_dirty[new_live]    # own features
+            n_dirty = int(np.sum(task_dirty[new_live]))
+            sparse = cols._aff_rows | cols._pref_rows       # conservative
+            if sparse:
+                rerank_mask[:n_live] |= np.isin(
+                    new_live, np.fromiter(sparse, np.int64)
+                )
+            if self.eroded_dev is not None:
+                # kbt: allow[KBT010] tiny [P]-bool readback of LAST cycle's
+                # erosion flags at plan time — long since computed, so the
+                # sync is free; riding the action readback would thread
+                # warm state through every consumer for no transfer win
+                eroded = np.asarray(self.eroded_dev)
+                er_rows = self.rows[np.flatnonzero(eroded)]
+                er_rows = er_rows[er_rows >= 0]
+                n_eroded = int(er_rows.size)
+                if er_rows.size:
+                    # SPARE-FILL budget: erosion refresh only occupies the
+                    # re-rank rung's padding slots, never grows the rung —
+                    # the mandatory set (new/dirty rows) prices the rung,
+                    # and refreshing eroded rows inside it is free compute.
+                    # Deferred rows stay EXACT (a thin table answers via
+                    # the prefix/exhaustion contract) and retry next cycle.
+                    base = int(rerank_mask.sum())
+                    spare = _rung(max(base, 1), warm_rerank_rungs(P)) - base
+                    if spare > 0:
+                        admit = np.isin(new_live, er_rows)
+                        admit &= ~rerank_mask[:n_live]
+                        extra = np.flatnonzero(admit)[:spare]
+                        rerank_mask[extra] = True
+            # changed-node list at its (ratcheted, decaying) rung
+            ch_rows = np.flatnonzero(changed_mask)
+            n_changed = int(ch_rows.size)
+            self._c_rung, self._c_low = _ratchet(
+                self._c_rung, _rung(max(n_changed, 1), c_rungs),
+                self._c_low, floor=c_rungs[0],
+            )
+            changed = np.full(self._c_rung, -1, np.int32)
+            changed[:n_changed] = ch_rows.astype(np.int32)
+
+        n_rerank = int(rerank_mask.sum())
+        rrung = _rung(max(n_rerank, 1), rungs)
+        if rrung < P:
+            # sub-P rungs ratchet with decay; a cold-sized rung (=P)
+            # never pins the ratchet
+            self._r_rung, self._r_low = _ratchet(
+                self._r_rung, rrung, self._r_low, floor=rungs[0]
+            )
+            rrung = min(self._r_rung, m_rung)
+        rerank_slots = np.full(rrung, -1, np.int32)
+        slots = np.flatnonzero(rerank_mask)
+        rerank_slots[:n_rerank] = slots.astype(np.int32)
+        rerank_rows = np.full(rrung, -1, np.int32)
+        rerank_rows[:n_rerank] = pend_rows[slots]
+
+        table = self.table
+        if table is None:
+            table = self._init_table(P, W)
+        # plan consumed: clear the accumulators (and mark the consumed
+        # swap version so same-version re-notifies can't re-mark them);
+        # the next swaps rebuild
+        self._changed = np.zeros(capN, bool)
+        self._node_full = False
+        self._consumed_version = self._absorbed_version
+        self.rows = pend_rows.copy()
+        self.reranked_total += n_rerank
+        self.changed_total += n_changed
+        self.last = {
+            "cold": cold, "reranked": n_rerank, "changed": n_changed,
+            "bucket_live": n_live, "w": W,
+            # re-rank attribution (bench/sim evidence): fresh bucket rows,
+            # rows whose own features moved, θ/φ-eroded rows
+            "new": n_new, "dirty": n_dirty, "eroded": n_eroded,
+        }
+        return {
+            "row_map": row_map, "changed": changed,
+            "rerank_rows": rerank_rows, "rerank_slots": rerank_slots,
+            "table": table, "w": W, "cold": cold,
+        }
+
+    def _init_table(self, P: int, W: int):
+        import jax
+        import jax.numpy as jnp
+
+        idx = np.zeros((P, W), np.int32)
+        skey = np.full((P, W), -(2 ** 31), np.int32)
+        hsh = np.full((P, W), -1, np.int32)
+        trunc = np.zeros(P, bool)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P_
+
+            repl = NamedSharding(self.mesh, P_())
+            return tuple(
+                jax.device_put(a, repl) for a in (idx, skey, hsh, trunc)
+            )
+        return tuple(map(jnp.asarray, (idx, skey, hsh, trunc)))
+
+    def commit(self, table, eroded) -> None:
+        """Adopt the refreshed table + erosion flags the solve returned
+        (the stale buffers were donated into the refresh off-CPU)."""
+        self.table = table
+        self.eroded_dev = eroded
+
+    def drop(self) -> None:
+        """Abandon the carry (next plan cold-builds).  The dispatch calls
+        this when a warm solve raises between plan() and commit():
+        plan() already consumed the invalidation accumulators and — off
+        CPU — the solve donated the table buffers, so carrying on would
+        pair a stale (or deleted) table with the new bucket order."""
+        self._reset()
+
+    def counters(self) -> Dict:
+        return {
+            "plans": self.plans,
+            "cold_builds": self.cold_builds,
+            "reranked_total": self.reranked_total,
+            "changed_total": self.changed_total,
+            "last": dict(self.last),
+        }

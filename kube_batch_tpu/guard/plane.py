@@ -48,8 +48,11 @@ logger = logging.getLogger("kube_batch_tpu")
 
 #: the demotable fast paths — each has a per-dispatch oracle knob the
 #: demotion flips (actions/allocate.py dispatch + parallel/mesh.py impl
-#: selection + the session's use_pallas flag)
-FAST_PATHS = ("topk", "shard_map", "pallas")
+#: selection + the session's use_pallas flag).  "warm" is the carried
+#: candidate-table path (KB_WARM): demotion pins the compacted solve to
+#: its cold per-solve build, and the trip heal drops the carried table
+#: with the resident caches (ColumnStore.drop_resident)
+FAST_PATHS = ("topk", "shard_map", "pallas", "warm")
 
 HEALTHY, DEMOTED, PROBING = "healthy", "demoted", "probing"
 

@@ -683,6 +683,90 @@ def lex_topk(skey: jnp.ndarray, hash_: jnp.ndarray, idx0: jnp.ndarray,
     return oi, os, oh
 
 
+def lex_topk3(skey: jnp.ndarray, hash_: jnp.ndarray, idx: jnp.ndarray,
+              K: int, block: int = 64):
+    """Exact per-row top-K of (skey desc, hash desc, **idx asc**) with the
+    index as an EXPLICIT third key — :func:`lex_topk` generalized past its
+    positional-tie assumption (it breaks full ties by input POSITION,
+    which equals the index order only when the caller's columns are
+    index-sorted).  The warm-table merge concatenates a carried table with
+    a fresh changed-node block, neither index-contiguous — pre-sorting by
+    index would cost a [P, W+C] comparator sort per solve (XLA's CPU sort
+    is ~50× a reduction pass — the very cost lex_topk exists to avoid),
+    so the tournament carries the index and reduces it with a min.
+
+    Requires per-row-unique indices among valid entries (the merge
+    guarantees it: stored nodes are distinct and changed stored entries
+    are removed before their fresh versions join).  Returns ``(idx, skey,
+    hash)`` [P, K] in descending lex order."""
+    P, M = skey.shape
+    C = min(block, M)
+    Mp = -(-M // C) * C
+    pad = Mp - M
+    if pad:
+        skey = jnp.pad(skey, ((0, 0), (0, pad)), constant_values=-(2 ** 31))
+        hash_ = jnp.pad(hash_, ((0, 0), (0, pad)), constant_values=-1)
+        idx = jnp.pad(idx, ((0, 0), (0, pad)),
+                      constant_values=(1 << 30))
+    B = Mp // C
+    s3 = skey.reshape(P, B, C)
+    h3 = hash_.reshape(P, B, C)
+    i3 = idx.reshape(P, B, C)
+    BIG = jnp.int32(1 << 30)
+
+    def block_reduce(s, h, i):
+        bval = jnp.max(s, axis=-1)
+        t1 = s >= bval[..., None]
+        bh = jnp.max(jnp.where(t1, h, -2), axis=-1)
+        t2 = t1 & (h == bh[..., None])
+        bidx = jnp.min(jnp.where(t2, i, BIG), axis=-1)
+        return bval, bh, bidx
+
+    bval, bh, bidx = block_reduce(s3, h3, i3)
+    barange = jnp.arange(B, dtype=jnp.int32)[None, :]
+
+    def step(k, state):
+        bval, bh, bidx, oi, os, oh = state
+        gv = jnp.max(bval, axis=1)
+        t1 = bval >= gv[:, None]
+        ghv = jnp.max(jnp.where(t1, bh, -2), axis=1)
+        t2 = t1 & (bh == ghv[:, None])
+        gidx = jnp.min(jnp.where(t2, bidx, BIG), axis=1)
+        # indices are per-row unique → exactly one block holds the winner
+        gb = jnp.argmax(t2 & (bidx == gidx[:, None]), axis=1).astype(
+            jnp.int32
+        )
+        oi = jax.lax.dynamic_update_slice(oi, gidx[:, None], (0, k))
+        os = jax.lax.dynamic_update_slice(os, gv[:, None], (0, k))
+        oh = jax.lax.dynamic_update_slice(oh, ghv[:, None], (0, k))
+        # gather ONLY the winning block, re-reduce it under the extracted
+        # threshold (keep entries strictly lex-below (gv, ghv, gidx)), and
+        # fold the fresh triple back with a broadcast select over the
+        # [P, B] stats — per-step work stays O(P·C), and no .at scatter
+        # (XLA CPU scatters serialize per row and dominated the step)
+        cols_ = (gb * C)[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+        gs = jnp.take_along_axis(skey, cols_, 1)
+        gh2 = jnp.take_along_axis(hash_, cols_, 1)
+        gi2 = jnp.take_along_axis(idx, cols_, 1)
+        keep = (gs < gv[:, None]) | ((gs == gv[:, None]) & (
+            (gh2 < ghv[:, None])
+            | ((gh2 == ghv[:, None]) & (gi2 > gidx[:, None]))))
+        nv, nh, ni = block_reduce(
+            jnp.where(keep, gs, _I32_MIN)[:, None, :],
+            gh2[:, None, :], gi2[:, None, :],
+        )
+        win = barange == gb[:, None]
+        bval = jnp.where(win, nv, bval)
+        bh = jnp.where(win, nh, bh)
+        bidx = jnp.where(win, ni, bidx)
+        return bval, bh, bidx, oi, os, oh
+
+    init = (bval, bh, bidx, jnp.zeros((P, K), jnp.int32),
+            jnp.full((P, K), _I32_MIN), jnp.full((P, K), -1, jnp.int32))
+    *_, oi, os, oh = jax.lax.fori_loop(0, K, step, init)
+    return oi, os, oh
+
+
 def _remap_rows(sparse_idx: jnp.ndarray, pend_rows: jnp.ndarray) -> jnp.ndarray:
     """Map sparse per-task row indices (affinity/preference corrections)
     into pending-bucket slots; rows outside the bucket park at -1 (their
@@ -925,9 +1009,459 @@ def allocate_topk_solve(snap: DeviceSnapshot, pend_rows: jnp.ndarray,
     return scatter_bucket_result(res, pend_rows, T)
 
 
+# ==========================================================================
+# Warm-started incremental allocate (KB_WARM) — the cross-cycle candidate
+# table carry + assignment repair
+# ==========================================================================
+#
+# KB_TOPK made the ROUNDS O(P·K), but the candidate-table BUILD still
+# re-ranks every bucket row against every node once per solve — the last
+# O(P·N) cost in the cycle's dominant phase.  The warm path promotes the
+# table to a PERSISTENT cross-cycle structure: the dispatch carries the
+# [P, W] table on device between solves and each cycle only
+#
+#   re-ranks the INVALIDATED rows  (new/bucket-shifted rows, rows whose
+#     own task features moved, eroded rows — a sub-bucket
+#     compact_candidates at a fixed rung, not [P, N]);
+#   merges the CHANGED NODES' fresh keys ([P, C] — C = the node rows the
+#     resident scatter deltas moved since the last solve) into every
+#     carried row.
+#
+# Exactness (why the carried table keeps the compact-head invariant —
+# "exact descending lex prefix of the currently-cycle-start-feasible
+# nodes"):
+#
+#   INV: every node ABSENT from a row's valid entries either changed since
+#   the last refresh (so its fresh key is in this merge), or its key —
+#   unchanged, because ALL of its key inputs are unchanged — is lex-BELOW
+#   the row's last valid entry θ.
+#
+#   The merge removes the changed nodes' stale entries, inserts their
+#   fresh keys, re-extracts the top W, and CUTS every merged entry that
+#   falls lex-below θ: above θ the merged set provably contains every
+#   node (unchanged ones were already stored; changed ones are fresh), so
+#   the kept prefix is the exact current top-J — and the cut re-
+#   establishes INV for the next cycle (cut entries are ≥ the extraction's
+#   dropped ones, so everything absent is below the new θ).  A cut or an
+#   extraction overflow marks the row TRUNCATED; a truncated row whose
+#   valid entries all die in-round re-enters the full-matrix head the
+#   SAME round (the KB_TOPK fallback, with the [P, N] planes computed
+#   lazily inside the cond), so bit-exactness never depends on the table
+#   being deep — only on it being an exact prefix.  Rows whose prefix
+#   erodes below the nominal K report in the `eroded` output and the host
+#   planner re-ranks them next cycle.
+#
+#   Cross-cycle soundness rides on the same two facts as KB_TOPK: budgets
+#   only SHRINK within a solve (the table stays an upper bound all
+#   rounds), and between solves state moves only at rows the resident
+#   scatters (api/resident.py) know about — which is exactly where the
+#   invalidation comes from.  KB_WARM=0 keeps the per-solve cold build as
+#   the bit-exactness oracle, same contract as KB_TOPK=0 / KB_SHARD_MAP=0.
+
+
+def node_view(snap: DeviceSnapshot, node_rows: jnp.ndarray) -> DeviceSnapshot:
+    """``snap`` with the node axis gathered to ``node_rows`` (-1 padding →
+    dead columns: node_valid forced off so static predicates fail).  The
+    per-element contract of the shard_map block view, applied to an
+    arbitrary node subset: every live column of the view equals the same
+    column of the full matrices, which is what makes the warm merge's
+    fresh [P, C] keys bit-equal to a full rebuild's."""
+    N = snap.node_idle.shape[0]
+    safe = jnp.clip(node_rows, 0, N - 1)
+    live = node_rows >= 0
+
+    def g(arr):
+        return arr[safe]
+
+    def g1(arr):  # [K?, N] sparse rows — node axis is axis 1
+        return arr[:, safe]
+
+    return snap._replace(
+        node_idle=g(snap.node_idle),
+        node_releasing=g(snap.node_releasing),
+        node_used=g(snap.node_used),
+        node_alloc=g(snap.node_alloc),
+        node_valid=g(snap.node_valid) & live,
+        node_sched=g(snap.node_sched),
+        node_label_bits=g(snap.node_label_bits),
+        node_taint_bits=g(snap.node_taint_bits),
+        task_aff_mask=g1(snap.task_aff_mask),
+        task_pref_node=g1(snap.task_pref_node),
+        task_pref_pod=g1(snap.task_pref_pod),
+    )
+
+
+def fresh_block_skey(view_pc: DeviceSnapshot, quanta: jnp.ndarray,
+                     config: AllocateConfig) -> jnp.ndarray:
+    """[P, C] sort keys of the changed-node columns at the CURRENT
+    cycle-start budgets — exactly ``compact_candidates``' key derivation
+    restricted to a node subset (``view_pc`` = the pend view node-gathered
+    at the changed rows).  The zero-releasing skip mirrors the shard_map
+    block head's per-block test: exact for solver-pending rows either
+    way (see local_round_head)."""
+    static_ok = static_predicates(view_pc)
+    score = score_matrix(view_pc, config.weights)
+    score_static = jnp.where(static_ok, score, NEG)
+    fit0 = fits(view_pc.task_req, view_pc.node_idle, quanta)
+    fit0_rel = jax.lax.cond(
+        jnp.any(view_pc.node_releasing > 0.0),
+        lambda rel: fits(view_pc.task_req, rel, quanta),
+        lambda rel: jnp.zeros_like(fit0),
+        view_pc.node_releasing,
+    )
+    return f32_sort_key(jnp.where(fit0 | fit0_rel, score_static, NEG))
+
+
+#: fresh candidates inserted per row per merge — rows where more changed
+#: nodes belong in the top-W are φ-cut: still EXACT (the cut re-founds
+#: the prefix invariant and marks the row truncated), just thinner, and
+#: the spare-fill refresh budget re-ranks them on rung padding slots.
+#: E prices the merge's tournament (its extraction steps are the merge's
+#: dominant cost at CPU dispatch granularity), so it is sized to the
+#: steady-state insertion rate (~W·C/N), not the burst worst case
+FRESH_E = 8
+
+
+def _lex_ge(s, h, i, ts, th, ti):
+    """Entry (s, h, i) lex-at-or-above threshold (ts, th, ti) under the
+    table order (skey desc, hash desc, idx asc)."""
+    return (s > ts) | ((s == ts) & ((h > th) | ((h == th) & (i <= ti))))
+
+
+def warm_refresh_table(t_idx, t_skey, t_hash, t_trunc, row_map, rows_m,
+                       changed_nodes, skey_c, hash_c,
+                       ri, rs, rh, trunc_i, rerank_slots,
+                       N: int, k_min: int):
+    """One cycle's table maintenance, in exact integer arithmetic over the
+    [M] live prefix (M = ``row_map``'s length — the merge rung; rows past
+    M are bucket padding and stay empty by induction): permute the carried
+    table into the new bucket order (``row_map`` — old slot per new slot,
+    -1 = fresh row), remove the changed nodes' stale entries, INSERT their
+    fresh keys, θ/φ-cut, and overwrite the re-ranked sub-bucket's rows
+    with their fresh [Pi, W] builds at ``rerank_slots``.
+
+    The insert is a COUNTING merge, not a re-extraction: only the top
+    FRESH_E fresh candidates per row are ranked (a short tournament over
+    [M, C]), each surviving entry's merged position is a comparison count
+    (kept-stored are already sorted; [M, W, E] lex compares rank both
+    sides), and two rank-scatters place everything — per-solve cost is
+    O(E) extraction steps instead of O(W), which is what lets a warm
+    cycle undercut the cold build's K-step extraction at all.  Exactness:
+    fresh candidates beyond the top E are all lex-below the E-th extracted
+    key φ (a strict bound — indices are unique), so cutting the merged
+    table at lexmax(θ, φ) keeps it an exact prefix; cut rows mark
+    truncated and the erosion flag re-ranks them next cycle.
+
+    Returns ``(idx, skey, hash, trunc, eroded)`` — the refreshed FULL
+    [P, W] table (rows past M carried through untouched) plus the [P]
+    erosion flag (truncated AND fewer than ``k_min`` valid entries)."""
+    P, W = t_skey.shape
+    M = row_map.shape[0]
+    E = FRESH_E
+    neg = _neg_key()
+    BIG = jnp.int32(1 << 30)
+    # ---- 1. permute the live prefix into the new bucket order --------
+    live = row_map >= 0
+    safe = jnp.clip(row_map, 0, M - 1)
+    idx = jnp.where(live[:, None], t_idx[:M][safe], 0)
+    skey = jnp.where(live[:, None], t_skey[:M][safe], _I32_MIN)
+    hsh = jnp.where(live[:, None], t_hash[:M][safe], -1)
+    # a fresh (carried-in) row starts TRUNCATED: its empty table claims
+    # nothing, so until the re-rank overwrite below fills it, the head
+    # must treat it as incomplete (exhaustion-fallback territory) — the
+    # planner always re-ranks fresh rows, but correctness must not
+    # depend on that scheduling
+    trunc = jnp.where(live, t_trunc[:M][safe], True)
+    # ---- 2. θ per row: the last valid entry, PRE-removal -------------
+    valid = skey > neg
+    vcnt = jnp.sum(valid, axis=1, dtype=jnp.int32)
+    last = jnp.clip(vcnt - 1, 0, W - 1)[:, None]
+    has_any = vcnt > 0
+    th_s = jnp.where(has_any, jnp.take_along_axis(skey, last, 1)[:, 0], neg)
+    th_h = jnp.where(
+        has_any, jnp.take_along_axis(hsh, last, 1)[:, 0],
+        jnp.int32(2 ** 31 - 1),
+    )
+    th_i = jnp.where(
+        has_any, jnp.take_along_axis(idx, last, 1)[:, 0], jnp.int32(-1)
+    )
+    # ---- 3. remove the changed nodes' stale entries ------------------
+    changed_mask = jnp.zeros(N + 1, bool).at[
+        jnp.where(changed_nodes >= 0, changed_nodes, N)
+    ].set(True, mode="drop")[:N]
+    keep = valid & ~changed_mask[jnp.clip(idx, 0, N - 1)]
+    skey = jnp.where(keep, skey, _I32_MIN)
+    # ---- 4. top-E of the fresh block (short tournament) --------------
+    C = changed_nodes.shape[0]
+    idx_c = jnp.broadcast_to(changed_nodes[None, :], (M, C))
+    fresh_ok = (changed_nodes >= 0)[None, :] & (skey_c > neg)
+    fi, fs, fh = lex_topk3(
+        jnp.where(fresh_ok, skey_c, _I32_MIN), hash_c, idx_c, E
+    )
+    f_valid = fs > neg
+    # φ: the E-th extracted fresh key — every non-extracted fresh
+    # candidate is strictly lex-below it (indices unique)
+    phi_live = f_valid[:, E - 1]
+    ph_s, ph_h, ph_i = fs[:, E - 1], fh[:, E - 1], fi[:, E - 1]
+    # ---- 5. gather-based two-sorted-list merge -----------------------
+    # kept-stored entries keep their relative (sorted) order and the
+    # fresh top-E is sorted by extraction; merged output j = lexmax of
+    # the two heads after consuming j entries.  Everything is gathers +
+    # small broadcast counts — XLA CPU scatters serialize per row and
+    # dominated the first (rank-scatter) formulation of this merge.
+    kp = jnp.cumsum(keep.astype(jnp.int32), axis=1) - keep
+    kept_cnt = jnp.sum(keep, axis=1, dtype=jnp.int32)
+    jcols = jnp.arange(W, dtype=jnp.int32)[None, :]
+    # position (in stored-entry coordinates) of the j-th KEPT entry — one
+    # [M, W+1] inverse scatter instead of a [M, W, W] compare+argmax
+    kth_kept = jnp.zeros((M, W + 1), jnp.int32).at[
+        jnp.arange(M)[:, None], jnp.where(keep, kp, W)
+    ].set(
+        jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (M, W)),
+        mode="drop",
+    )[:, :W]                                             # [M, W]
+    # fresh rank of each top-E entry among the merged output: its own
+    # position + kept-stored entries lex-above it
+    gt = _lex_ge(          # stored strictly above fresh (no equal keys)
+        skey[:, :, None], hsh[:, :, None], idx[:, :, None],
+        fs[:, None, :], fh[:, None, :], fi[:, None, :],
+    )
+    fresh_rank = jnp.arange(E, dtype=jnp.int32)[None, :] + jnp.sum(
+        gt & keep[:, :, None], axis=1, dtype=jnp.int32
+    )
+    # fresh entries consumed before output j → the two head cursors
+    b = jnp.sum(
+        f_valid[:, None, :] & (fresh_rank[:, None, :] < jcols[:, :, None]),
+        axis=2, dtype=jnp.int32,
+    )                                                    # [M, W]
+    a = jcols - b
+
+    def g(arr, cur, ok, fill):
+        v = jnp.take_along_axis(arr, jnp.clip(cur, 0, arr.shape[1] - 1), 1)
+        return jnp.where(ok, v, fill)
+
+    s_ok = a < kept_cnt[:, None]
+    sp = g(kth_kept, a, s_ok, 0)
+    hs_s = g(skey, sp, s_ok, _I32_MIN)
+    hs_h = g(hsh, sp, s_ok, jnp.int32(-1))
+    hs_i = g(idx, sp, s_ok, BIG)
+    f_ok = (b < E) & jnp.take_along_axis(
+        f_valid, jnp.clip(b, 0, E - 1), 1)
+    hf_s = g(fs, b, f_ok, _I32_MIN)
+    hf_h = g(fh, b, f_ok, jnp.int32(-1))
+    hf_i = g(fi, b, f_ok, BIG)
+    take_f = f_ok & ~(s_ok & _lex_ge(hs_s, hs_h, hs_i, hf_s, hf_h, hf_i))
+    ns = jnp.where(take_f, hf_s, hs_s)
+    nh = jnp.where(take_f, hf_h, hs_h)
+    ni = jnp.where(take_f, hf_i, hs_i)
+    overflow = (
+        kept_cnt + jnp.sum(f_valid, axis=1, dtype=jnp.int32)
+    ) > W
+    # ---- 6. cut at lexmax(θ, φ): above both, the merged set provably
+    # contains every node, so the kept prefix is exact -----------------
+    ge = _lex_ge(ns, nh, ni, th_s[:, None], th_h[:, None], th_i[:, None])
+    ge &= ~phi_live[:, None] | _lex_ge(
+        ns, nh, ni, ph_s[:, None], ph_h[:, None], ph_i[:, None]
+    )
+    cut_any = jnp.any((ns > neg) & ~ge, axis=1)
+    ns = jnp.where((ns > neg) & ge, ns, _I32_MIN)
+    # a LIVE φ means non-extracted fresh candidates may exist below it —
+    # the table can no longer claim completeness even when nothing was
+    # cut (an empty-but-complete row gaining > E feasible changed nodes
+    # keeps every merged entry above both thresholds, yet the 9th+ fresh
+    # candidates are absent: without trunc the exhaustion fallback would
+    # never re-enter for them)
+    trunc = trunc | cut_any | overflow | phi_live
+    # ---- 7. overwrite the re-ranked sub-bucket's rows ----------------
+    scat = jnp.where(rerank_slots >= 0, rerank_slots, M)
+
+    def over(dst, upd):
+        pad = jnp.zeros((1,) + dst.shape[1:], dst.dtype)
+        return jnp.concatenate([dst, pad], 0).at[scat].set(
+            upd, mode="drop"
+        )[:M]
+
+    ni = over(ni, ri)
+    ns = over(ns, rs)
+    nh = over(nh, rh)
+    trunc = over(trunc, trunc_i)
+    # ---- 8. erosion flag + full-table assembly -----------------------
+    # STAGGERED thresholds: θ-cuts thin every carried row at roughly the
+    # same per-cycle rate, so a single shared floor would mature whole
+    # re-rank cohorts at once — a periodic rung-spiking wave (measured:
+    # a quiet er≈100 steady state punctuated by er≈1100 spikes).  Each
+    # row instead refreshes at its own hashed depth in [k_min, W), which
+    # spreads the cohort across the thinning trajectory; the flag is a
+    # scheduling signal only (a fully eroded table still answers exactly
+    # via the exhaustion fallback), so the stagger cannot affect results.
+    vcnt2 = jnp.sum(ns > neg, axis=1, dtype=jnp.int32)
+    spread = jnp.int32(max(W - k_min, 1))
+    jitter = jax.lax.shift_right_logical(
+        jnp.maximum(rows_m, 0) * jnp.int32(_H1), 16
+    ) % spread
+    eroded = trunc & (vcnt2 < k_min + jitter)
+    upd = jax.lax.dynamic_update_slice
+    return (
+        upd(t_idx, ni, (0, 0)),
+        upd(t_skey, ns, (0, 0)),
+        upd(t_hash, nh, (0, 0)),
+        upd(t_trunc, trunc, (0,)),
+        upd(jnp.zeros(P, bool), eroded, (0,)),
+    )
+
+
+def make_lazy_bucket_fallback(view_p: DeviceSnapshot, pend_rows, quanta,
+                              config: AllocateConfig):
+    """The warm path's exhaustion re-entry: the full-matrix head over the
+    bucket with the [P, N] score/hash planes computed INSIDE the cond —
+    the whole point of the carry is that steady cycles never build those
+    planes, so the fallback must not hoist them (the sharded compacted
+    body's fallback is the precedent)."""
+    safe_rows = jnp.maximum(pend_rows, 0)
+    N = view_p.node_idle.shape[0]
+
+    def fallback(idle, releasing, pending_exh):
+        static_ok = static_predicates(view_p)
+        score = score_matrix(view_p, config.weights)
+        ss = jnp.where(static_ok, score, NEG)
+        tie = tie_break_hash_rows(
+            safe_rows, jnp.arange(N, dtype=jnp.int32)
+        )
+        return make_bucket_fallback(view_p, ss, tie, quanta)(
+            idle, releasing, pending_exh
+        )
+
+    return fallback
+
+
+def _warm_allocate_solve(snap: DeviceSnapshot, pend_rows,
+                         t_idx, t_skey, t_hash, t_trunc,
+                         row_map, changed_nodes, rerank_rows, rerank_slots,
+                         config: AllocateConfig, k_min: int):
+    """The warm-started compacted allocate solve: identical outputs to
+    :func:`allocate_topk_solve` (and therefore to the KB_TOPK=0 full
+    program) computed against the CARRIED candidate table, refreshed
+    in-program by :func:`warm_refresh_table`.  ``config.topk`` is the
+    STORED width W (the dispatch carries W = K + WARM_WIDTH_MARGIN so
+    θ/φ-cut erosion rarely reaches the refresh floor); ``k_min`` is that
+    floor (the dispatch passes K/4 — a thin table still answers exactly,
+    so the floor trades re-rank traffic against fallback probability).
+
+    Returns ``(AllocateResult, (idx, skey, hash, trunc), eroded)`` — the
+    refreshed table stays on device for the next cycle's carry (the jit
+    wrapper donates the stale table buffers off-CPU)."""
+    T = snap.task_req.shape[0]
+    N = snap.node_idle.shape[0]
+    M = row_map.shape[0]
+    view_p = pend_view(snap, pend_rows)
+    # fresh keys for the changed-node columns over the [M] live prefix
+    # (row_map's length IS the merge rung — the planner sizes it over the
+    # live bucket rows so padding rows pay nothing), at cycle-start state
+    rows_m = pend_rows[:M]
+    view_pm = pend_view(snap, rows_m)
+    view_pc = node_view(view_pm, changed_nodes)
+    skey_c = fresh_block_skey(view_pc, snap.quanta, config)
+    hash_c = tie_break_hash_rows(
+        jnp.maximum(rows_m, 0), jnp.maximum(changed_nodes, 0)
+    )
+    # full re-rank of the invalidated sub-bucket (compact_candidates at
+    # the rerank rung — the only [·, N] work of a steady warm cycle)
+    view_i = pend_view(snap, rerank_rows)
+    ri, rs, rh, n_feas, _ss, _tie = compact_candidates(
+        view_i, rerank_rows, snap.node_idle, snap.node_releasing,
+        snap.quanta, config,
+    )
+    ni, ns, nh, trunc, eroded = warm_refresh_table(
+        t_idx, t_skey, t_hash, t_trunc, row_map, rows_m, changed_nodes,
+        skey_c, hash_c, ri, rs, rh, n_feas > config.topk, rerank_slots,
+        N, k_min,
+    )
+    fallback = make_lazy_bucket_fallback(view_p, pend_rows, snap.quanta,
+                                         config)
+    head = make_compact_head(
+        ni, ns, nh, trunc, view_p.task_req, snap.quanta, N, fallback,
+    )
+    res = allocate_rounds(
+        view_p, config, None, snap.node_idle, snap.node_releasing,
+        snap.node_used, compact_head=head,
+    )
+    return scatter_bucket_result(res, pend_rows, T), (ni, ns, nh, trunc), eroded
+
+
+#: argument positions of the carried table buffers — donated off-CPU so
+#: the refresh writes in place (the resident scatter's donation contract)
+WARM_TABLE_ARGNUMS = (2, 3, 4, 5)
+
+_WARM_SOLVE = None
+
+
+def warm_solve_fn():
+    """The shared jitted warm solve — module-level memo (the _scatter_fn
+    idiom): donation is backend-dependent, so the wrapper is built on
+    first use, and every cache instance reuses one compiled
+    specialization set per (shape, config) key."""
+    global _WARM_SOLVE
+    if _WARM_SOLVE is None:
+        donate = (
+            () if jax.default_backend() == "cpu" else WARM_TABLE_ARGNUMS
+        )
+        _WARM_SOLVE = jitstats.register(
+            "warm_allocate_solve",
+            jax.jit(_warm_allocate_solve,
+                    static_argnames=("config", "k_min"),
+                    donate_argnums=donate),
+        )
+    return _WARM_SOLVE
+
+
+def warm_allocate_solve(snap, pend_rows, table, plan, config, k_min):
+    """Dispatch-facing warm solve: ``table`` = the carried (idx, skey,
+    hash, trunc) device arrays, ``plan`` = the host planner's (row_map,
+    changed_nodes, rerank_rows, rerank_slots) int32 arrays."""
+    t_idx, t_skey, t_hash, t_trunc = table
+    row_map, changed, rr, rslots = plan
+    return warm_solve_fn()(
+        snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+        row_map, changed, rr, rslots, config=config, k_min=k_min,
+    )
+
+
+@jax.jit
+def failure_histogram_bucket_solve(snap: DeviceSnapshot,
+                                   pend_rows) -> jnp.ndarray:
+    """:func:`failure_histogram_solve` computed on the [P] pending bucket
+    instead of re-walking [T, N]: every consumer reads histogram rows only
+    for unplaced PENDING tasks, all of which the dispatch's bucket covers,
+    and each task's row is a node-axis reduction independent of the other
+    task rows — so the bucket rows are bit-equal to the full program's and
+    the non-bucket rows (never read) scatter back as zeros."""
+    from kube_batch_tpu.ops.feasibility import (
+        FeasibilityMasks,
+        N_REASONS,
+        failure_histogram,
+    )
+
+    T = snap.task_req.shape[0]
+    view_p = pend_view(snap, pend_rows)
+    static_ok = static_predicates(view_p)
+    fit0_idle = fits(view_p.task_req, snap.node_idle, snap.quanta)
+    fit0_rel = fits(view_p.task_req, snap.node_releasing, snap.quanta)
+    h = failure_histogram(
+        view_p,
+        FeasibilityMasks(
+            static_ok, fit0_idle, fit0_rel,
+            static_ok & (fit0_idle | fit0_rel),
+        ),
+    )
+    scat = jnp.where(pend_rows >= 0, pend_rows, T)
+    return jnp.zeros((T + 1, N_REASONS), jnp.int32).at[scat].set(h)[:T]
+
+
 # retrace accounting (utils/jitstats): the bench asserts these stay flat
 # across steady-state cycles — shape-bucketed snapshots must hit the jit
 # cache every cycle after warmup
 jitstats.register("allocate_solve", allocate_solve)
 jitstats.register("allocate_topk_solve", allocate_topk_solve)
 jitstats.register("failure_histogram_solve", failure_histogram_solve)
+jitstats.register("failure_histogram_bucket_solve",
+                  failure_histogram_bucket_solve)

@@ -394,6 +394,58 @@ def allocate_topk_sentinel_solve(snap: DeviceSnapshot, pend_rows,
     return res, verdict, hist, eligibility_checksum(snap)
 
 
+def _warm_sentinel_body(snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+                        row_map, changed_nodes, rerank_rows, rerank_slots,
+                        config: AllocateConfig, k_min: int):
+    """The warm-started compacted solve (ops.assignment._warm_allocate_solve)
+    plus the fused invariant tail: the invariants run on the scattered-back
+    [T] result, so a table-carry bug that merges a stale key into a wrong
+    placement is in scope exactly like a compaction mis-scatter."""
+    from kube_batch_tpu.ops.assignment import _warm_allocate_solve
+
+    res, table, eroded = _warm_allocate_solve(
+        snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+        row_map, changed_nodes, rerank_rows, rerank_slots, config, k_min,
+    )
+    verdict, hist = allocate_invariants(snap, res, config)
+    return res, verdict, hist, eligibility_checksum(snap), table, eroded
+
+
+_WARM_SENTINEL = None
+
+
+def warm_sentinel_solve_fn():
+    """Jitted sentinel-fused warm solve — module-level memo with the same
+    backend-dependent table donation as ops.assignment.warm_solve_fn."""
+    global _WARM_SENTINEL
+    if _WARM_SENTINEL is None:
+        from kube_batch_tpu.ops.assignment import WARM_TABLE_ARGNUMS
+
+        donate = (
+            () if jax.default_backend() == "cpu" else WARM_TABLE_ARGNUMS
+        )
+        _WARM_SENTINEL = jitstats.register(
+            "warm_allocate_sentinel_solve",
+            jax.jit(_warm_sentinel_body,
+                    static_argnames=("config", "k_min"),
+                    donate_argnums=donate),
+        )
+    return _WARM_SENTINEL
+
+
+def warm_allocate_sentinel_solve(snap, pend_rows, table, plan,
+                                 config: AllocateConfig, k_min: int):
+    """Dispatch-facing sentinel-fused warm solve: same calling shape as
+    ops.assignment.warm_allocate_solve, returning ``(result, verdict,
+    hist, checksum, table', eroded)``."""
+    t_idx, t_skey, t_hash, t_trunc = table
+    row_map, changed, rr, rslots = plan
+    return warm_sentinel_solve_fn()(
+        snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+        row_map, changed, rr, rslots, config=config, k_min=k_min,
+    )
+
+
 @partial(jax.jit, static_argnames=("config",))
 def evict_sentinel_solve(snap: DeviceSnapshot, config: EvictConfig):
     """evict_solve (reclaim/preempt) with the fused invariant tail."""

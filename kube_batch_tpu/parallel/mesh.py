@@ -304,6 +304,134 @@ def sharded_allocate_topk_solve(
         return fn(snap, pend_rows)
 
 
+def warm_allocate_solve_fn(mesh: Mesh, config: AllocateConfig, k_min: int,
+                           impl: Optional[str] = None):
+    """The memoized jitted WARM-STARTED compacted solve for (mesh, config,
+    k_min, impl) — the cross-cycle candidate-table carry
+    (ops.assignment._warm_allocate_solve).  The shard_map impl contributes
+    delta-sized per-shard work (fresh changed-node keys via one psum, the
+    invalidated sub-bucket via one all_gather + replicated merge) and
+    keeps the round loop collective-free; the pjit impl re-jits the
+    single-device warm body with mesh shardings (table + plan replicated)
+    as the sharded bit-exactness oracle — the same split as every solve."""
+    from kube_batch_tpu.ops.assignment import _warm_allocate_solve
+
+    impl = _impl(impl)
+    key = (mesh, config, "warm", k_min, impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.warm_allocate_shard_map(mesh, config, k_min)
+        else:
+            in_shardings = snapshot_shardings(mesh)
+            node2 = NamedSharding(mesh, P(NODE_AXIS, None))
+            repl = NamedSharding(mesh, P())
+            res_shardings = AllocateResult(
+                assigned=repl, pipelined=repl, committed=repl,
+                node_idle=node2, node_releasing=node2, node_used=node2,
+                deserved=repl, rounds_run=repl,
+                topk_exhausted=repl, topk_reentries=repl,
+            )
+            fn = jax.jit(
+                partial(_warm_allocate_solve, config=config, k_min=k_min),
+                in_shardings=(in_shardings,) + (repl,) * 9,
+                out_shardings=(res_shardings, (repl,) * 4, repl),
+            )
+        jitstats.register(f"sharded_warm_allocate_solve[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sharded_warm_allocate_solve(snap, pend_rows, table, plan,
+                                config: AllocateConfig, k_min: int,
+                                mesh: Mesh, impl: Optional[str] = None):
+    """The warm-started compacted solve over the mesh — same calling
+    shape as ops.assignment.warm_allocate_solve, returning
+    ``(AllocateResult, table', eroded)``; the refreshed table comes back
+    replicated and carries to the next cycle as-is."""
+    fn = warm_allocate_solve_fn(mesh, config, k_min, impl=impl)
+    t_idx, t_skey, t_hash, t_trunc = table
+    row_map, changed, rr, rslots = plan
+    with mesh:
+        return fn(snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+                  row_map, changed, rr, rslots)
+
+
+def sentinel_warm_allocate_solve_fn(mesh: Mesh, config: AllocateConfig,
+                                    k_min: int,
+                                    impl: Optional[str] = None):
+    from kube_batch_tpu.ops.invariants import (
+        allocate_invariants,
+        eligibility_checksum,
+    )
+
+    impl = _impl(impl)
+    key = (mesh, config, "sentinel_warm", k_min, impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        inner = warm_allocate_solve_fn(mesh, config, k_min, impl=impl)
+
+        def fused(snap, pend_rows, *rest):
+            res, table, eroded = inner(snap, pend_rows, *rest)
+            verdict, hist = allocate_invariants(snap, res, config)
+            return (res, verdict, hist, eligibility_checksum(snap),
+                    table, eroded)
+
+        fn = jax.jit(fused)
+        jitstats.register(f"sentinel_sharded_warm_allocate_solve[{impl}]",
+                          fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sentinel_sharded_warm_allocate_solve(snap, pend_rows, table, plan,
+                                         config, k_min, mesh, impl=None):
+    fn = sentinel_warm_allocate_solve_fn(mesh, config, k_min, impl=impl)
+    t_idx, t_skey, t_hash, t_trunc = table
+    row_map, changed, rr, rslots = plan
+    with mesh:
+        return fn(snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+                  row_map, changed, rr, rslots)
+
+
+def failure_histogram_bucket_fn(mesh: Mesh, impl: Optional[str] = None):
+    """Memoized jitted sharded BUCKETED fit-error histogram for `mesh`
+    (dispatch + jaxpr-audit entry point) — the [P] pending-bucket variant
+    of failure_histogram_fn."""
+    from kube_batch_tpu.ops.assignment import failure_histogram_bucket_solve
+
+    impl = _impl(impl)
+    key = (mesh, "fail_hist_bucket", impl)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        if impl == "shard_map":
+            from kube_batch_tpu.parallel import shard_solve
+
+            fn = shard_solve.failure_histogram_bucket_shard_map(mesh)
+        else:
+            repl = NamedSharding(mesh, P())
+            fn = jax.jit(
+                failure_histogram_bucket_solve.__wrapped__,
+                in_shardings=(snapshot_shardings(mesh), repl),
+                out_shardings=repl,
+            )
+        jitstats.register(f"sharded_failure_histogram_bucket[{impl}]", fn)
+        _jit_cache[key] = fn
+    return fn
+
+
+def sharded_failure_histogram_bucket(snap: DeviceSnapshot, pend_rows,
+                                     mesh: Mesh):
+    """The lazy fit-error histogram over the mesh, restricted to the [P]
+    pending bucket — per-shard [P, N_loc] partials, one psum, scattered
+    back to the replicated [T, N_REASONS] result."""
+    fn = failure_histogram_bucket_fn(mesh)
+    with mesh:
+        return fn(snap, pend_rows)
+
+
 def failure_histogram_fn(mesh: Mesh, impl: Optional[str] = None):
     """Memoized jitted sharded fit-error histogram for `mesh` (dispatch +
     jaxpr-audit entry point)."""

@@ -400,6 +400,144 @@ def allocate_topk_shard_map(mesh, config):
 
 
 # --------------------------------------------------------------------------
+# warm-started compacted allocate (KB_WARM) — cross-cycle table carry
+# --------------------------------------------------------------------------
+
+
+def _warm_allocate_body(snap, pend_rows, t_idx, t_skey, t_hash, t_trunc,
+                        row_map, changed_nodes, rerank_rows, rerank_slots,
+                        *, config, node_shards, k_min):
+    """The sharded warm solve: the carried [P, W] table rides REPLICATED
+    across cycles; per solve each shard contributes only delta-sized work —
+
+    - the fresh keys of ITS OWN changed nodes (a [P, C] partial over the
+      local node columns, merged with ONE psum: each changed node is owned
+      by exactly one shard, so the masked-sum is the exact stacked value);
+    - its local [Pi, W] candidate lists for the INVALIDATED sub-bucket,
+      merged with one all_gather + replicated lex merge — the PR 10
+      per-solve merge, now shipped only for the invalidated rows.
+
+    Table refresh (permute / remove / θ-cut merge / re-rank scatter) and
+    the bidding rounds run replicated on the merged state + the per-solve
+    gathered ledgers, so the round loop keeps the compacted path's ZERO
+    per-round cross-shard collectives."""
+    from kube_batch_tpu.ops import assignment as _asg
+
+    N_loc = snap.node_idle.shape[0]
+    N = N_loc * node_shards
+    T = snap.task_req.shape[0]
+    W = config.topk
+    n0 = jax.lax.axis_index(NODE_AXIS) * N_loc
+    quanta = snap.quanta
+
+    # ---- fresh changed-node keys over the [M] live prefix: per-shard
+    # partial + one psum (each changed node is owned by exactly one shard)
+    M = row_map.shape[0]
+    rows_m = pend_rows[:M]
+    view_lm = _asg.pend_view(snap, rows_m)
+    loc = changed_nodes - n0
+    own = (changed_nodes >= 0) & (loc >= 0) & (loc < N_loc)
+    view_lc = _asg.node_view(view_lm, jnp.where(own, loc, -1))
+    skey_part = _asg.fresh_block_skey(view_lc, quanta, config)
+    skey_c = jax.lax.psum(
+        jnp.where(own[None, :], skey_part, 0), NODE_AXIS
+    )
+    skey_c = jnp.where(
+        (changed_nodes >= 0)[None, :], skey_c, _asg._I32_MIN
+    )
+    hash_c = _asg.tie_break_hash_rows(
+        jnp.maximum(rows_m, 0), jnp.maximum(changed_nodes, 0)
+    )
+
+    # ---- invalidated sub-bucket: local build, gather, replicated merge --
+    view_i = _asg.pend_view(snap, rerank_rows)
+    ki, ks, kh, nf_l, _ss, _tie = _asg.compact_candidates(
+        view_i, rerank_rows, snap.node_idle, snap.node_releasing, quanta,
+        config, n0=n0,
+    )
+    Pi = rerank_rows.shape[0]
+    payload = jnp.concatenate([ks, kh, ki, nf_l[:, None]], axis=1)
+    g = jax.lax.all_gather(payload, NODE_AXIS, axis=0, tiled=False)
+    skeys = jnp.transpose(g[:, :, 0:W], (1, 0, 2)).reshape(Pi, -1)
+    hashes = jnp.transpose(g[:, :, W:2 * W], (1, 0, 2)).reshape(Pi, -1)
+    idxs = jnp.transpose(g[:, :, 2 * W:3 * W], (1, 0, 2)).reshape(Pi, -1)
+    n_feas = jnp.sum(g[:, :, 3 * W], axis=0)
+    ri, rs, rh = _asg.lex_topk(skeys, hashes, idxs, W, block=max(W, 8))
+
+    # ---- replicated table refresh + rounds ------------------------------
+    ni, ns, nh, trunc, eroded = _asg.warm_refresh_table(
+        t_idx, t_skey, t_hash, t_trunc, row_map, rows_m, changed_nodes,
+        skey_c, hash_c, ri, rs, rh, n_feas > W, rerank_slots, N, k_min,
+    )
+    idle0 = _gather_nodes(snap.node_idle, node_shards)
+    rel0 = _gather_nodes(snap.node_releasing, node_shards)
+    used0 = _gather_nodes(snap.node_used, node_shards)
+
+    def _gn(x):
+        return _gather_nodes(x, node_shards)
+
+    def _gn1(x):
+        if node_shards == 1:
+            return x
+        return jax.lax.all_gather(x, NODE_AXIS, axis=1, tiled=True)
+
+    snap_repl = snap._replace(
+        node_idle=idle0, node_releasing=rel0, node_used=used0,
+        node_alloc=_gn(snap.node_alloc), node_valid=_gn(snap.node_valid),
+        node_sched=_gn(snap.node_sched),
+        node_label_bits=_gn(snap.node_label_bits),
+        node_taint_bits=_gn(snap.node_taint_bits),
+        task_aff_mask=_gn1(snap.task_aff_mask),
+        task_pref_node=_gn1(snap.task_pref_node),
+        task_pref_pod=_gn1(snap.task_pref_pod),
+    )
+    view_repl = _asg.pend_view(snap_repl, pend_rows)
+    fallback = _asg.make_lazy_bucket_fallback(
+        view_repl, pend_rows, quanta, config
+    )
+    head = _asg.make_compact_head(
+        ni, ns, nh, trunc, view_repl.task_req, quanta, N, fallback,
+    )
+    res = _asg.allocate_rounds(
+        view_repl, config, None, idle0, rel0, used0, compact_head=head
+    )
+    res = _asg.scatter_bucket_result(res, pend_rows, T)
+    sl = partial(jax.lax.dynamic_slice_in_dim, start_index=n0,
+                 slice_size=N_loc, axis=0)
+    res = res._replace(
+        node_idle=sl(res.node_idle),
+        node_releasing=sl(res.node_releasing),
+        node_used=sl(res.node_used),
+    )
+    return res, (ni, ns, nh, trunc), eroded
+
+
+def warm_allocate_shard_map(mesh, config, k_min: int):
+    """jitted shard_map warm-started compacted solve for (mesh, config,
+    k_min) — the carried table and every plan array ride replicated; only
+    the node-axis snapshot columns are shard-local.  Like the cold
+    compacted path, a 2-D task-sharded mesh declines (the dispatch never
+    routes it here)."""
+    from kube_batch_tpu.ops.assignment import AllocateResult
+
+    task_shards, node_shards = _axis_sizes(mesh)
+    if task_shards != 1:
+        raise ValueError("KB_WARM carry requires a 1-D node mesh")
+    node2 = P(NODE_AXIS, None)
+    res_specs = AllocateResult(
+        assigned=P(), pipelined=P(), committed=P(),
+        node_idle=node2, node_releasing=node2, node_used=node2,
+        deserved=P(), rounds_run=P(),
+        topk_exhausted=P(), topk_reentries=P(),
+    )
+    out_specs = (res_specs, (P(), P(), P(), P()), P())
+    body = partial(_warm_allocate_body, config=config,
+                   node_shards=node_shards, k_min=k_min)
+    in_specs = (_snapshot_specs(mesh),) + (P(),) * 9
+    return _shard_map(body, mesh, in_specs, out_specs)
+
+
+# --------------------------------------------------------------------------
 # evict (reclaim / preempt)
 # --------------------------------------------------------------------------
 
@@ -510,6 +648,30 @@ def _histogram_body(snap, *, node_shards, task_shards):
     return _gather_tasks(h, task_shards)
 
 
+def _histogram_bucket_body(snap, pend_rows, *, node_shards):
+    """The fit-error histogram on the [P] pending bucket: per-shard
+    [P, N_loc] partial counts, one psum, scattered back to the [T] task
+    axis (the compacted-allocate bucket idiom applied to the histogram —
+    every consumer reads rows only for unplaced PENDING tasks, all of
+    which the bucket covers)."""
+    from kube_batch_tpu.ops import assignment as _asg
+    from kube_batch_tpu.ops.feasibility import N_REASONS
+
+    T = snap.task_req.shape[0]
+    view = _asg.pend_view(snap, pend_rows)
+    static_ok = static_predicates(view)
+    fit_i = fits(view.task_req, snap.node_idle, snap.quanta)
+    fit_r = fits(view.task_req, snap.node_releasing, snap.quanta)
+    h = failure_histogram(
+        view,
+        FeasibilityMasks(static_ok, fit_i, fit_r,
+                         static_ok & (fit_i | fit_r)),
+    )
+    h = jax.lax.psum(h, NODE_AXIS)
+    scat = jnp.where(pend_rows >= 0, pend_rows, T)
+    return jnp.zeros((T + 1, N_REASONS), jnp.int32).at[scat].set(h)[:T]
+
+
 # --------------------------------------------------------------------------
 # builders — jitted shard_map wrappers (memoized by parallel.mesh)
 # --------------------------------------------------------------------------
@@ -573,6 +735,18 @@ def failure_histogram_shard_map(mesh):
     body = partial(_histogram_body,
                    node_shards=node_shards, task_shards=task_shards)
     return _shard_map(body, mesh, (_snapshot_specs(mesh),), P())
+
+
+def failure_histogram_bucket_shard_map(mesh):
+    """jitted shard_map BUCKETED fit-error histogram (the [P] pending
+    bucket instead of [T, N] — dispatched whenever the compacted allocate
+    planned a bucket this cycle; 1-D node meshes only, like the compacted
+    solve itself)."""
+    task_shards, node_shards = _axis_sizes(mesh)
+    if task_shards != 1:
+        raise ValueError("bucketed histogram requires a 1-D node mesh")
+    body = partial(_histogram_bucket_body, node_shards=node_shards)
+    return _shard_map(body, mesh, (_snapshot_specs(mesh), P()), P())
 
 
 def _probe_body(snap, batch, probe_rows, *, config, evict_config,

@@ -43,6 +43,13 @@ def main(argv=None) -> int:
                     help="event-driven pipelined cycles: wake at arrivals "
                          "(floored by the preset's min_period) instead of "
                          "the fixed tick; staged close + writeback worker")
+    ap.add_argument("--warm-ab", action="store_true",
+                    help="KB_WARM A/B: run the preset twice — carried "
+                         "candidate table (KB_WARM default) vs the cold "
+                         "per-solve build (KB_WARM=0) — and exit nonzero "
+                         "unless every acked bind is identical "
+                         "(bind_digest equality; the warm leg must also "
+                         "actually engage the carry)")
     ap.add_argument("--replay-bundle", default=None, metavar="DIR",
                     help="replay a guard-plane diagnostics bundle instead "
                          "of running a preset: re-run the condemned solve "
@@ -61,6 +68,50 @@ def main(argv=None) -> int:
                 f.write(out + "\n")
         print(out, flush=True)
         return 0 if report.get("reproduced") else 1
+
+    if args.warm_ab:
+        # the warm-carry decision-equality leg (ISSUE 14): same preset,
+        # same seed, KB_WARM on vs off — bit-identical binds required.
+        # Runs in-process back to back; the runner is seed-deterministic
+        # and each run builds a fresh cache, so the only varying input is
+        # the knob under test.
+        import os
+
+        saved = os.environ.get("KB_WARM")
+        try:
+            os.environ.pop("KB_WARM", None)
+            warm = run_preset(args.preset, seed=args.seed,
+                              cycles=args.cycles, pipelined=args.pipelined)
+            os.environ["KB_WARM"] = "0"
+            cold = run_preset(args.preset, seed=args.seed,
+                              cycles=args.cycles, pipelined=args.pipelined)
+        finally:
+            if saved is None:
+                os.environ.pop("KB_WARM", None)
+            else:
+                os.environ["KB_WARM"] = saved
+        match = warm.get("bind_digest") == cold.get("bind_digest")
+        # "engaged" = the CARRY actually served (merge cycles, not cold
+        # rebuilds — a regression that escalates every plan to cold would
+        # trivially match the oracle while the feature is dead)
+        wrep = warm.get("warm", {})
+        engaged = (
+            wrep.get("warm_cycles", 0) - wrep.get("cold_builds", 0) > 0
+        )
+        out = json.dumps({
+            "preset": args.preset, "seed": args.seed,
+            "binds_match": match, "warm_engaged": engaged,
+            "warm": warm.get("warm"),
+            "acked_binds_warm": warm.get("bind_integrity", {}).get(
+                "acked_binds"),
+            "acked_binds_cold": cold.get("bind_integrity", {}).get(
+                "acked_binds"),
+        }, indent=2, sort_keys=True)
+        if args.report:
+            with open(args.report, "w") as f:
+                f.write(out + "\n")
+        print(out, flush=True)
+        return 0 if match and engaged else 1
 
     report = run_preset(args.preset, seed=args.seed, cycles=args.cycles,
                         trace_path=args.trace, pipelined=args.pipelined,

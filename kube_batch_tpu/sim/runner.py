@@ -24,6 +24,7 @@ against the serial run of the same preset/seed.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 from typing import Dict, List, Optional, Tuple
 
@@ -150,6 +151,17 @@ def preset(name: str, seed: int = 0) -> SimConfig:
             *bind_fail_script(3.0, count=3),
             *watch_flap_script(9.0),
         )
+        return cfg
+    if name == "warm-churn":
+        # the KB_WARM A/B scale (ISSUE 14): big enough that the compacted
+        # allocate engages (task capacity past the smallest pending-bucket
+        # rung, node capacity past the K width) with sustained gang churn
+        # so the carried candidate table actually merges across cycles —
+        # the --warm-ab leg runs this twice and bit-compares every bind
+        cfg = SimConfig(seed=seed, cycles=60, n_nodes=40,
+                        n_jobs=350, arrival_rate=10.0,
+                        gang_sizes=(4, 6, 8),
+                        duration_range=(6.0, 14.0))
         return cfg
     if name == "brownout":
         # apiserver brownout mid-workload: every egress call fails for a
@@ -278,6 +290,16 @@ class SimRunner:
         self.topk_exhausted = 0
         self.topk_reentries = 0
         self.topk_k = 0
+        # warm-carry accumulators (ISSUE 14)
+        self.warm_cycles = 0
+        self.warm_cold = 0
+        self.warm_reranked = 0
+        self.warm_changed = 0
+        self.warm_live = 0
+        # order-exact digest of every acked (pod, node) bind — the
+        # KB_WARM A/B leg's decision-equality receipt (same seed + a
+        # bit-exact fast path ⇒ identical digest)
+        self._bind_hash = hashlib.sha256()
 
     # ---- shared lookups --------------------------------------------------
     def job_of_pod(self, key: str) -> Optional[str]:
@@ -465,6 +487,7 @@ class SimRunner:
     def _drain_kubelet(self, now: float) -> None:
         binds, evicts = self.kubelet.drain()
         for key, node in binds:
+            self._bind_hash.update(f"{key}->{node};".encode())
             self.trace.record(SimEvent(now, ev.BIND,
                                        {"key": key, "node": node}))
             info = self.pod_info.get(key)
@@ -562,6 +585,16 @@ class SimRunner:
             self.topk_exhausted += topk.get("exhausted", 0)
             self.topk_reentries += topk.get("reentries", 0)
             self.topk_k = topk.get("k", self.topk_k)
+        # warm-carry longitudinal counters (ISSUE 14): cycles the carried
+        # table served, cold rebuilds, and the invalidation volume
+        warm = getattr(get_action("allocate"), "last_warm", None)
+        if warm is not None:
+            self.warm_cycles += 1
+            if warm.get("cold"):
+                self.warm_cold += 1
+            self.warm_reranked += warm.get("reranked", 0)
+            self.warm_changed += warm.get("changed", 0)
+            self.warm_live += warm.get("bucket_live", 0)
         pending, running = self._task_counts()
         shares = self._queue_shares()
         # surface the longitudinal fairness series live: the same
@@ -734,6 +767,20 @@ class SimRunner:
                 "exhausted_total": self.topk_exhausted,
                 "reentries_total": self.topk_reentries,
             },
+            # warm-carry longitudinal evidence (ISSUE 14): how many cycles
+            # the carried candidate table served, cold rebuilds, and the
+            # invalidated-row fraction over the scenario — the KB_WARM A/B
+            # leg (--warm-ab) additionally bit-compares bind_digest
+            "warm": {
+                "warm_cycles": self.warm_cycles,
+                "cold_builds": self.warm_cold,
+                "reranked_total": self.warm_reranked,
+                "changed_total": self.warm_changed,
+                "invalidated_row_fraction": (
+                    round(self.warm_reranked / self.warm_live, 4)
+                    if self.warm_live else None
+                ),
+            },
             **({"solve_collectives": solve_collectives}
                if solve_collectives is not None else {}),
             # fault-hardening evidence: bind integrity (no lost/duplicate
@@ -761,6 +808,10 @@ class SimRunner:
             "bind_failures_injected": self.kubelet.bind_failures,
             "trace_events": len(self.trace),
             "trace_sha256": self.trace.sha256(),
+            # decision receipt: the order-exact digest of every acked
+            # bind — two runs that scheduled identically share it (the
+            # --warm-ab leg's comparison point)
+            "bind_digest": self._bind_hash.hexdigest(),
         })
         recovery = self._fault_recovery()
         if recovery is not None:

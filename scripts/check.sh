@@ -65,3 +65,12 @@ env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py
 # (scripts/trace_smoke.py; KBT014 keeps span bodies clock-free statically)
 echo "kbt-check: trace smoke (spans + flight recorder)"
 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+
+# warm smoke: the KB_WARM A/B leg (ISSUE 14) — the warm-churn preset run
+# twice, carried candidate table vs the cold per-solve build; every acked
+# bind must be bit-identical and the carry must actually engage (the CLI
+# exits nonzero on either failure)
+echo "kbt-check: warm smoke (KB_WARM A/B, warm-churn preset)"
+env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
+  --preset warm-churn --seed 3 --warm-ab --no-fairness-series >/dev/null
+echo "kbt-check: warm smoke clean"
