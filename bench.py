@@ -1005,8 +1005,8 @@ def whatif_serving_bench(conf, n_tasks=20_000, n_nodes=2_000,
             "n_nodes": n_nodes,
             "clients": n_clients,
             "requests": total,
-            "whatif_p50_ms": round(_pct(lat, 50), 2) if lat else None,
-            "whatif_p99_ms": round(_pct(lat, 99), 2) if lat else None,
+            "whatif_p50_ms": round(_pct(lat, 0.50), 2) if lat else None,
+            "whatif_p99_ms": round(_pct(lat, 0.99), 2) if lat else None,
             "qps": round(total / elapsed, 1) if elapsed > 0 else None,
             "device_dispatches": dispatches,
             "mean_batch_size": round(total / dispatches, 2) if dispatches else None,
@@ -1023,6 +1023,148 @@ def whatif_serving_bench(conf, n_tasks=20_000, n_nodes=2_000,
         return out
     finally:
         qp.close()
+
+
+def replication_serving_bench(conf, n_tasks=1_000, n_nodes=96,
+                              clients_per_follower=4,
+                              requests_per_client=25):
+    """The replicate/ follower read plane's horizontal-scale evidence: a
+    leader (publisher + AdminServer) with 1→3 REAL follower processes
+    (``--follower`` subprocesses, own devices + probe executables each)
+    serving /v1/whatif over loopback HTTP.  Offered load grows with the
+    follower count (``clients_per_follower`` threads per live follower),
+    so aggregate QPS should scale ~linearly while the leader pays one
+    record encode per cycle regardless of fan-out.  Followers run pinned
+    to CPU (hardened_cpu_env) — the section measures read-plane scaling
+    against itself, and a TPU leader must not share its devices with
+    bench children.  Also records the one-time evidence that each
+    follower bit-matches the leader verdict on the frozen snapshot and
+    reports zero staleness lag."""
+    import socket
+    import threading
+    import urllib.request
+
+    from kube_batch_tpu.cmd.server import AdminServer
+    from kube_batch_tpu.envutil import hardened_cpu_env
+    from kube_batch_tpu.replicate.publisher import ReplicationPublisher
+    from kube_batch_tpu.serve.plane import QueryPlane
+
+    gib = float(2 ** 30)
+    body = json.dumps({"queue": "q0", "count": 2,
+                       "requests": {"cpu": 500.0, "memory": gib}}).encode()
+
+    def post(url, data=body, timeout=60):
+        req = urllib.request.Request(
+            url + "/v1/whatif", data=data,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    cache = synthetic_cluster(
+        n_tasks=n_tasks, n_nodes=n_nodes, gang_size=4, n_queues=2
+    )
+    cache.replication = pub = ReplicationPublisher()
+    qp = QueryPlane(cache, max_batch=16, window_s=0.002, start_thread=True)
+    srv = AdminServer(cache, port=0, query_plane=qp)
+    srv.start()
+    leader_url = f"http://127.0.0.1:{srv.port}"
+    procs, out = [], {}
+    try:
+        one_cycle(conf, cache)  # publish the lease + replication record
+        pub.barrier()
+
+        ports = []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        env = hardened_cpu_env()
+        for port in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "kube_batch_tpu.cmd.main",
+                 "--follower", leader_url,
+                 "--listen-address", f"127.0.0.1:{port}"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ))
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+        # readiness: the pull loop has adopted a snapshot once /v1/whatif
+        # answers 200 (it 503s before the first lease); then a warm probe
+        # per follower so subprocess compile never lands in the timed window
+        deadline = time.perf_counter() + 300
+        for url in urls:
+            while True:
+                try:
+                    resp = post(url, timeout=10)
+                    if "feasible" in resp:
+                        break
+                except Exception:  # noqa: BLE001 — still starting up
+                    pass
+                if time.perf_counter() > deadline:
+                    raise RuntimeError(f"follower at {url} never became "
+                                       f"ready (subprocess startup)")
+                time.sleep(0.5)
+
+        # frozen-snapshot evidence: every follower must answer the leader's
+        # verdict byte-identically, at zero reported lag
+        want = json.dumps(post(leader_url), sort_keys=True)
+        matches = [json.dumps(post(u), sort_keys=True) == want for u in urls]
+        lags = [post(u)["staleness"]["lag_cycles"] for u in urls]
+
+        def drive(n_followers: int) -> dict:
+            lat: list = []
+            lock = threading.Lock()
+
+            def client(k):
+                url = urls[k % n_followers]
+                mine = []
+                for _ in range(requests_per_client):
+                    t0 = time.perf_counter()
+                    post(url)
+                    mine.append((time.perf_counter() - t0) * 1e3)
+                with lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(n_followers * clients_per_follower)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            elapsed = time.perf_counter() - t0
+            return {
+                "clients": len(threads),
+                "requests": len(lat),
+                "qps": round(len(lat) / elapsed, 1) if elapsed > 0 else None,
+                "p50_ms": round(_pct(lat, 0.50), 2) if lat else None,
+                "p99_ms": round(_pct(lat, 0.99), 2) if lat else None,
+            }
+
+        scale = {k: drive(k) for k in (1, 2, 3)}
+        q1, q3 = scale[1]["qps"], scale[3]["qps"]
+        out = {
+            "n_tasks": n_tasks, "n_nodes": n_nodes,
+            "bit_match_all_followers": bool(all(matches)),
+            "staleness_lag_cycles": lags,
+            "qps_by_follower_count": {str(k): v for k, v in scale.items()},
+            "scaling_1_to_3": round(q3 / q1, 2) if q1 else None,
+            "leader_records": pub.counters(),
+        }
+        return out
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        srv.stop()
+        qp.close()
+        pub.close()
 
 
 def pipelined_bench(conf, n_tasks=400, n_nodes=48, arrivals=10,
@@ -1112,8 +1254,8 @@ def pipelined_bench(conf, n_tasks=400, n_nodes=48, arrivals=10,
             "mode": "pipelined" if pipelined else "serial",
             "arrivals": arrivals,
             "decided": len(sink),
-            "p50_ms": round(_pct(sink, 50), 1) if sink else None,
-            "p99_ms": round(_pct(sink, 99), 1) if sink else None,
+            "p50_ms": round(_pct(sink, 0.50), 1) if sink else None,
+            "p99_ms": round(_pct(sink, 0.99), 1) if sink else None,
             "mean_ms": round(sum(sink) / len(sink), 1) if sink else None,
             "retraces_steady": retraces,
         }
@@ -1264,6 +1406,12 @@ def main() -> None:
             result["whatif_serving"] = whatif_serving_bench(conf)
         except Exception as e:  # noqa: BLE001
             result["whatif_serving_error"] = f"{type(e).__name__}: {e}"
+        # follower read-plane scaling is loopback-HTTP + CPU followers —
+        # backend-independent by construction
+        try:
+            result["replication_serving"] = replication_serving_bench(conf)
+        except Exception as e:  # noqa: BLE001
+            result["replication_serving_error"] = f"{type(e).__name__}: {e}"
         # arrival→decision latency is a POLICY number (tick vs trigger),
         # valid on any backend — the ≥2× acceptance evidence runs here too
         try:
@@ -1374,6 +1522,14 @@ def main() -> None:
     if section("whatif_serving", margin_s=120):
         with guarded("whatif_serving"):
             result["whatif_serving"] = whatif_serving_bench(conf)
+
+    # ---- the replicate/ follower read plane: 1→3 real --follower
+    # subprocesses against a publishing leader — aggregate /v1/whatif QPS
+    # must scale ~linearly with the follower count, each follower
+    # bit-matching the leader's frozen-snapshot verdict at zero lag
+    if section("replication_serving", margin_s=360):
+        with guarded("replication_serving"):
+            result["replication_serving"] = replication_serving_bench(conf)
 
     # ---- event-driven pipelined cycles: live arrival→decision latency,
     # serial 1 s tick vs trigger-driven loop, + the writeback overlap gain
@@ -1554,8 +1710,8 @@ def _emit(result: dict, tpu_capture_note: bool) -> None:
         missing = [
             s for s in ("go_loop_ms", "pallas_roundhead", "pipeline5_ms",
                         "het30_ms", "multicycle", "multicycle_sharded",
-                        "whatif_serving", "topk_compare",
-                        "incremental_solve")
+                        "whatif_serving", "replication_serving",
+                        "topk_compare", "incremental_solve")
             if s not in capture
         ]
         # the matrix is complete only when every build_cases() config has a

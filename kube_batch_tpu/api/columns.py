@@ -680,11 +680,17 @@ class ColumnStore:
         qinfo._cols = self
         self.q_weight[row] = qinfo.weight
         self.q_valid[row] = True
-        cap = np.full(self.R, UNBOUNDED, np.float32)
         if qinfo.queue.capability:
+            # dims a capability dict does not name are capped at 0 — the
+            # JobEnqueueable closure's exact encoding (plugins/proportion.py,
+            # mirrored by build_snapshot), consumed by the probe's admission
+            # veto; only a cap-less queue is UNBOUNDED
+            cap = np.zeros(self.R, np.float32)
             for name, v in qinfo.queue.capability.items():
                 if name in self.spec:
                     cap[self.spec.index(name)] = v
+        else:
+            cap = np.full(self.R, UNBOUNDED, np.float32)
         self.q_cap[row] = cap
 
     def free_queue(self, name: str) -> None:
@@ -1025,6 +1031,19 @@ class ColumnStore:
             out["single" if key is None else "sharded"] = cache.counters()
         return out
 
+    def export_delta_record(self, mesh=None):
+        """The last resident swap's row-exact delta record + dirty-tracker
+        version token, for the replication publisher
+        (replicate/publisher.py) — the same knowledge the warm-table carry
+        absorbs, so the wire stream rides the scatter diff instead of
+        re-deriving it.  ``(None, 0)`` when this path has no resident
+        cache (KB_DEVICE_CACHE=0, or no solve dispatched yet); the
+        publisher then self-diffs against its own mirrors."""
+        cache = self._per_cycle_dev.get(mesh)
+        if cache is None:
+            return None, 0
+        return dict(cache.delta_record), int(cache.version)
+
     def drop_resident(self) -> None:
         """Cold-start the device residency — the per-cycle scatter caches
         AND the version-keyed static feature cache: the next solve dispatch
@@ -1078,7 +1097,10 @@ class ColumnStore:
         so the compiled executables and resident buffers survive and
         failover pays no recompile/re-upload. DROP (cold start) on any
         consistency error or an unsynced cache — a mirror of unknown
-        provenance must not feed a solve."""
+        provenance must not feed a solve.  (The replication follower's
+        restart re-adoption — replicate/follower.py
+        ``FollowerApplier.revalidate_resident`` — applies the same
+        keep-iff-synced contract to its wire-fed resident cache.)"""
         errors = [str(e) for e in self.check_consistency(cache)]
         tokens = {
             ("single" if key is None else "sharded"): rc.version

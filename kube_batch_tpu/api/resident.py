@@ -237,6 +237,16 @@ def _mesh_shard_scatter_fn(mesh):
     return fn
 
 
+def changed_rows(mirror: np.ndarray, host: np.ndarray) -> np.ndarray:
+    """Ascending row indices where ``host`` differs from ``mirror`` — the
+    ONE vectorized diff behind both device-cache scatter refreshes AND the
+    replication publisher's wire deltas (replicate/publisher.py), so a
+    follower's scatter payload is row-for-row the leader's."""
+    if host.ndim == 1:
+        return np.flatnonzero(mirror != host)
+    return np.flatnonzero(np.any(mirror != host, axis=1))
+
+
 def scatter_summary(per_path_counters: Dict[str, Dict[str, int]]
                     ) -> Dict[str, Dict]:
     """Per-path counter summary with the delta-vs-full bytes-moved
@@ -338,10 +348,7 @@ class PerCycleDeviceCache:
             self._mirror[field] = host.copy()
             self._dev[field] = dev
             return dev
-        if host.ndim == 1:
-            changed = np.flatnonzero(mirror != host)
-        else:
-            changed = np.flatnonzero(np.any(mirror != host, axis=1))
+        changed = changed_rows(mirror, host)
         if changed.size == 0:
             self.clean_hits += 1
             return self._dev[field]
@@ -590,10 +597,7 @@ class ShardedPerCycleDeviceCache(PerCycleDeviceCache):
             or mirror.dtype != host.dtype
         ):
             return self._full_upload(field, host)
-        if host.ndim == 1:
-            changed = np.flatnonzero(mirror != host)
-        else:
-            changed = np.flatnonzero(np.any(mirror != host, axis=1))
+        changed = changed_rows(mirror, host)
         if changed.size == 0:
             self.clean_hits += 1
             return self._dev[field]

@@ -114,7 +114,9 @@ class DeviceSnapshot(NamedTuple):
     job_allocated: "np.ndarray"     # [J, R] f32 — for DRF shares
     # queues [Q, ...]
     queue_weight: "np.ndarray"      # [Q] f32
-    queue_capability: "np.ndarray"  # [Q, R] f32 (UNBOUNDED where uncapped)
+    queue_capability: "np.ndarray"  # [Q, R] f32 (UNBOUNDED iff no Capability;
+    #                                 a capability dict zeroes unnamed dims —
+    #                                 the JobEnqueueable closure's encoding)
     queue_alloc: "np.ndarray"       # [Q, R] f32
     queue_request: "np.ndarray"     # [Q, R] f32 — total request of queue's jobs
     queue_valid: "np.ndarray"       # [Q] bool
@@ -400,6 +402,11 @@ def build_snapshot(
         queue_weight[i] = q.weight
         queue_valid[i] = True
         if q.queue.capability:
+            # a capability dict caps every dim it does NOT name at 0 — the
+            # JobEnqueueable closure builds its cap from spec.empty()
+            # (plugins/proportion.py), and the probe's admission veto must
+            # read the same encoding; only a cap-less queue is unbounded
+            queue_capability[i] = 0.0
             for name, v in q.queue.capability.items():
                 if name in spec:
                     queue_capability[i, spec.index(name)] = v

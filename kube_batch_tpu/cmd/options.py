@@ -40,6 +40,11 @@ class ServerOption:
     # barrier (POST /v1/sync or a restored state file) — the WaitForCacheSync
     # analog; 0 = don't wait (clients that never signal lose nothing)
     cache_sync_timeout: float = 0.0
+    # replicated read plane (replicate/): a leader URL turns this process
+    # into a what-if follower — no scheduler, no ingest; the pull loop
+    # applies the leader's cycle deltas and the serving stack answers
+    # against the local replica
+    follower: str = ""
 
     def check_option_or_die(self) -> None:
         """(options.go:84-90): leader election requires a lock namespace;
@@ -112,6 +117,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="seconds to wait for the initial-sync barrier "
                              "(POST /v1/sync) before the first cycle; 0 = "
                              "don't wait")
+    parser.add_argument("--follower", default=d.follower, metavar="URL",
+                        help="run as a what-if read replica of the leader at "
+                             "URL (its /v1/replicate stream) instead of "
+                             "scheduling")
 
 
 def parse(argv: Optional[List[str]] = None) -> ServerOption:
@@ -135,6 +144,7 @@ def parse(argv: Optional[List[str]] = None) -> ServerOption:
         print_version=ns.version,
         state_file=ns.state_file,
         cache_sync_timeout=ns.cache_sync_timeout,
+        follower=ns.follower,
     )
     global server_opts
     server_opts = opt

@@ -22,14 +22,28 @@ The reference serves Prometheus `/metrics` (+ pprof) on --listen-address
                                  fast-path breaker, trips, audits, bundles)
 - GET  /v1/trace               — cycle tracing plane: last cycle's span
                                  tree + flight-recorder ring stats
+- GET  /v1/trace/dumps         — flight-recorder dump index; append
+                                 /<name>/<trace.json|meta.json> to stream
+                                 one dump's files (warm standbys and
+                                 followers serve these too)
 - GET  /v1/alerts              — guard trip-rate SLO alert state
 - POST /v1/whatif              — batched what-if / admission probe against
                                  the resident snapshot (serve/; README
                                  "Query plane" for the schema)
+- POST /v1/whatif/sweep        — server-side capacity sweep: binary-search
+                                 the largest feasible replica count against
+                                 ONE snapshot lease
+- GET  /v1/replicate?since=N   — the replication stream (replicate/): the
+                                 leader's KBR1 frame for record N+1, a
+                                 synthesized full snapshot when N fell off
+                                 the ring, or a heartbeat when caught up
 
 `Run` mirrors app.Run (server.go:76-151): build cache + scheduler, start the
 HTTP listener, then run the scheduling loop — optionally gated behind leader
-election."""
+election.  ``--follower http://leader:port`` boots the replicated read
+plane instead (run_follower): no scheduler, no ingest — a pull loop applies
+the leader's cycle deltas to a local device-resident replica and the SAME
+serving stack answers /v1/whatif against it."""
 
 from __future__ import annotations
 
@@ -135,7 +149,10 @@ def make_handler(cache: SchedulerCache, query_plane=None):
             logger.debug("http: " + fmt, *args)
 
         def _send(self, code: int, body: str, ctype="application/json"):
-            data = body.encode()
+            self._send_bytes(code, body.encode(), ctype)
+
+        def _send_bytes(self, code: int, data: bytes,
+                        ctype="application/octet-stream"):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
@@ -240,6 +257,14 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 from kube_batch_tpu.obs.trace import tracer_of
 
                 self._send(200, json.dumps(tracer_of(cache).state()))
+            elif self.path == "/v1/trace/dumps" or self.path.startswith(
+                "/v1/trace/dumps/"
+            ):
+                self._trace_dumps()
+            elif self.path == "/v1/replicate" or self.path.startswith(
+                "/v1/replicate?"
+            ):
+                self._replicate()
             elif self.path == "/v1/alerts":
                 # guard trip-rate SLO alerts (obs/alerts): firing state,
                 # windowed trip counts, thresholds
@@ -252,6 +277,57 @@ def make_handler(cache: SchedulerCache, query_plane=None):
         def _body(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))
             return json.loads(self.rfile.read(n) or b"{}")
+
+        def _replicate(self):
+            """The leader's replication publish endpoint: one KBR1 frame
+            per pull, chosen by the follower's applied cursor (heartbeat
+            when caught up, a synthesized full snapshot when the cursor
+            fell off the ring — the delta-gap escalation)."""
+            from urllib.parse import parse_qs, urlparse
+
+            pub = getattr(cache, "replication", None)
+            if pub is None:
+                self._send(503, json.dumps(
+                    {"error": "replication not enabled"}))
+                return
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                since = int(q.get("since", ["-1"])[0])
+            except ValueError:
+                self._send(400, json.dumps(
+                    {"error": "since must be an integer"}))
+                return
+            self._send_bytes(200, pub.record_for(since))
+
+        def _trace_dumps(self):
+            """Flight-recorder dump streaming: the index lists every dump
+            this process published; /<name>/<trace.json|meta.json> streams
+            one file.  Only names the recorder itself registered resolve —
+            the dump list is the allow-list, so no path escapes it."""
+            from kube_batch_tpu.obs.trace import tracer_of
+
+            recorder = tracer_of(cache).recorder
+            dumps = recorder.stats()["dumps"] if recorder is not None else []
+            by_name = {os.path.basename(p): p for p in dumps}
+            rest = self.path[len("/v1/trace/dumps"):].strip("/")
+            if not rest:
+                self._send(200, json.dumps({
+                    "dumps": sorted(by_name),
+                    "directory": recorder.directory if recorder else None,
+                }))
+                return
+            parts = rest.split("/")
+            root = by_name.get(parts[0])
+            if root is None or len(parts) != 2 or parts[1] not in (
+                "trace.json", "meta.json"
+            ):
+                self._send(404, json.dumps({"error": "no such dump file"}))
+                return
+            try:
+                with open(os.path.join(root, parts[1]), "rb") as f:
+                    self._send_bytes(200, f.read(), "application/json")
+            except OSError as e:
+                self._send(404, json.dumps({"error": str(e)}))
 
         def _ingest(self, delete: bool):
             kind = self.path.rsplit("/", 1)[-1]
@@ -298,11 +374,16 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 self._send(200, "{}")
                 return
             if self.path == "/v1/whatif":
-                self._whatif()
+                self._whatif(lambda body: query_plane.submit(body))
+                return
+            if self.path == "/v1/whatif/sweep":
+                # server-side capacity sweep: binary-search max replicas
+                # against ONE lease (the autoscaler's "how many fit" ask)
+                self._whatif(lambda body: query_plane.submit_sweep(body))
                 return
             self._ingest(delete=False)
 
-        def _whatif(self):
+        def _whatif(self, submit):
             """The query plane's serving endpoint: validate, enqueue into
             the micro-batcher, block this handler thread on the per-request
             future (ThreadingHTTPServer gives every request its own thread,
@@ -322,7 +403,7 @@ def make_handler(cache: SchedulerCache, query_plane=None):
                 self._send(400, json.dumps({"error": str(e)}))
                 return
             try:
-                fut = query_plane.submit(body)
+                fut = submit(body)
                 resp = fut.result(timeout=query_plane.dispatch_timeout + 8)
             except WhatifError as e:
                 self._send(e.status, json.dumps({"error": str(e)}))
@@ -495,10 +576,49 @@ def run_warm_standby(elector, sched: Scheduler, cache: SchedulerCache,
             elector.reset()
 
 
+def run_follower(opt: ServerOption) -> None:
+    """The replicated read plane's process loop (--follower URL): no
+    scheduler, no ingest — a pull thread subscribes to the leader's
+    /v1/replicate stream, applies cycle deltas to a local device-resident
+    ColumnStore replica, and the admin listener serves the SAME /v1/whatif
+    stack (plus sweep/trace/metrics) against it.  Horizontal read scale:
+    each follower owns its own devices and probe executables, so serving
+    QPS adds up across follower processes while the leader pays one encode
+    per cycle regardless of fan-out."""
+    from kube_batch_tpu.envutil import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+
+    from kube_batch_tpu.replicate.follower import (
+        FollowerCache,
+        ReplicationFollower,
+    )
+    from kube_batch_tpu.serve.plane import QueryPlane
+
+    cache = FollowerCache()
+    query_plane = QueryPlane(cache, prewarm=True)
+    follower = ReplicationFollower(opt.follower, cache=cache,
+                                   query_plane=query_plane)
+    host, port = opt.listen_host_port
+    admin = AdminServer(cache, host, port, query_plane=query_plane)
+    admin.start()
+    logger.info("follower serving on %s:%d, replicating from %s", host,
+                admin.port, opt.follower)
+    follower.start()
+    try:
+        follower.join()
+    finally:
+        follower.stop()
+        query_plane.close()
+        admin.stop()
+
+
 def run(opt: ServerOption) -> None:
     """app.Run (server.go:76-151): metrics/admin listener up front, then the
     scheduling loop — behind leader election when enabled. Option validation
     and --version live in cmd/main.py."""
+    if opt.follower:
+        return run_follower(opt)
     from kube_batch_tpu.envutil import enable_persistent_compilation_cache
 
     enable_persistent_compilation_cache()  # restart re-pays no solve compiles
@@ -569,6 +689,18 @@ def run(opt: ServerOption) -> None:
         from kube_batch_tpu.serve.plane import QueryPlane
 
         query_plane = QueryPlane(cache, prewarm=True)
+        # the replication publisher (replicate/): each cycle's resident
+        # swap goes out as a wire delta on GET /v1/replicate for follower
+        # read replicas; KB_REPLICATE=0 opts out.  Publisher encode runs
+        # overlapped like the writeback stage (scheduler.drain_pipeline
+        # joins it), so the leader's cycle pays ~one host diff.
+        if os.environ.get("KB_REPLICATE", "").strip().lower() not in (
+            "0", "false", "off", "no"
+        ):
+            from kube_batch_tpu.obs.trace import tracer_of
+            from kube_batch_tpu.replicate.publisher import ReplicationPublisher
+
+            cache.replication = ReplicationPublisher(tracer=tracer_of(cache))
     host, port = opt.listen_host_port
     admin = AdminServer(cache, host, port, query_plane=query_plane)
     admin.start()
@@ -624,4 +756,7 @@ def run(opt: ServerOption) -> None:
             watcher.stop()
         if query_plane is not None:
             query_plane.close()
+        pub = getattr(cache, "replication", None)
+        if pub is not None:
+            pub.close()
         admin.stop()

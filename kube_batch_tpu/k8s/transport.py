@@ -467,6 +467,22 @@ class ApiTransport:
 
         return self._call("read", attempt, retry=retry)
 
+    def get_bytes(self, path: str, timeout: float = 60,
+                  retry: bool = True) -> bytes:
+        """Raw-bytes GET under the same ``read`` retry class — the
+        replication follower's frame pull (replicate/follower.py) and the
+        flight-recorder dump fetch; JSON endpoints use :meth:`get_json`."""
+        def attempt():
+            req = urllib.request.Request(
+                self.api_server + path, headers=self.headers()
+            )
+            with urllib.request.urlopen(
+                req, context=self._ctx, timeout=timeout
+            ) as r:
+                return r.read()
+
+        return self._call("read", attempt, retry=retry)
+
     def stream_lines(self, path: str, timeout: float = 330):
         """Yield decoded JSON objects from a chunked watch stream. The
         CONNECT rides the policy/breaker (class ``watch``, budget 1 — the

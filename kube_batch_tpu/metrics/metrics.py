@@ -312,6 +312,35 @@ ALERTS_FIRING = Gauge(
     "1 while the named SLO alert fires (guard trip-rate thresholds)",
     ("alert",),
 )
+# replicated follower read plane (kube_batch_tpu/replicate): the leader's
+# published stream (records/bytes by kind), the follower's apply/resync
+# outcomes, and its live lag behind the stream head in cycles
+REPLICATION_RECORDS = Counter(
+    f"{_SUBSYSTEM}_replication_records_total",
+    "Replication records published, by kind (full|delta|heartbeat)",
+    ("kind",),
+)
+REPLICATION_BYTES = Counter(
+    f"{_SUBSYSTEM}_replication_bytes_total",
+    "Replication wire bytes published (encoded frames)",
+)
+REPLICATION_APPLIED = Counter(
+    f"{_SUBSYSTEM}_replication_applied_total",
+    "Replication records applied by this follower, by kind (full|delta)",
+    ("kind",),
+)
+REPLICATION_RESYNCS = Counter(
+    f"{_SUBSYSTEM}_replication_resyncs_total",
+    "Delta-chain gaps that escalated this follower to a full resync",
+)
+REPLICATION_LAG = Gauge(
+    f"{_SUBSYSTEM}_replication_lag_cycles",
+    "Cycles this follower's applied state trails the stream head",
+)
+WHATIF_SWEEPS = Counter(
+    f"{_SUBSYSTEM}_whatif_sweeps_total",
+    "Capacity sweeps (/v1/whatif/sweep) served",
+)
 
 METRICS = [
     E2E_LATENCY,
@@ -353,6 +382,12 @@ METRICS = [
     STAGE_LATENCY,
     FLIGHT_DUMPS,
     ALERTS_FIRING,
+    REPLICATION_RECORDS,
+    REPLICATION_BYTES,
+    REPLICATION_APPLIED,
+    REPLICATION_RESYNCS,
+    REPLICATION_LAG,
+    WHATIF_SWEEPS,
 ]
 
 
@@ -497,6 +532,28 @@ def observe_whatif_latency(ms: float) -> None:
 
 def set_whatif_snapshot_version(version: int) -> None:
     WHATIF_SNAPSHOT_VERSION.set(float(version))
+
+
+def register_replication_record(kind: str, nbytes: int) -> None:
+    REPLICATION_RECORDS.inc(kind)
+    if nbytes:
+        REPLICATION_BYTES.add(float(nbytes))
+
+
+def register_replication_applied(kind: str) -> None:
+    REPLICATION_APPLIED.inc(kind)
+
+
+def register_replication_resync() -> None:
+    REPLICATION_RESYNCS.inc()
+
+
+def set_replication_lag(lag: int) -> None:
+    REPLICATION_LAG.set(float(lag))
+
+
+def register_whatif_sweep() -> None:
+    WHATIF_SWEEPS.inc()
 
 
 # optional exact-sample sink for the decision-latency stream: the bench

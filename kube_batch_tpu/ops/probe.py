@@ -32,13 +32,17 @@ real cycle can still out-compete the gang at submission time — that race is
 inherent to any what-if and is what the lease's ``snapshot_version`` lets
 clients reason about.
 
-Modeled scope: the probe answers for the allocate/preempt solve only.
-Best-effort members (every semantic request below the resource quanta —
-including an empty request map) are never solver-pending, so an
-all-best-effort gang reports ``feasible: false`` with an empty fit-error
-histogram even though the backfill action would bind exactly such pods;
-like the queue-state ``JobEnqueueable`` veto, the backfill path is a
-documented non-goal (README "Query plane", ROADMAP follow-ons).
+Modeled scope: the probe answers for the allocate/preempt solve plus the
+enqueue action's FULL admission gate — both the cluster-capability test
+(1.2×total − used) and the queue-state ``JobEnqueueable`` veto
+(proportion.go:211-233): a gang naming a known queue is also checked
+against that queue's capability minus its current allocation, exactly the
+test :mod:`actions.enqueue` applies at enqueue time.  Best-effort members
+(every semantic request below the resource quanta — including an empty
+request map) are never solver-pending, so an all-best-effort gang reports
+``feasible: false`` with an empty fit-error histogram even though the
+backfill action would bind exactly such pods; the backfill path is the one
+remaining documented non-goal (README "Query plane", ROADMAP follow-ons).
 
 Shapes are jit-stable: B is the batcher's fixed batch bucket, G the gang
 bucket (padded members have ``valid`` off), so steady-state serving never
@@ -118,7 +122,7 @@ class ProbeResult(NamedTuple):
     committed: jnp.ndarray     # [B] bool — the gang commit gate's verdict
     feasible: jnp.ndarray      # [B] bool — every valid member placed
     reasons: jnp.ndarray       # [B, G, N_REASONS] i32 — per-member fit-error histogram
-    enqueue_ok: jnp.ndarray    # [B] bool — MinResources vs 1.2×total−used
+    enqueue_ok: jnp.ndarray    # [B] bool — capability gate + queue JobEnqueueable veto
     claim_node: jnp.ndarray    # [B, G] i32 — eviction claim node, -1 (preempt probe)
     victims: jnp.ndarray       # [B, T] bool — hypothetical eviction set
     evict_covered: jnp.ndarray  # [B] bool — eviction claims passed the commit gate
@@ -209,17 +213,23 @@ def overcommit_idle(snap: DeviceSnapshot) -> jnp.ndarray:
     return jnp.maximum(snap.total * OVERCOMMIT_FACTOR - used, 0.0)
 
 
-def _admission_verdict(idle, quanta, min_res, has_min_res):
-    """The enqueue action's capability core for ONE speculative podgroup:
+def _admission_verdict(idle, quanta, min_res, has_min_res,
+                       queue_alloc, queue_cap, queue_known):
+    """The enqueue action's admission core for ONE speculative podgroup:
     MinResources ≤ the overcommitted idle budget, tolerating a sub-quantum
     excess (enqueue.go:74-81,102-117; ops/admission.gate_scan's fit test
     with an empty prior admission set — the probe's gang is the only
-    candidate).  No MinResources → unconditional promotion
-    (enqueue.go:102-105).  Queue-state JobEnqueueable vetoes
-    (proportion.go:211-233) are not modeled — the probe verdict is the
-    static capability gate."""
+    candidate), AND the queue-state ``JobEnqueueable`` veto
+    (proportion.go:211-233): MinResources plus the queue's current
+    allocation must fit the queue's capability, with the same sub-quantum
+    tolerance (actions/enqueue.py's ``need − cap < quanta`` test).  An
+    unknown or invalid queue skips the veto — the reference treats a
+    missing queue attribute as enqueueable.  No MinResources →
+    unconditional promotion (enqueue.go:102-105)."""
     fits_cap = jnp.all((min_res <= idle) | (min_res - idle < quanta))
-    return ~has_min_res | fits_cap
+    need = min_res + queue_alloc
+    fits_queue = jnp.all((need <= queue_cap) | (need - queue_cap < quanta))
+    return ~has_min_res | (fits_cap & (~queue_known | fits_queue))
 
 
 def _evict_probe(snap: DeviceSnapshot, req, pending, queue, min_avail,
@@ -353,8 +363,12 @@ def probe_gang_core(snap: DeviceSnapshot, view: DeviceSnapshot, g: ProbeBatch,
     # not this solve — would bind sub-quanta pods (module docstring)
     feasible &= jnp.any(view.task_pending)
     reasons = hist_fn()
+    Q = snap.queue_valid.shape[0]
+    qsafe = jnp.clip(g.queue, 0, Q - 1)
+    queue_known = (g.queue >= 0) & (g.queue < Q) & snap.queue_valid[qsafe]
     enqueue_ok = _admission_verdict(
-        oc_idle, snap.quanta, g.min_res, g.has_min_res
+        oc_idle, snap.quanta, g.min_res, g.has_min_res,
+        snap.queue_alloc[qsafe], snap.queue_capability[qsafe], queue_known,
     )
 
     if with_evictions:

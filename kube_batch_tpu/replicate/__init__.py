@@ -1,0 +1,23 @@
+"""Replicated follower read plane (ISSUE 16).
+
+The leader publishes each cycle's resident swap as a wire-format
+replication record — per-field row/value scatter payloads with the same
+full-upload escalation discipline as api/resident.py, plus the
+dirty-tracker version token — and follower processes apply the deltas to
+their own device-resident snapshot copy and run the full serve/ stack
+(lease broker, micro-batcher, probe kernel, prewarm) against it.
+
+- :mod:`.stream`    — the KBR1 frame format: encode/decode, config wire.
+- :mod:`.publisher` — the leader side: host mirrors, deferred encode,
+  ring buffer, ``record_for(since)`` serving.
+- :mod:`.follower`  — the follower side: pull loop over k8s/transport,
+  applier (delta apply + resync escalation), FollowerCache shim.
+"""
+
+from kube_batch_tpu.replicate.stream import (  # noqa: F401
+    ReplicationRecord, decode_record, encode_record,
+)
+from kube_batch_tpu.replicate.publisher import ReplicationPublisher  # noqa: F401
+from kube_batch_tpu.replicate.follower import (  # noqa: F401
+    FollowerApplier, FollowerCache, ReplicationFollower,
+)

@@ -402,6 +402,12 @@ class Scheduler:
         ingest — the deterministic post-cycle state the serial run_once
         gives inline.  Tests, the sim, and shutdown call this."""
         self._await_writeback()
+        # the replication publisher's encode stage overlaps the next cycle
+        # exactly like the writeback worker — join it at the same barrier
+        # so a drained pipeline has the cycle's record on the stream
+        rep = getattr(self.cache, "replication", None)
+        if rep is not None:
+            rep.barrier()
         drain = getattr(self.cache, "drain_staged_ingest", None)
         if drain is not None:
             metrics.register_staged_ingest(drain())

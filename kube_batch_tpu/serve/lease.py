@@ -51,6 +51,10 @@ class SnapshotLease(NamedTuple):
     #: probe does NOT model (drf/proportion) — surfaced per response as
     #: `unmodeled: [...]` so clients can't silently over-trust a verdict
     unmodeled_gates: tuple = ()
+    #: replication-stream record sequence number this lease's state
+    #: corresponds to (replicate/); 0 = unreplicated.  Every verdict's
+    #: staleness block is ``head_seq - seq`` in cycles.
+    seq: int = 0
 
 
 def _donation_active() -> bool:

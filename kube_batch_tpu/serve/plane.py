@@ -11,7 +11,11 @@ sharded.
 
 Probe answers are oracle-exact on a frozen snapshot (ops/probe.py module
 docstring); the lease's ``snapshot_version`` tells clients which cache
-state answered them.
+state answered them, and every verdict carries a ``staleness`` block
+(lease seq/version vs the publisher head — replicate/) bounding how far
+behind the leader the serving state is.  The same plane serves follower
+processes: replicate/follower.py publishes wire-rebuilt leases into this
+broker and points :attr:`QueryPlane.head_fn` at the stream head.
 """
 
 from __future__ import annotations
@@ -37,6 +41,10 @@ MAX_GANG = 64
 #: the probe batch's integer columns are i32 — out-of-range values must
 #: 400 their own request at parse time, never overflow inside the flush
 _I32_MAX = 2**31 - 1
+
+#: /v1/whatif/sweep: the geometric count grid the first dispatch pass
+#: probes to bracket the feasibility boundary before binary search
+_SWEEP_GRID = (1, 2, 4, 8, 16, 32, 64)
 
 
 class WhatifError(Exception):
@@ -176,6 +184,10 @@ class QueryPlane:
         self._swap_guard = self.broker.swap_guard
         cols.resident_swap_guard = self._swap_guard
         cache.query_plane = self
+        # replication head source for the staleness block: None (the
+        # leader — the lease IS the head) or a () -> (head_seq,
+        # head_version) callable (followers point it at their applier)
+        self.head_fn = None
         self.batcher = MicroBatcher(
             self._flush, max_batch=max_batch, window_s=window_s,
             max_queue=max_queue, start_thread=start_thread,
@@ -267,6 +279,23 @@ class QueryPlane:
             queue_rows=queue_rows,
             unmodeled_gates=tuple(sorted(gates & {"drf", "proportion"})),
         )
+        pub = getattr(self.cache, "replication", None)
+        if pub is not None:
+            # publish the cycle onto the replication stream BEFORE the
+            # broker install, so the lease carries the record's seq and
+            # leader verdicts report the same staleness coordinates a
+            # caught-up follower's do.  The resident swap's own delta
+            # record rides along as the diff fast path.
+            try:
+                hint, hint_version = cols.export_delta_record(mesh)
+                seq = pub.publish_cycle(
+                    snap, meta, lease, delta_hint=hint,
+                    cache_version=hint_version,
+                )
+                lease = lease._replace(seq=seq)
+            except Exception:  # noqa: BLE001 — replication must never stall the cycle
+                logger.exception(
+                    "replication publish failed; followers will resync")
         self.broker.publish(lease)
         metrics.set_whatif_snapshot_version(lease.version)
         if self._prewarm:
@@ -343,6 +372,27 @@ class QueryPlane:
         # future (batcher.submit never raises)
         return self.batcher.submit(req)
 
+    def submit_sweep(self, body: dict) -> Future:
+        """Validate and enqueue one /v1/whatif/sweep request — the
+        server-side "how many replicas of this gang fit" binary search.
+        The body is a normal whatif body plus ``max_count`` (default the
+        gang cap); ``count``/``min_available`` are ignored — each probed
+        point c asks for a gang of c members, all required
+        (min_available=c).  The future resolves to the sweep response."""
+        req = _parse_request(body, self.cache.spec)
+        if req["evictions"]:
+            raise WhatifError(400, "sweep does not support evictions")
+        try:
+            max_count = int(body.get("max_count", MAX_GANG))
+        except (TypeError, ValueError):
+            raise WhatifError(400, "max_count must be an integer")
+        if not 1 <= max_count <= MAX_GANG:
+            raise WhatifError(
+                400, f"max_count must be in [1, {MAX_GANG}]")
+        req["max_count"] = max_count
+        req["_sweep"] = True
+        return self.batcher.submit(req)
+
     # ------------------------------------------------------------------
     # batch flush — ONE device dispatch for every queued request
     # ------------------------------------------------------------------
@@ -361,12 +411,17 @@ class QueryPlane:
         # request must not make every co-batched plain probe pay the
         # eviction pass's device time (each sub-batch is still a jit-stable
         # (B, G) bucket — at most two dispatches per window, answered
-        # against the SAME lease)
+        # against the SAME lease).  Sweeps run their own multi-dispatch
+        # search, still inside the single held dispatch region, so every
+        # probed point answers against one snapshot.
+        sweeps = [(r, f) for r, f in batch if r.get("_sweep")]
+        plain = [(r, f) for r, f in batch if not r.get("_sweep")]
         subs = [
-            [(r, f) for r, f in batch if not r["evictions"]],
-            [(r, f) for r, f in batch if r["evictions"]],
+            [(r, f) for r, f in plain if not r["evictions"]],
+            [(r, f) for r, f in plain if r["evictions"]],
         ]
         done = []
+        done_sweeps = []
         with self.broker.dispatch(timeout=self.dispatch_timeout) as lease:
             if lease is None:
                 err = WhatifError(
@@ -390,6 +445,23 @@ class QueryPlane:
                             fut, error=WhatifError(500, f"probe failed: {e}")
                         ):
                             metrics.register_whatif_request("error")
+            for req, fut in sweeps:
+                try:
+                    done_sweeps.append((req, fut, self._sweep(lease, req)))
+                except Exception as e:  # noqa: BLE001 — fail THIS sweep, keep serving
+                    logger.exception("whatif sweep failed")
+                    if self._deliver(
+                        fut, error=WhatifError(500, f"sweep failed: {e}")
+                    ):
+                        metrics.register_whatif_request("error")
+        for req, fut, resp in done_sweeps:
+            if not self._deliver(fut, result=resp):
+                continue
+            metrics.register_whatif_sweep()
+            metrics.observe_whatif_latency(
+                (telemetry.perf_counter() - req["_t0"]) * 1e3
+            )
+            self.requests_served += 1
         for sub, results in done:
             for (req, fut), resp in zip(sub, results):
                 if not self._deliver(fut, result=resp):
@@ -400,6 +472,54 @@ class QueryPlane:
                     (telemetry.perf_counter() - req["_t0"]) * 1e3
                 )
                 self.requests_served += 1
+
+    def _sweep(self, lease: SnapshotLease, req: dict) -> dict:
+        """Binary-search the largest replica count whose gang fits,
+        against ONE lease: a geometric grid pass brackets the feasibility
+        boundary (one or two chunked probe dispatches), then classic
+        binary search refines it — the server does the log(N) probes the
+        client would otherwise issue as round-trips, and every point
+        answers against the same snapshot (feasibility is monotone in
+        count on a frozen snapshot: a (c+1)-gang placement contains a
+        c-gang placement)."""
+        max_count = req["max_count"]
+        feasible: Dict[int, bool] = {}
+        probes = 0
+
+        def probe(counts: List[int]) -> None:
+            nonlocal probes
+            for i in range(0, len(counts), self.batcher.max_batch):
+                chunk = counts[i:i + self.batcher.max_batch]
+                reqs = [dict(req, count=c, min_avail=c) for c in chunk]
+                for c, r in zip(chunk, self._probe(lease, reqs)):
+                    feasible[c] = bool(r["feasible"])
+                probes += len(chunk)
+
+        grid = sorted({c for c in _SWEEP_GRID if c < max_count}
+                      | {max_count})
+        probe(grid)
+        if not feasible[grid[0]]:
+            lo = 0
+        elif feasible[max_count]:
+            lo = max_count
+        else:
+            lo = max(c for c in grid if feasible[c])
+            hi = min(c for c in grid if not feasible[c])
+            while hi - lo > 1:
+                mid = (lo + hi) // 2
+                probe([mid])
+                if feasible[mid]:
+                    lo = mid
+                else:
+                    hi = mid
+        return {
+            "snapshot_version": lease.version,
+            "max_fit": lo,
+            "feasible": lo >= 1,
+            "max_count": max_count,
+            "probes": probes,
+            "staleness": self._staleness(lease),
+        }
 
     @staticmethod
     def _deliver(fut: Future, result=None, error=None) -> bool:
@@ -524,6 +644,25 @@ class QueryPlane:
             self._decode(lease, r, host, b) for b, r in enumerate(reqs)
         ]
 
+    def _staleness(self, lease: SnapshotLease) -> dict:
+        """The version-token-bounded staleness block every verdict
+        carries: this lease's replication coordinates vs the stream head.
+        On the leader (``head_fn`` unset) the lease IS the head — lag 0
+        by construction; a follower reports the head of its last fetched
+        frame, so ``lag_cycles`` bounds how many cycles behind the
+        answering state is."""
+        head_seq, head_version = (
+            self.head_fn() if self.head_fn is not None
+            else (lease.seq, lease.version)
+        )
+        return {
+            "seq": lease.seq,
+            "version": lease.version,
+            "head_seq": head_seq,
+            "head_version": head_version,
+            "lag_cycles": max(0, head_seq - lease.seq),
+        }
+
     def _decode(self, lease: SnapshotLease, req: dict, host, b: int) -> dict:
         from kube_batch_tpu.ops.feasibility import REASON_MESSAGES
 
@@ -566,6 +705,7 @@ class QueryPlane:
             "pipelined": [bool(p) for p in pipelined.tolist()],
             "unplaced": unplaced,
             "unmodeled": unmodeled,
+            "staleness": self._staleness(lease),
         }
         if unplaced:
             # fit-error reasons summed over the unplaced members — the same

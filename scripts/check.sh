@@ -74,3 +74,11 @@ echo "kbt-check: warm smoke (KB_WARM A/B, warm-churn preset)"
 env JAX_PLATFORMS=cpu python -m kube_batch_tpu.sim \
   --preset warm-churn --seed 3 --warm-ab --no-fairness-series >/dev/null
 echo "kbt-check: warm smoke clean"
+
+# replication smoke: the replicate/ follower read plane over real loopback
+# HTTP — a leader + two pull-loop followers under randomized churn, with
+# bit-matched /v1/whatif(+/sweep) verdicts once caught up, staleness p99
+# ≤ 1 cycle on live followers, and serving continuity + warm re-adoption
+# through one follower kill/restart (scripts/replication_smoke.py)
+echo "kbt-check: replication smoke (leader + 2 followers)"
+env JAX_PLATFORMS=cpu python scripts/replication_smoke.py

@@ -361,6 +361,103 @@ class TestWhatifOracle:
             qp.submit({"count": 1, "priority": 2**40})
 
 
+class TestQueueAdmissionVeto:
+    """The queue-state half of the admission verdict: JobEnqueueable
+    (plugins/proportion.py) vetoes a gang whose min_resources plus the
+    queue's current allocation exceed its Capability — the probe must
+    apply the same veto, with the same quanta tolerance, as the
+    committed enqueue action."""
+
+    def _capped_cache(self):
+        # queue "capped" holds 6000 cpu / 4 GiB of running load against a
+        # 10000-cpu capability; the CLUSTER has far more idle than that,
+        # so only the queue veto separates the verdicts below
+        return build_cache(
+            queues=[Queue(name="capped", weight=1,
+                          capability={"cpu": 10000.0, "memory": 64 * GiB,
+                                      "pods": 16.0})],
+            pod_groups=[PodGroup(name="run0", namespace="c1", min_member=1,
+                                 queue="capped")],
+            nodes=[build_node(f"n{i}", cpu=16000, mem=64 * GiB, pods=64)
+                   for i in range(2)],
+            pods=[build_pod("c1", "r0", "n0", PodPhase.RUNNING,
+                            {"cpu": 6000, "memory": 4 * GiB},
+                            group_name="run0")],
+        )
+
+    def test_queue_capability_vetoes_over_cap_min_resources(
+            self, plane_factory):
+        cache = self._capped_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        base = {"queue": "capped", "count": 1,
+                "requests": {"cpu": 100, "memory": GiB}}
+        # 6000 allocated + 3000 = 9000 ≤ 10000 → admitted
+        under = _probe(qp, dict(base, min_resources={"cpu": 3000}))
+        assert under["enqueue_admitted"]
+        # 6000 + 8000 = 14000 > 10000 → queue veto, even though the
+        # cluster-wide capability gate alone (idle ≈ 32400) would admit
+        over = _probe(qp, dict(base, min_resources={"cpu": 8000}))
+        assert not over["enqueue_admitted"]
+        assert over["feasible"], "the veto is advisory, not a placement gate"
+
+    def test_veto_honors_quanta_tolerance(self, plane_factory):
+        """Resource.less_equal admits need−cap below the per-dim quantum
+        (MIN_MILLI_CPU = 10); the columnar verdict must agree at the
+        boundary."""
+        cache = self._capped_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        base = {"queue": "capped", "count": 1,
+                "requests": {"cpu": 100, "memory": GiB}}
+        within = _probe(qp, dict(base, min_resources={"cpu": 4005}))
+        assert within["enqueue_admitted"]      # need 10005, over by 5 < 10
+        beyond = _probe(qp, dict(base, min_resources={"cpu": 4020}))
+        assert not beyond["enqueue_admitted"]  # need 10020, over by 20
+
+    def test_unknown_queue_skips_the_veto(self, plane_factory):
+        """A queue the snapshot does not know (proportion's attrs map has
+        no entry) cannot veto — only the cluster capability gate applies,
+        exactly like jobEnqueueableFns finding no attr."""
+        cache = self._capped_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        resp = _probe(qp, {"queue": "ghost", "count": 1,
+                           "requests": {"cpu": 100, "memory": GiB},
+                           "min_resources": {"cpu": 8000}})
+        assert resp["enqueue_admitted"]
+
+    def test_verdict_mirrors_committed_enqueue_action(self, plane_factory):
+        """Probe verdicts vs the real enqueue action on the same state:
+        the over-cap gang stays Pending, the under-cap gang goes InQueue —
+        matching enqueue_admitted per gang."""
+        from kube_batch_tpu.api.types import PodGroupPhase
+
+        cache = self._capped_cache()
+        qp = plane_factory(cache)
+        _run(cache)
+        verdicts = {}
+        for name, cpu in (("over", 8000.0), ("under", 3000.0)):
+            verdicts[name] = _probe(qp, {
+                "queue": "capped", "count": 1,
+                "requests": {"cpu": 100, "memory": GiB},
+                "min_resources": {"cpu": cpu},
+            })["enqueue_admitted"]
+            cache.add_pod_group(PodGroup(
+                name=name, namespace="c1", min_member=1, queue="capped",
+                min_resources={"cpu": cpu}, phase=PodGroupPhase.PENDING,
+            ))
+            cache.add_pod(build_pod(
+                "c1", f"{name}-0", None, PodPhase.PENDING,
+                {"cpu": 100, "memory": GiB}, group_name=name))
+        assert verdicts == {"over": False, "under": True}
+        _run(cache, names=("enqueue",))
+        phases = {name: cache.jobs[f"c1/{name}"].pod_group.phase
+                  for name in ("over", "under")}
+        assert phases["over"] == PodGroupPhase.PENDING
+        assert phases["under"] == PodGroupPhase.INQUEUE
+
+
 class TestPeekTaskRows:
     def test_peek_matches_alloc_order_across_free_and_growth(self):
         """peek(k) must predict alloc() exactly — free-list LIFO first,
