@@ -862,6 +862,51 @@ def hbm_round_head_model(T=500_000, N=50_000, R=8, node_ring=8,
     }
 
 
+def hbm_headroom_bench():
+    """The tier-C audit's bytes-vs-budget numbers as a bench section, so
+    the headroom trajectory is tracked across PRs like any other perf
+    number.  Tracing is abstract (no device memory, backend-independent):
+    the peaks are the liveness model's per-device bytes at each ladder
+    point — see analysis/hbm_audit.py for the model and its documented
+    overestimate-direction slack.  Entries that fail to trace at a point
+    record ``traced: false`` (the audit's KBT000 covers the alarm)."""
+    from kube_batch_tpu.analysis.hbm_audit import GIB, headroom_report
+
+    rep = headroom_report()
+    entries = {}
+    worst = None
+    for name, per_point in rep["entries"].items():
+        compact = {}
+        for pt, d in per_point.items():
+            if not d["traced"]:
+                compact[pt] = {"traced": False}
+                continue
+            compact[pt] = {
+                "peak_gib": round(d["peak_bytes"] / GIB, 3),
+                "headroom_gib": round(d["headroom_bytes"] / GIB, 3),
+                "over_budget": d["over_budget"],
+            }
+            if worst is None or d["peak_bytes"] > worst[2]:
+                worst = (name, pt, d["peak_bytes"])
+        entries[name] = compact
+    out = {
+        "budget_gib": round(rep["budget_bytes"] / GIB, 1),
+        "budget_profile": rep["budget_profile"],
+        "points": {
+            p["name"]: {"tasks": p["tasks"], "nodes": p["nodes"],
+                        "T": p["T"], "N": p["N"], "P": p["P"]}
+            for p in rep["points"]
+        },
+        "entries": entries,
+    }
+    if worst is not None:
+        out["worst"] = {
+            "entry": worst[0], "point": worst[1],
+            "peak_gib": round(worst[2] / GIB, 3),
+        }
+    return out
+
+
 def task_axis_probe(conf, n_tasks, n_nodes, cycles=3):
     """The task-axis-sharded cycle: rerun the steady-state regime on a 2-D
     (tasks=2 × nodes) mesh (KB_TASK_SHARDS=2) and report that the cycle
@@ -1383,6 +1428,12 @@ def main() -> None:
             result["lock_profile"] = lock_profile_bench(conf, cycles=6)
         except Exception as e:  # noqa: BLE001
             result["lock_profile_error"] = f"{type(e).__name__}: {e}"
+        # tier-C HBM headroom: abstract traces, identical on any backend —
+        # a wedged tunnel changes nothing about the liveness model's bytes
+        try:
+            result["hbm_headroom"] = hbm_headroom_bench()
+        except Exception as e:  # noqa: BLE001
+            result["hbm_headroom_error"] = f"{type(e).__name__}: {e}"
         # sharded steady-state evidence on a forced 4-device host mesh — a
         # child process, because the device count must be fixed before the
         # child's jax initializes (this process is already single-device)
@@ -1506,6 +1557,13 @@ def main() -> None:
     if section("lock_profile", margin_s=60):
         with guarded("lock_profile"):
             result["lock_profile"] = lock_profile_bench(conf)
+
+    # ---- tier-C HBM headroom: the liveness audit's peak-live-bytes vs the
+    # v5e budget per entry per ladder point — abstract traces only, so the
+    # numbers are identical on any backend and regress visibly in the JSON
+    if section("hbm_headroom", margin_s=90):
+        with guarded("hbm_headroom"):
+            result["hbm_headroom"] = hbm_headroom_bench()
 
     # ---- the SHARDED steady-state regime: same persistent-cache churn
     # cycle over the device mesh — the per-shard scatter-delta residency's

@@ -3,7 +3,12 @@
 Tier A (default): the static AST/flow rules over the package tree.
 Tier B (``--jaxpr``): the jaxpr-level audit of the registered jitted entry
 points (analysis/jaxpr_audit.py) — added to the static run; ``--jaxpr-only``
-skips tier A.  ``--select``/``--jsonl`` apply to both tiers uniformly.
+skips tier A.
+Tier C (``--hbm``): the liveness/HBM-budget audit (analysis/hbm_audit.py) —
+traces every registered entry point at the abstract shape ladder up to the
+1M×100k north star and checks peak live bytes against the backend budget;
+``--hbm-only`` runs just that tier.  ``--select``/``--jsonl`` apply to all
+tiers uniformly.
 
 Exit status: 0 clean, 1 findings, 2 usage error.  `--jsonl` emits one JSON
 object per finding on stdout for CI consumption; the human format is
@@ -38,7 +43,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--select", metavar="RULES",
         help="comma-separated rule ids to run (default: all); KBT10x ids "
-             "select jaxpr-audit checks",
+             "select jaxpr-audit checks, KBT20x ids select HBM-audit checks",
     )
     parser.add_argument(
         "--jaxpr", action="store_true",
@@ -50,12 +55,23 @@ def main(argv=None) -> int:
         help="run only the jaxpr audit tier",
     )
     parser.add_argument(
+        "--hbm", action="store_true",
+        help="additionally run the liveness/HBM-budget audit of every "
+             "registered entry point at the abstract shape ladder "
+             "(imports jax; CPU-safe — traces only, never allocates)",
+    )
+    parser.add_argument(
+        "--hbm-only", action="store_true",
+        help="run only the HBM audit tier",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog",
     )
     args = parser.parse_args(argv)
 
     # the audit-rule ids live here, not in rules.py — keep the static tier
     # importable without jax
+    from kube_batch_tpu.analysis.hbm_audit import HBM_RULES
     from kube_batch_tpu.analysis.jaxpr_audit import AUDIT_RULES
 
     if args.list_rules:
@@ -64,42 +80,54 @@ def main(argv=None) -> int:
             print(f"{rule.id}  {rule.title}  [{scope}]")
         for rid, title in AUDIT_RULES.items():
             print(f"{rid}  {title}  [jaxpr audit]")
+        for rid, title in HBM_RULES.items():
+            print(f"{rid}  {title}  [hbm audit]")
         return 0
 
     static_rules = None
     audit_select = None
+    hbm_select = None
     if args.select:
         ids = [r.strip() for r in args.select.split(",") if r.strip()]
         unknown = [r for r in ids
-                   if r not in RULES_BY_ID and r not in AUDIT_RULES]
+                   if r not in RULES_BY_ID and r not in AUDIT_RULES
+                   and r not in HBM_RULES]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
         static_ids = [r for r in ids if r in RULES_BY_ID]
         audit_ids = [r for r in ids if r in AUDIT_RULES]
+        hbm_ids = [r for r in ids if r in HBM_RULES]
         # with an explicit selection, each tier runs exactly its selected
         # rules: naming audit rules implies the audit tier, and a selection
         # with NO audit ids skips the audit entirely even under --jaxpr —
         # tracing six entry points only to discard every finding would
         # both waste the cost and let CI believe the tier ran
         audit_select = audit_ids
-        if audit_ids:
-            args.jaxpr = True
-            if not static_ids:
-                args.jaxpr_only = True
-        else:
-            args.jaxpr = False
-            args.jaxpr_only = False
+        hbm_select = hbm_ids
+        args.jaxpr = bool(audit_ids)
+        args.hbm = bool(hbm_ids)
+        only_implied = not static_ids
+        args.jaxpr_only = bool(audit_ids) and only_implied
+        args.hbm_only = bool(hbm_ids) and only_implied
         if static_ids:
             static_rules = [RULES_BY_ID[r] for r in static_ids]
 
+    skip_static = args.jaxpr_only or args.hbm_only
+    if args.select:
+        skip_static = static_rules is None
+
     findings = []
-    if not args.jaxpr_only:
+    if not skip_static:
         findings.extend(run_paths(args.paths, rules=static_rules))
     if args.jaxpr or args.jaxpr_only:
         from kube_batch_tpu.analysis.jaxpr_audit import run_audit
 
         findings.extend(run_audit(select=audit_select))
+    if args.hbm or args.hbm_only:
+        from kube_batch_tpu.analysis.hbm_audit import run_hbm_audit
+
+        findings.extend(run_hbm_audit(select=hbm_select))
 
     for f in findings:
         if args.jsonl:

@@ -61,16 +61,23 @@ class EntryPoint:
 
     ``build`` returns ``(jitted_fn, args)`` with abstract (ShapeDtypeStruct)
     array arguments — static arguments go in baked into ``args`` as real
-    values.  ``donate`` maps backend name → expected donate_argnums, with
-    ``"*"`` as the fallback (the resident scatter donates everywhere except
-    CPU).  ``allow`` suppresses one audit rule for this entry, reason
-    mandatory."""
+    values.  ``build`` accepts an optional ShapePoint: ``build()`` traces at
+    the tier-B audit extents, ``build(sp)`` at a tier-C shape-ladder point.
+    ``donate`` maps backend name → expected donate_argnums, with ``"*"`` as
+    the fallback (the resident scatter donates everywhere except CPU).
+    ``allow`` suppresses one audit rule for this entry, reason mandatory.
+    ``steady`` declares the program steady-path/sparse: dispatched every
+    cycle at scale, so tier C's KBT202 asserts it materializes no
+    task-axis × node-axis plane (the full-matrix oracle and the pallas tile
+    kernels are NOT steady — the first is the cold reference, the second
+    are fixed-tile building blocks)."""
 
     name: str
-    build: Callable[[], Tuple[Callable, Tuple]]
+    build: Callable[..., Tuple[Callable, Tuple]]
     donate: Dict[str, Tuple[int, ...]] = dataclasses.field(
         default_factory=lambda: {"*": ()})
     allow: Dict[str, str] = dataclasses.field(default_factory=dict)
+    steady: bool = False
 
 
 # --------------------------------------------------------------------------
@@ -81,6 +88,80 @@ class EntryPoint:
 # does not depend on extents, and small shapes keep tracing fast.  W/Wt=1
 # matches a fresh ColumnStore; K/Kp=1 is the padded sparse-row floor.
 _T, _N, _J, _Q, _R, _W, _K = 16, 8, 4, 2, 3, 1, 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapePoint:
+    """One rung of the tier-C shape ladder: the abstract axis extents every
+    entry point is traced at when the HBM audit asks "does this program fit
+    at THIS scale".  Tier B traces at `_AUDIT_POINT` (the tiny historical
+    extents — primitive coverage only); tier C re-traces the same builders
+    at the bench shapes, the 50k×5k headline, and the 1M×100k north star,
+    where peak live bytes are the production numbers.
+
+    ``T``/``N``/``J`` are the padded capacity buckets (api.snapshot.bucket)
+    for ``tasks``/``nodes`` pods/nodes; ``P``/``topk`` are the compacted
+    [P, K] dispatch extents the production sizing would pick at this scale,
+    and ``warm_*`` mirror api.resident's warm-carry plan for the same."""
+
+    name: str
+    tasks: int           # nominal pod count (pre-bucketing)
+    nodes: int           # nominal node count (pre-bucketing)
+    T: int               # task capacity bucket
+    N: int               # node capacity bucket
+    J: int               # job capacity bucket
+    Q: int               # queue count
+    R: int               # resource kinds
+    W: int               # label/selector bitset words
+    K_aff: int           # padded affinity rows
+    P: int               # compacted pending bucket
+    topk: int            # candidate width K of the [P, K] table
+    warm_w: int          # warm carried-table stored width
+    warm_c: int          # warm changed-node slots
+    warm_pi: int         # warm rerank rung (re-ranked rows per refresh)
+    probe_b: int = 2     # what-if probe batch
+    probe_g: int = 4     # what-if gang width
+    scatter_rows: int = 64  # resident scatter's device-ledger rows
+
+
+#: tier B's extents as a ShapePoint — `build()` with no argument traces here
+_AUDIT_POINT = ShapePoint(
+    name="audit", tasks=_T, nodes=_N, T=_T, N=_N, J=_J, Q=_Q, R=_R, W=_W,
+    K_aff=_K, P=8, topk=2, warm_w=4, warm_c=4, warm_pi=4,
+    probe_b=2, probe_g=4, scatter_rows=64,
+)
+
+
+def shape_point(name: str, tasks: int, nodes: int, R: int = 8,
+                W: int = 4) -> ShapePoint:
+    """Derive a ladder point from nominal pod/node counts using the SAME
+    sizing the production path uses: capacity buckets from
+    api.snapshot.bucket, the pending bucket from actions.allocate's
+    ``fit ≤ T // 4`` rule (largest fitting bucket = the worst case the
+    audit must cover), and the warm plan's width/changed/rung arithmetic
+    from api.resident.  Keeping these derivations shared — not copied —
+    is the point: if the sizing rules move, the audit moves with them."""
+    from kube_batch_tpu.actions.allocate import TOPK_DEFAULT, TOPK_PEND_BUCKETS
+    from kube_batch_tpu.api.resident import (
+        WARM_CHANGED_BUCKETS,
+        WARM_WIDTH_MARGIN,
+        warm_rerank_rungs,
+    )
+    from kube_batch_tpu.api.snapshot import bucket
+
+    T, N = bucket(tasks), bucket(nodes)
+    J = bucket(max(8, tasks // 4))
+    fit = [b for b in TOPK_PEND_BUCKETS if b <= T // 4]
+    P = fit[-1] if fit else TOPK_PEND_BUCKETS[0]
+    k = TOPK_DEFAULT
+    changed = [c for c in WARM_CHANGED_BUCKETS if c < N]
+    warm_c = changed[-1] if changed else WARM_CHANGED_BUCKETS[0]
+    return ShapePoint(
+        name=name, tasks=tasks, nodes=nodes, T=T, N=N, J=J, Q=8, R=R, W=W,
+        K_aff=4, P=P, topk=k, warm_w=k + WARM_WIDTH_MARGIN, warm_c=warm_c,
+        warm_pi=warm_rerank_rungs(P)[-1], probe_b=2, probe_g=4,
+        scatter_rows=N,
+    )
 
 
 def abstract_snapshot(T=_T, N=_N, J=_J, Q=_Q, R=_R, W=_W, K=_K):
@@ -119,16 +200,23 @@ def abstract_snapshot(T=_T, N=_N, J=_J, Q=_Q, R=_R, W=_W, K=_K):
     )
 
 
-def _build_allocate():
+def _snap(ax: ShapePoint):
+    return abstract_snapshot(
+        T=ax.T, N=ax.N, J=ax.J, Q=ax.Q, R=ax.R, W=ax.W, K=ax.K_aff)
+
+
+def _build_allocate(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_solve
 
-    return allocate_solve, (abstract_snapshot(), AllocateConfig())
+    ax = sp or _AUDIT_POINT
+    return allocate_solve, (_snap(ax), AllocateConfig())
 
 
-def _build_failure_histogram():
+def _build_failure_histogram(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import failure_histogram_solve
 
-    return failure_histogram_solve, (abstract_snapshot(),)
+    ax = sp or _AUDIT_POINT
+    return failure_histogram_solve, (_snap(ax),)
 
 
 #: audit-scale pending bucket + candidate width for the compacted solve
@@ -142,12 +230,13 @@ def _abstract_pend_rows(P=_P):
     return S((P,), jnp.int32)
 
 
-def _build_topk_allocate():
+def _build_topk_allocate(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig, allocate_topk_solve
 
+    ax = sp or _AUDIT_POINT
     return allocate_topk_solve, (
-        abstract_snapshot(), _abstract_pend_rows(),
-        AllocateConfig(topk=_TOPK),
+        _snap(ax), _abstract_pend_rows(ax.P),
+        AllocateConfig(topk=ax.topk),
     )
 
 
@@ -181,34 +270,41 @@ def _warm_donation() -> Dict[str, Tuple[int, ...]]:
     return {"cpu": (), "*": (2, 3, 4, 5)}
 
 
-def _build_warm_allocate():
+def _warm_args_at(ax: ShapePoint):
+    return _abstract_warm_args(P=ax.P, W=ax.warm_w, C=ax.warm_c, Pi=ax.warm_pi)
+
+
+def _build_warm_allocate(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig, warm_solve_fn
 
+    ax = sp or _AUDIT_POINT
     return warm_solve_fn(), (
-        abstract_snapshot(), *_abstract_warm_args(),
-        AllocateConfig(topk=_WARM_W), _TOPK,
+        _snap(ax), *_warm_args_at(ax),
+        AllocateConfig(topk=ax.warm_w), ax.topk,
     )
 
 
-def _build_warm_sentinel():
+def _build_warm_sentinel(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.ops.invariants import warm_sentinel_solve_fn
 
+    ax = sp or _AUDIT_POINT
     return warm_sentinel_solve_fn(), (
-        abstract_snapshot(), *_abstract_warm_args(),
-        AllocateConfig(topk=_WARM_W), _TOPK,
+        _snap(ax), *_warm_args_at(ax),
+        AllocateConfig(topk=ax.warm_w), ax.topk,
     )
 
 
-def _build_bucket_histogram():
+def _build_bucket_histogram(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import failure_histogram_bucket_solve
 
+    ax = sp or _AUDIT_POINT
     return failure_histogram_bucket_solve, (
-        abstract_snapshot(), _abstract_pend_rows(),
+        _snap(ax), _abstract_pend_rows(ax.P),
     )
 
 
-def _build_topk_probe():
+def _build_topk_probe(sp: Optional[ShapePoint] = None):
     """The probe traced with a topk>0 config: the query plane reuses the
     session's AllocateConfig, and the probe's [G, N] head ignores the
     compaction knob by design (a gang's task axis is already tiny) — this
@@ -217,65 +313,72 @@ def _build_topk_probe():
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.ops.probe import probe_solve
 
-    batch, rows = _abstract_probe_batch()
+    ax = sp or _AUDIT_POINT
+    batch, rows = _abstract_probe_batch(
+        B=ax.probe_b, G=ax.probe_g, R=ax.R, W=ax.W)
     return probe_solve, (
-        abstract_snapshot(), batch, rows, AllocateConfig(topk=_TOPK),
+        _snap(ax), batch, rows, AllocateConfig(topk=ax.topk),
         EvictConfig(mode="preempt"), True,
     )
 
 
-def _build_evict_reclaim():
+def _build_evict_reclaim(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
 
-    return evict_solve, (abstract_snapshot(), EvictConfig(mode="reclaim"))
+    ax = sp or _AUDIT_POINT
+    return evict_solve, (_snap(ax), EvictConfig(mode="reclaim"))
 
 
-def _build_evict_preempt():
+def _build_evict_preempt(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.eviction import EvictConfig, evict_solve
 
-    return evict_solve, (abstract_snapshot(), EvictConfig(mode="preempt"))
+    ax = sp or _AUDIT_POINT
+    return evict_solve, (_snap(ax), EvictConfig(mode="preempt"))
 
 
-def _build_resident_scatter():
+def _build_resident_scatter(sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.api.resident import SCATTER_SLOTS, _scatter_fn
 
+    ax = sp or _AUDIT_POINT
     return _scatter_fn(), (
-        S((64, _R), jnp.float32),
+        S((ax.scatter_rows, ax.R), jnp.float32),
         S((SCATTER_SLOTS,), jnp.int32),
-        S((SCATTER_SLOTS, _R), jnp.float32),
+        S((SCATTER_SLOTS, ax.R), jnp.float32),
     )
 
 
-def _build_enqueue_gate():
+def _build_enqueue_gate(sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.ops.admission import enqueue_gate_fn
 
+    ax = sp or _AUDIT_POINT
     return enqueue_gate_fn(), (
-        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
-        S((_R,), jnp.float32), S((_R,), jnp.float32),
+        S((ax.J, ax.R), jnp.float32), S((ax.J,), jnp.bool_),
+        S((ax.R,), jnp.float32), S((ax.R,), jnp.float32),
     )
 
 
-def _build_pallas_round_head():
+def _build_pallas_round_head(sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.ops.pallas_kernels import NODE_TILE, TASK_TILE, masked_best_node
 
+    ax = sp or _AUDIT_POINT
     T, N = TASK_TILE, NODE_TILE  # one tile — grid multiples are guaranteed
     return masked_best_node, (
-        S((T, N), jnp.float32), S((T, N), jnp.bool_), S((T, _R), jnp.float32),
-        S((N, _R), jnp.float32), S((N, _R), jnp.float32), S((T,), jnp.bool_),
-        S((_R,), jnp.float32), True,  # interpret=True: auditable off-TPU
+        S((T, N), jnp.float32), S((T, N), jnp.bool_), S((T, ax.R), jnp.float32),
+        S((N, ax.R), jnp.float32), S((N, ax.R), jnp.float32), S((T,), jnp.bool_),
+        S((ax.R,), jnp.float32), True,  # interpret=True: auditable off-TPU
     )
 
 
-def _build_pallas_topk_blocks():
+def _build_pallas_topk_blocks(sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
@@ -285,16 +388,17 @@ def _build_pallas_topk_blocks():
         masked_topk_blocks,
     )
 
+    ax = sp or _AUDIT_POINT
     P, N = TASK_TILE, NODE_TILE
     return masked_topk_blocks, (
-        S((P, N), jnp.float32), S((P, _R), jnp.float32),
-        S((N, _R), jnp.float32), S((N, _R), jnp.float32),
-        S((P,), jnp.int32), S((_R,), jnp.float32),
+        S((P, N), jnp.float32), S((P, ax.R), jnp.float32),
+        S((N, ax.R), jnp.float32), S((N, ax.R), jnp.float32),
+        S((P,), jnp.int32), S((ax.R,), jnp.float32),
         0, True,  # n0=0, interpret=True: auditable off-TPU
     )
 
 
-def _abstract_probe_batch(B=2, G=4):
+def _abstract_probe_batch(B=2, G=4, R=_R, W=_W):
     """A ProbeBatch of ShapeDtypeStructs + the [G] row oracle — the query
     plane's serving shapes at audit scale."""
     import jax.numpy as jnp
@@ -304,25 +408,27 @@ def _abstract_probe_batch(B=2, G=4):
 
     f32, i32, b, u32 = jnp.float32, jnp.int32, jnp.bool_, jnp.uint32
     batch = ProbeBatch(
-        req=S((B, G, _R), f32), valid=S((B, G), b),
+        req=S((B, G, R), f32), valid=S((B, G), b),
         min_avail=S((B,), i32), queue=S((B,), i32), prio=S((B,), i32),
-        sel_bits=S((B, _W), u32), sel_impossible=S((B,), b),
-        tol_bits=S((B, _W), u32), min_res=S((B, _R), f32),
+        sel_bits=S((B, W), u32), sel_impossible=S((B,), b),
+        tol_bits=S((B, W), u32), min_res=S((B, R), f32),
         has_min_res=S((B,), b),
     )
     return batch, S((G,), i32)
 
 
-def _build_probe():
+def _build_probe(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.ops.probe import probe_solve
 
-    batch, rows = _abstract_probe_batch()
+    ax = sp or _AUDIT_POINT
+    batch, rows = _abstract_probe_batch(
+        B=ax.probe_b, G=ax.probe_g, R=ax.R, W=ax.W)
     # with_evictions=True traces the superset program (head + admission +
     # histogram + the eviction probe's while_loop)
     return probe_solve, (
-        abstract_snapshot(), batch, rows, AllocateConfig(),
+        _snap(ax), batch, rows, AllocateConfig(),
         EvictConfig(mode="preempt"), True,
     )
 
@@ -340,74 +446,90 @@ def _scatter_donation() -> Dict[str, Tuple[int, ...]]:
 # exactly the path it guards)
 
 
-def _build_sentinel_allocate():
+def _build_sentinel_allocate(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.ops.invariants import allocate_sentinel_solve
 
-    return allocate_sentinel_solve, (abstract_snapshot(), AllocateConfig())
+    ax = sp or _AUDIT_POINT
+    return allocate_sentinel_solve, (_snap(ax), AllocateConfig())
 
 
-def _build_sentinel_topk():
+def _build_sentinel_topk(sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.ops.invariants import allocate_topk_sentinel_solve
 
+    ax = sp or _AUDIT_POINT
     return allocate_topk_sentinel_solve, (
-        abstract_snapshot(), _abstract_pend_rows(),
-        AllocateConfig(topk=_TOPK),
+        _snap(ax), _abstract_pend_rows(ax.P),
+        AllocateConfig(topk=ax.topk),
     )
 
 
-def _build_sentinel_evict(mode):
+def _build_sentinel_evict(mode, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.ops.invariants import evict_sentinel_solve
 
+    ax = sp or _AUDIT_POINT
     return evict_sentinel_solve, (
-        abstract_snapshot(), EvictConfig(mode=mode))
+        _snap(ax), EvictConfig(mode=mode))
 
 
-def _build_sentinel_gate():
+def _build_sentinel_gate(sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.ops.invariants import enqueue_gate_sentinel_fn
 
+    ax = sp or _AUDIT_POINT
     return enqueue_gate_sentinel_fn(), (
-        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
-        S((_R,), jnp.float32), S((_R,), jnp.float32),
+        S((ax.J, ax.R), jnp.float32), S((ax.J,), jnp.bool_),
+        S((ax.R,), jnp.float32), S((ax.R,), jnp.float32),
     )
 
 
 REGISTRY: Tuple[EntryPoint, ...] = (
+    # the full-matrix allocate is the COLD oracle — steady=False by design;
+    # the compacted topk/warm programs are what dispatches at scale
     EntryPoint("ops.assignment.allocate_solve", _build_allocate),
-    EntryPoint("ops.assignment.allocate_topk_solve", _build_topk_allocate),
+    EntryPoint("ops.assignment.allocate_topk_solve", _build_topk_allocate,
+               steady=True),
     EntryPoint("ops.assignment.warm_allocate_solve", _build_warm_allocate,
-               donate=_warm_donation()),
+               donate=_warm_donation(), steady=True),
     EntryPoint("ops.assignment.failure_histogram_solve",
                _build_failure_histogram),
     EntryPoint("ops.assignment.failure_histogram_bucket_solve",
                _build_bucket_histogram),
-    EntryPoint("ops.eviction.evict_solve[reclaim]", _build_evict_reclaim),
-    EntryPoint("ops.eviction.evict_solve[preempt]", _build_evict_preempt),
+    # eviction runs inside production cycles — steady, so KBT202 pins the
+    # known full-matrix bid planes (ROADMAP 1.(1)) via the allowlist
+    EntryPoint("ops.eviction.evict_solve[reclaim]", _build_evict_reclaim,
+               steady=True),
+    EntryPoint("ops.eviction.evict_solve[preempt]", _build_evict_preempt,
+               steady=True),
     EntryPoint("api.resident.scatter", _build_resident_scatter,
-               donate=_scatter_donation()),
-    EntryPoint("ops.admission.enqueue_gate", _build_enqueue_gate),
+               donate=_scatter_donation(), steady=True),
+    EntryPoint("ops.admission.enqueue_gate", _build_enqueue_gate,
+               steady=True),
     EntryPoint("ops.pallas_kernels.masked_best_node",
                _build_pallas_round_head),
     EntryPoint("ops.pallas_kernels.masked_topk_blocks",
                _build_pallas_topk_blocks),
-    EntryPoint("ops.probe.probe_solve", _build_probe),
-    EntryPoint("ops.probe.probe_solve[topk-inert]", _build_topk_probe),
+    EntryPoint("ops.probe.probe_solve", _build_probe, steady=True),
+    EntryPoint("ops.probe.probe_solve[topk-inert]", _build_topk_probe,
+               steady=True),
     EntryPoint("ops.invariants.allocate_sentinel_solve",
                _build_sentinel_allocate),
     EntryPoint("ops.invariants.allocate_topk_sentinel_solve",
-               _build_sentinel_topk),
+               _build_sentinel_topk, steady=True),
     EntryPoint("ops.invariants.warm_allocate_sentinel_solve",
-               _build_warm_sentinel, donate=_warm_donation()),
+               _build_warm_sentinel, donate=_warm_donation(), steady=True),
     EntryPoint("ops.invariants.evict_sentinel_solve[reclaim]",
-               lambda: _build_sentinel_evict("reclaim")),
+               lambda sp=None: _build_sentinel_evict("reclaim", sp),
+               steady=True),
     EntryPoint("ops.invariants.evict_sentinel_solve[preempt]",
-               lambda: _build_sentinel_evict("preempt")),
-    EntryPoint("ops.invariants.enqueue_gate_sentinel", _build_sentinel_gate),
+               lambda sp=None: _build_sentinel_evict("preempt", sp),
+               steady=True),
+    EntryPoint("ops.invariants.enqueue_gate_sentinel", _build_sentinel_gate,
+               steady=True),
 )
 
 
@@ -422,113 +544,130 @@ REGISTRY: Tuple[EntryPoint, ...] = (
 # --------------------------------------------------------------------------
 
 
-def _build_sharded_allocate(mesh, impl):
+def _build_sharded_allocate(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import allocate_solve_fn
 
+    ax = sp or _AUDIT_POINT
     return allocate_solve_fn(mesh, AllocateConfig(), impl=impl), (
-        abstract_snapshot(),)
+        _snap(ax),)
 
 
-def _build_sharded_topk(mesh, impl):
+def _build_sharded_topk(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import allocate_topk_solve_fn
 
-    fn = allocate_topk_solve_fn(mesh, AllocateConfig(topk=_TOPK), impl=impl)
-    return fn, (abstract_snapshot(), _abstract_pend_rows())
+    ax = sp or _AUDIT_POINT
+    fn = allocate_topk_solve_fn(mesh, AllocateConfig(topk=ax.topk), impl=impl)
+    return fn, (_snap(ax), _abstract_pend_rows(ax.P))
 
 
-def _build_sharded_warm(mesh, impl):
+def _build_sharded_warm(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import warm_allocate_solve_fn
 
+    ax = sp or _AUDIT_POINT
     fn = warm_allocate_solve_fn(
-        mesh, AllocateConfig(topk=_WARM_W), _TOPK, impl=impl)
-    return fn, (abstract_snapshot(), *_abstract_warm_args())
+        mesh, AllocateConfig(topk=ax.warm_w), ax.topk, impl=impl)
+    return fn, (_snap(ax), *_warm_args_at(ax))
 
 
-def _build_sharded_sentinel_warm(mesh, impl):
+def _build_sharded_sentinel_warm(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import (
         sentinel_warm_allocate_solve_fn,
     )
 
+    ax = sp or _AUDIT_POINT
     fn = sentinel_warm_allocate_solve_fn(
-        mesh, AllocateConfig(topk=_WARM_W), _TOPK, impl=impl)
-    return fn, (abstract_snapshot(), *_abstract_warm_args())
+        mesh, AllocateConfig(topk=ax.warm_w), ax.topk, impl=impl)
+    return fn, (_snap(ax), *_warm_args_at(ax))
 
 
-def _build_sharded_bucket_histogram(mesh, impl):
+def _build_sharded_bucket_histogram(mesh, impl,
+                                    sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.parallel.mesh import failure_histogram_bucket_fn
 
+    ax = sp or _AUDIT_POINT
     fn = failure_histogram_bucket_fn(mesh, impl=impl)
-    return fn, (abstract_snapshot(), _abstract_pend_rows())
+    return fn, (_snap(ax), _abstract_pend_rows(ax.P))
 
 
-def _build_sharded_histogram(mesh, impl):
+def _build_sharded_histogram(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.parallel.mesh import failure_histogram_fn
 
-    return failure_histogram_fn(mesh, impl=impl), (abstract_snapshot(),)
+    ax = sp or _AUDIT_POINT
+    return failure_histogram_fn(mesh, impl=impl), (_snap(ax),)
 
 
-def _build_sharded_evict(mesh, mode, impl):
+def _build_sharded_evict(mesh, mode, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.parallel.mesh import evict_solve_fn
 
+    ax = sp or _AUDIT_POINT
     return evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl), (
-        abstract_snapshot(),)
+        _snap(ax),)
 
 
-def _build_sharded_sentinel_allocate(mesh, impl):
+def _build_sharded_sentinel_allocate(mesh, impl,
+                                     sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import sentinel_allocate_solve_fn
 
+    ax = sp or _AUDIT_POINT
     fn = sentinel_allocate_solve_fn(mesh, AllocateConfig(), impl=impl)
-    return fn, (abstract_snapshot(),)
+    return fn, (_snap(ax),)
 
 
-def _build_sharded_sentinel_topk(mesh, impl):
+def _build_sharded_sentinel_topk(mesh, impl,
+                                 sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.parallel.mesh import sentinel_allocate_topk_solve_fn
 
+    ax = sp or _AUDIT_POINT
     fn = sentinel_allocate_topk_solve_fn(
-        mesh, AllocateConfig(topk=_TOPK), impl=impl)
-    return fn, (abstract_snapshot(), _abstract_pend_rows())
+        mesh, AllocateConfig(topk=ax.topk), impl=impl)
+    return fn, (_snap(ax), _abstract_pend_rows(ax.P))
 
 
-def _build_sharded_sentinel_evict(mesh, mode, impl):
+def _build_sharded_sentinel_evict(mesh, mode, impl,
+                                  sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.parallel.mesh import sentinel_evict_solve_fn
 
+    ax = sp or _AUDIT_POINT
     fn = sentinel_evict_solve_fn(mesh, EvictConfig(mode=mode), impl=impl)
-    return fn, (abstract_snapshot(),)
+    return fn, (_snap(ax),)
 
 
-def _build_sharded_probe(mesh, impl):
+def _build_sharded_probe(mesh, impl, sp: Optional[ShapePoint] = None):
     from kube_batch_tpu.ops.assignment import AllocateConfig
     from kube_batch_tpu.ops.eviction import EvictConfig
     from kube_batch_tpu.parallel.mesh import probe_solve_fn
 
-    batch, rows = _abstract_probe_batch()
+    ax = sp or _AUDIT_POINT
+    batch, rows = _abstract_probe_batch(
+        B=ax.probe_b, G=ax.probe_g, R=ax.R, W=ax.W)
     fn = probe_solve_fn(
         mesh, AllocateConfig(), EvictConfig(mode="preempt"), True, impl=impl
     )
-    return fn, (abstract_snapshot(), batch, rows)
+    return fn, (_snap(ax), batch, rows)
 
 
-def _build_sharded_gate(mesh):
+def _build_sharded_gate(mesh, sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.parallel.mesh import enqueue_gate_solve_fn
 
+    ax = sp or _AUDIT_POINT
     return enqueue_gate_solve_fn(mesh), (
-        S((_J, _R), jnp.float32), S((_J,), jnp.bool_),
-        S((_R,), jnp.float32), S((_R,), jnp.float32),
+        S((ax.J, ax.R), jnp.float32), S((ax.J,), jnp.bool_),
+        S((ax.R,), jnp.float32), S((ax.R,), jnp.float32),
     )
 
 
-def _build_shard_scatter(mesh):
+def _build_shard_scatter(mesh, sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
@@ -538,22 +677,24 @@ def _build_shard_scatter(mesh):
     )
     from kube_batch_tpu.parallel.mesh import NODE_AXIS
 
+    ax = sp or _AUDIT_POINT
     d = int(dict(mesh.shape)[NODE_AXIS])  # node-axis extent, not device count
     return _mesh_shard_scatter_fn(mesh), (
-        S((_N, _R), jnp.float32),
+        S((ax.N, ax.R), jnp.float32),
         S((d, SHARD_SCATTER_SLOTS), jnp.int32),
-        S((d, SHARD_SCATTER_SLOTS, _R), jnp.float32),
+        S((d, SHARD_SCATTER_SLOTS, ax.R), jnp.float32),
     )
 
 
-def _build_repl_scatter(mesh):
+def _build_repl_scatter(mesh, sp: Optional[ShapePoint] = None):
     import jax.numpy as jnp
     from jax import ShapeDtypeStruct as S
 
     from kube_batch_tpu.api.resident import SCATTER_SLOTS, _mesh_repl_scatter_fn
 
+    ax = sp or _AUDIT_POINT
     return _mesh_repl_scatter_fn(mesh), (
-        S((_T,), jnp.int32),
+        S((ax.T,), jnp.int32),
         S((SCATTER_SLOTS,), jnp.int32),
         S((SCATTER_SLOTS,), jnp.int32),
     )
@@ -589,44 +730,48 @@ def sharded_registry() -> Tuple[EntryPoint, ...]:
             EntryPoint(f"parallel.mesh.sharded_allocate_solve{tag}",
                        p(_build_sharded_allocate, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_allocate_topk_solve{tag}",
-                       p(_build_sharded_topk, mesh, impl)),
+                       p(_build_sharded_topk, mesh, impl), steady=True),
             EntryPoint(f"parallel.mesh.sharded_warm_allocate_solve{tag}",
-                       p(_build_sharded_warm, mesh, impl)),
+                       p(_build_sharded_warm, mesh, impl), steady=True),
             EntryPoint(
                 f"parallel.mesh.sentinel_sharded_warm_allocate_solve{tag}",
-                p(_build_sharded_sentinel_warm, mesh, impl)),
+                p(_build_sharded_sentinel_warm, mesh, impl), steady=True),
             EntryPoint(f"parallel.mesh.sharded_failure_histogram{tag}",
                        p(_build_sharded_histogram, mesh, impl)),
             EntryPoint(
                 f"parallel.mesh.sharded_failure_histogram_bucket{tag}",
                 p(_build_sharded_bucket_histogram, mesh, impl)),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[reclaim]{tag}",
-                       p(_build_sharded_evict, mesh, "reclaim", impl)),
+                       p(_build_sharded_evict, mesh, "reclaim", impl),
+                       steady=True),
             EntryPoint(f"parallel.mesh.sharded_evict_solve[preempt]{tag}",
-                       p(_build_sharded_evict, mesh, "preempt", impl)),
+                       p(_build_sharded_evict, mesh, "preempt", impl),
+                       steady=True),
             EntryPoint(f"parallel.mesh.sharded_probe_solve{tag}",
-                       p(_build_sharded_probe, mesh, impl)),
+                       p(_build_sharded_probe, mesh, impl), steady=True),
             EntryPoint(f"parallel.mesh.sentinel_sharded_allocate_solve{tag}",
                        p(_build_sharded_sentinel_allocate, mesh, impl)),
             EntryPoint(
                 f"parallel.mesh.sentinel_sharded_allocate_topk_solve{tag}",
-                p(_build_sharded_sentinel_topk, mesh, impl)),
+                p(_build_sharded_sentinel_topk, mesh, impl), steady=True),
             EntryPoint(
                 f"parallel.mesh.sentinel_sharded_evict_solve[reclaim]{tag}",
-                p(_build_sharded_sentinel_evict, mesh, "reclaim", impl)),
+                p(_build_sharded_sentinel_evict, mesh, "reclaim", impl),
+                steady=True),
             EntryPoint(
                 f"parallel.mesh.sentinel_sharded_evict_solve[preempt]{tag}",
-                p(_build_sharded_sentinel_evict, mesh, "preempt", impl)),
+                p(_build_sharded_sentinel_evict, mesh, "preempt", impl),
+                steady=True),
         ]
     entries += [
         EntryPoint("parallel.mesh.sharded_enqueue_gate",
-                   p(_build_sharded_gate, mesh)),
+                   p(_build_sharded_gate, mesh), steady=True),
         EntryPoint("api.resident.scatter_sharded",
                    p(_build_shard_scatter, mesh),
-                   donate=_scatter_donation()),
+                   donate=_scatter_donation(), steady=True),
         EntryPoint("api.resident.scatter_repl",
                    p(_build_repl_scatter, mesh),
-                   donate=_scatter_donation()),
+                   donate=_scatter_donation(), steady=True),
     ]
     if n_dev >= 4 and n_dev % 2 == 0 and _T % 2 == 0:
         mesh2 = make_mesh(n_dev, task_shards=2)

@@ -32,6 +32,15 @@ The host action replays the result through session verbs, re-validating each
 claim with the real plugin callbacks on the (small) selected sets — the
 device narrows O(tasks × nodes × victims) to O(claims), the host stays
 authoritative for semantics.
+
+Memory footprint: the bidding rounds still score FULL [tasks, nodes] bid
+planes, which blows the v5e HBM budget at the 1M×100k north star — the
+tier-C HBM audit (analysis/hbm_audit.py) flags every evict variant under
+KBT201/KBT202 and waives it in ``HBM_ALLOWLIST`` under ROADMAP 1.(1);
+the sparse rebuild (candidate table over per-(queue, node) capacity keys,
+with re-rank-on-growth since evictions grow capacity within a pass)
+deletes those waivers, and the audit fails on the stale entries if this
+file gets fixed without removing them.
 """
 
 from __future__ import annotations

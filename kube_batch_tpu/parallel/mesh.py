@@ -708,7 +708,14 @@ def collective_stats(mesh: Mesh, config: Optional[AllocateConfig] = None,
     With ``config.topk > 0`` and a ``pend_bucket`` size, the COMPACTED
     program is traced instead — its contract is per_round_bytes == 0
     (the candidate merge and the fallback's node-column gathers are all
-    per-solve), which the bench and tests assert from these numbers."""
+    per-solve), which the bench and tests assert from these numbers.
+
+    The inventory's nested-loop fields pass through:
+    ``per_round_bytes_expanded`` multiplies each per-round site by the
+    trip count of any scan nested inside the round loop, and
+    ``per_round_has_unbounded_inner_loop`` marks an inner ``while``
+    (no static trip count — the expanded total is then a floor).  The
+    HBM audit's KBT204 reads the same fields for its byte formulas."""
     import jax.numpy as jnp
 
     if snap is None:

@@ -58,6 +58,9 @@ COLLECTIVE_PRIMS = (
     "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
     "reduce_scatter", "psum_scatter",
 )
+#: jaxpr spellings that alias a canonical collective (jax renamed psum's
+#: primitive to ``psum2`` in 0.4.x; report it under the stable name)
+_PRIM_ALIASES = {"psum2": "psum"}
 _LOOP_PRIMS = ("while", "scan")
 
 
@@ -73,7 +76,7 @@ def _aval_bytes(var) -> int:
     return n * dtype.itemsize
 
 
-def collective_inventory(closed_jaxpr) -> Dict:
+def collective_inventory(closed_jaxpr, *, detail: bool = False) -> Dict:
     """Walk a traced program (a ClosedJaxpr, e.g. ``fn.trace(...).jaxpr``)
     and account every collective primitive's result bytes, split into
     per-ROUND (inside a while/scan body — paid every bidding round) and
@@ -85,37 +88,89 @@ def collective_inventory(closed_jaxpr) -> Dict:
     round loop shows up as a bytes jump, not a silent slowdown.  Bytes are
     the collective RESULT sizes — a uniform proxy for payload (an
     all-reduce moves ~result-size per hop; an all_gather's result already
-    includes the axis-size factor)."""
+    includes the axis-size factor).
+
+    Nested loops: a collective inside a scan/fori nested WITHIN the round
+    loop (the warm refresh's inner merge loops) runs inner-trip-count times
+    per round.  ``per_round_bytes`` keeps the historical once-per-site
+    count; ``per_round_bytes_expanded`` multiplies each per-round site by
+    the product of the scan lengths of the loops strictly inside the
+    outermost one.  An inner ``while`` has no static trip count — its sites
+    count ×1 in the expanded total and set
+    ``per_round_has_unbounded_inner_loop`` so the consumer (KBT204) knows
+    the formula is a floor, not a bound.
+
+    With ``detail=True``, each result also carries ``sites``: one record
+    per collective equation with its result shape/dtype/bytes, loop depth,
+    and inner trip multiplier — the raw material for byte-formula
+    extraction."""
     per: Dict[str, Dict[str, Dict[str, int]]] = {
         "per_round": {}, "per_solve": {},
     }
+    sites: List[Dict] = []
+    expanded = {"per_round": 0}
+    unbounded_seen = [False]
 
-    def walk(jaxpr, in_loop: bool) -> None:
+    def walk(jaxpr, depth: int, inner_trips: int, unbounded: bool) -> None:
+        # depth = enclosing while/scan count; inner_trips = product of the
+        # known scan lengths of the enclosing loops EXCLUDING the outermost
+        # (per-round means "per iteration of the outermost loop").
         for eqn in jaxpr.eqns:
-            prim = str(eqn.primitive)
+            prim = _PRIM_ALIASES.get(str(eqn.primitive), str(eqn.primitive))
             if prim in COLLECTIVE_PRIMS:
+                in_loop = depth > 0
                 bucket = per["per_round" if in_loop else "per_solve"]
                 rec = bucket.setdefault(prim, {"count": 0, "bytes": 0})
                 rec["count"] += 1
-                rec["bytes"] += sum(_aval_bytes(v) for v in eqn.outvars)
-            inner_loop = in_loop or prim in _LOOP_PRIMS
+                b = sum(_aval_bytes(v) for v in eqn.outvars)
+                rec["bytes"] += b
+                if in_loop:
+                    expanded["per_round"] += b * inner_trips
+                    if unbounded:
+                        unbounded_seen[0] = True
+                if detail:
+                    aval = getattr(eqn.outvars[0], "aval", None)
+                    sites.append({
+                        "prim": prim,
+                        "bytes": b,
+                        "shape": tuple(getattr(aval, "shape", ()) or ()),
+                        "dtype": str(getattr(aval, "dtype", "?")),
+                        "depth": depth,
+                        "inner_trips": inner_trips,
+                        "unbounded_trips": unbounded,
+                    })
+            is_loop = prim in _LOOP_PRIMS
+            if is_loop and depth >= 1:
+                # entering a loop nested inside the round loop: fold its
+                # trip count into the per-round multiplier
+                length = eqn.params.get("length")
+                sub_trips = inner_trips * int(length) if length else inner_trips
+                sub_unbounded = unbounded or length is None
+            else:
+                sub_trips, sub_unbounded = inner_trips, unbounded
+            inner_depth = depth + 1 if is_loop else depth
             for param in eqn.params.values():
                 vals = param if isinstance(param, (list, tuple)) else [param]
                 for sub in vals:
                     inner = getattr(sub, "jaxpr", None)
                     if inner is not None and hasattr(inner, "eqns"):
-                        walk(inner, inner_loop)
+                        walk(inner, inner_depth, sub_trips, sub_unbounded)
                     elif hasattr(sub, "eqns"):
-                        walk(sub, inner_loop)
+                        walk(sub, inner_depth, sub_trips, sub_unbounded)
 
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
-    walk(jaxpr, False)
-    return {
+    walk(jaxpr, 0, 1, False)
+    out = {
         "per_round_bytes": sum(
             r["bytes"] for r in per["per_round"].values()
         ),
         "per_solve_bytes": sum(
             r["bytes"] for r in per["per_solve"].values()
         ),
+        "per_round_bytes_expanded": expanded["per_round"],
+        "per_round_has_unbounded_inner_loop": unbounded_seen[0],
         "ops": per,
     }
+    if detail:
+        out["sites"] = sites
+    return out

@@ -1352,10 +1352,48 @@ class TestCli:
                        "kube_batch_tpu/analysis"])
         assert rc == 0
 
-    def test_list_rules_includes_both_tiers(self):
+    def test_static_only_select_skips_the_hbm_tier_too(self, monkeypatch):
+        # same contract for tier C: `--hbm --select KBT001` must not trace
+        # the shape ladder only to discard every KBT20x finding
+        from kube_batch_tpu.analysis import __main__ as cli
+        from kube_batch_tpu.analysis import hbm_audit, jaxpr_audit
+
+        def boom(*a, **k):
+            raise AssertionError("a traced tier must not run for a "
+                                 "static-only select")
+
+        monkeypatch.setattr(jaxpr_audit, "run_audit", boom)
+        monkeypatch.setattr(hbm_audit, "run_hbm_audit", boom)
+        rc = cli.main(["--jaxpr", "--hbm", "--select", "KBT001",
+                       "kube_batch_tpu/analysis"])
+        assert rc == 0
+
+    def test_hbm_select_implies_the_hbm_tier(self, monkeypatch):
+        # a KBT20x selection routes to tier C without an explicit --hbm,
+        # and skips tiers A and B outright
+        from kube_batch_tpu.analysis import __main__ as cli
+        from kube_batch_tpu.analysis import hbm_audit, jaxpr_audit
+
+        calls = {}
+
+        def fake_hbm(select=None):
+            calls["select"] = select
+            return []
+
+        def boom(*a, **k):
+            raise AssertionError("tier B must not run for a KBT20x select")
+
+        monkeypatch.setattr(hbm_audit, "run_hbm_audit", fake_hbm)
+        monkeypatch.setattr(jaxpr_audit, "run_audit", boom)
+        rc = cli.main(["--select", "KBT203"])
+        assert rc == 0
+        assert calls["select"] == ["KBT203"]
+
+    def test_list_rules_includes_all_tiers(self):
         proc = self._run("--list-rules")
         assert proc.returncode == 0
         assert "KBT010" in proc.stdout and "KBT101" in proc.stdout
+        assert "KBT201" in proc.stdout and "KBT204" in proc.stdout
 
 
 # ---------------------------------------------------------------------------

@@ -298,6 +298,12 @@ def test_collective_bytes_scale_with_tasks_not_nodes():
     assert round_ops["all_gather"]["count"] == 1, round_ops
     # the one-per-solve node-ledger gather grows with N, and only it
     assert nodes2["per_solve_bytes"] > base["per_solve_bytes"]
+    # nested-loop accounting (KBT204's byte-formula inputs): the bidding
+    # rounds are a dynamically-capped while INSIDE the outer gang-pass
+    # while, so no static inner trip count exists — the expanded total
+    # counts the site ×1 and the unbounded flag marks it as a floor
+    assert base["per_round_bytes_expanded"] == base["per_round_bytes"]
+    assert base["per_round_has_unbounded_inner_loop"] is True
 
 
 def test_collective_bytes_task_axis_gathers():
