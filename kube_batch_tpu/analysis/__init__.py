@@ -18,14 +18,24 @@ pattern. This package builds the checks once so the class stops recurring:
   abstract shapes and their closed jaxprs linted for f64 upcasts, in-graph
   transfers, host callbacks, and donation drift (KBT101–104). Run with
   `--jaxpr` / `--jaxpr-only`, or both tiers via `scripts/check.sh`.
+- `races`: tier D — the static thread/lock-domain race analyzer
+  (KBT301–304): a thread-root graph (spawn sites, worker bodies, HTTP
+  handlers), per-class lock-domain inference over the def-use walker's
+  with-block regions, and rules for off-domain access, publish-then-
+  mutate handoffs (the generalized KBT012, whose id survives as a
+  `--select` alias), lock-free check-then-act, and racy lazy init.  Run
+  with `--races` / `--races-only`; `--domains` prints the inferred map.
 - `lockdep`: a runtime lock-order validator in the spirit of the Linux
   kernel's lockdep — instrumented Lock/RLock factories record per-thread
   held-lock sets, build the acquisition-order graph, and flag A→B/B→A
   inversions (transitive cycles included), blocking calls made while a
   lock is held, and same-site nesting not declared via
-  utils.blocking.allow_nesting.
-- `pytest_plugin`: enables lockdep for the whole test suite and fails the
-  run on violations (wired into tests/conftest.py, so tier-1 enforces it).
+  utils.blocking.allow_nesting.  Also hosts the tier-D guarded-access
+  corroborator: hot shared structures are instrumented so every access
+  the suite executes asserts the statically inferred domain lock is held.
+- `pytest_plugin`: enables lockdep + the guarded-access corroborator for
+  the whole test suite and fails the run on violations (wired into
+  tests/conftest.py, so tier-1 enforces it).
 
 Suppressions: `# kbt: allow[KBT00X] reason` on the flagged line (or the
 line directly above). The reason is mandatory — an allow without one does

@@ -7,8 +7,12 @@ skips tier A.
 Tier C (``--hbm``): the liveness/HBM-budget audit (analysis/hbm_audit.py) —
 traces every registered entry point at the abstract shape ladder up to the
 1M×100k north star and checks peak live bytes against the backend budget;
-``--hbm-only`` runs just that tier.  ``--select``/``--jsonl`` apply to all
-tiers uniformly.
+``--hbm-only`` runs just that tier.
+Tier D (``--races``): the thread/lock-domain race rules (analysis/races.py,
+KBT301–304) — added to the static run; ``--races-only`` runs just that
+tier, and ``--domains`` prints the inferred per-class lock-domain map
+instead of findings.  ``--select``/``--jsonl`` apply to all tiers
+uniformly (``KBT012`` is accepted as an alias for ``KBT302``).
 
 Exit status: 0 clean, 1 findings, 2 usage error.  `--jsonl` emits one JSON
 object per finding on stdout for CI consumption; the human format is
@@ -22,6 +26,9 @@ import json
 import sys
 
 from kube_batch_tpu.analysis.engine import run_paths
+from kube_batch_tpu.analysis.races import (
+    RACE_RULES, RACE_RULES_BY_ID, RULE_ALIASES, domains_report,
+)
 from kube_batch_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
 
 
@@ -43,7 +50,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--select", metavar="RULES",
         help="comma-separated rule ids to run (default: all); KBT10x ids "
-             "select jaxpr-audit checks, KBT20x ids select HBM-audit checks",
+             "select jaxpr-audit checks, KBT20x ids select HBM-audit "
+             "checks, KBT30x ids select the race tier",
     )
     parser.add_argument(
         "--jaxpr", action="store_true",
@@ -65,6 +73,21 @@ def main(argv=None) -> int:
         help="run only the HBM audit tier",
     )
     parser.add_argument(
+        "--races", action="store_true",
+        help="additionally run the tier-D thread/lock-domain race rules "
+             "(KBT301-304; pure AST, no jax import)",
+    )
+    parser.add_argument(
+        "--races-only", action="store_true",
+        help="run only the race tier",
+    )
+    parser.add_argument(
+        "--domains", action="store_true",
+        help="print the tier-D inferred per-class lock-domain map "
+             "(reviewable form of the model the race rules check against) "
+             "and exit 0",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog",
     )
     args = parser.parse_args(argv)
@@ -82,22 +105,33 @@ def main(argv=None) -> int:
             print(f"{rid}  {title}  [jaxpr audit]")
         for rid, title in HBM_RULES.items():
             print(f"{rid}  {title}  [hbm audit]")
+        for rule in RACE_RULES:
+            print(f"{rule.id}  {rule.title}  [race analysis]")
+        for alias, target in sorted(RULE_ALIASES.items()):
+            print(f"{alias}  alias for {target}")
+        return 0
+
+    if args.domains:
+        print(domains_report(args.paths))
         return 0
 
     static_rules = None
     audit_select = None
     hbm_select = None
+    race_rules = None
     if args.select:
         ids = [r.strip() for r in args.select.split(",") if r.strip()]
+        ids = [RULE_ALIASES.get(r, r) for r in ids]
         unknown = [r for r in ids
                    if r not in RULES_BY_ID and r not in AUDIT_RULES
-                   and r not in HBM_RULES]
+                   and r not in HBM_RULES and r not in RACE_RULES_BY_ID]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
             return 2
         static_ids = [r for r in ids if r in RULES_BY_ID]
         audit_ids = [r for r in ids if r in AUDIT_RULES]
         hbm_ids = [r for r in ids if r in HBM_RULES]
+        race_ids = [r for r in ids if r in RACE_RULES_BY_ID]
         # with an explicit selection, each tier runs exactly its selected
         # rules: naming audit rules implies the audit tier, and a selection
         # with NO audit ids skips the audit entirely even under --jaxpr —
@@ -107,19 +141,27 @@ def main(argv=None) -> int:
         hbm_select = hbm_ids
         args.jaxpr = bool(audit_ids)
         args.hbm = bool(hbm_ids)
+        args.races = bool(race_ids)
         only_implied = not static_ids
         args.jaxpr_only = bool(audit_ids) and only_implied
         args.hbm_only = bool(hbm_ids) and only_implied
+        args.races_only = bool(race_ids) and only_implied
         if static_ids:
             static_rules = [RULES_BY_ID[r] for r in static_ids]
+        if race_ids:
+            race_rules = [RACE_RULES_BY_ID[r] for r in race_ids]
 
-    skip_static = args.jaxpr_only or args.hbm_only
+    skip_static = args.jaxpr_only or args.hbm_only or args.races_only
     if args.select:
         skip_static = static_rules is None
 
     findings = []
     if not skip_static:
         findings.extend(run_paths(args.paths, rules=static_rules))
+    if args.races or args.races_only:
+        findings.extend(
+            run_paths(args.paths, rules=race_rules or list(RACE_RULES))
+        )
     if args.jaxpr or args.jaxpr_only:
         from kube_batch_tpu.analysis.jaxpr_audit import run_audit
 
@@ -128,6 +170,18 @@ def main(argv=None) -> int:
         from kube_batch_tpu.analysis.hbm_audit import run_hbm_audit
 
         findings.extend(run_hbm_audit(select=hbm_select))
+
+    # tiers A and D both flow through run_paths, so engine-level findings
+    # (KBT000: bad suppression, missing path, broken module) would repeat
+    # once per tier — dedupe identical findings, order preserved
+    seen = set()
+    deduped = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(f)
+    findings = deduped
 
     for f in findings:
         if args.jsonl:

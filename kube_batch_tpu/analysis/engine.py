@@ -35,6 +35,13 @@ PACKAGE_NAME = "kube_batch_tpu"
 
 _ALLOW_RE = re.compile(r"kbt:\s*allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)")
 
+#: rule-id aliases, honored by `--select` AND by allow comments: when a
+#: rule migrates tiers its old id keeps meaning (KBT012 — the pipelined
+#: writeback handoff check — is a tier-D KBT302 instance since PR 18).
+#: Lives here (not races.py) so Suppressions can resolve without an
+#: engine→races import cycle; races re-exports it for the CLI.
+RULE_ALIASES = {"KBT012": "KBT302"}
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -99,7 +106,12 @@ class Suppressions:
         return sup
 
     def covers(self, rule: str, line: int) -> bool:
-        return rule in self.by_line.get(line, set())
+        allowed = self.by_line.get(line, set())
+        if rule in allowed:
+            return True
+        # honor aliased ids: allow[KBT012] keeps suppressing the rule it
+        # migrated into (KBT302)
+        return any(RULE_ALIASES.get(a) == rule for a in allowed)
 
 
 class Rule:

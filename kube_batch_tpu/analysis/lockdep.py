@@ -94,7 +94,8 @@ allow_blocking = _blocking.allow_blocking
 
 @dataclasses.dataclass
 class Violation:
-    kind: str  # "order-inversion" | "blocking-under-lock"
+    kind: str  # "order-inversion" | "blocking-under-lock" |
+    #            "undeclared-nesting" | "unguarded-access"
     description: str
     stack: str
 
@@ -455,3 +456,161 @@ def uninstall() -> Optional[LockdepState]:
 
 def current_state() -> Optional[LockdepState]:
     return _installed.state if _installed is not None else None
+
+
+# ---------------------------------------------------------------------------
+# guarded-access corroborator (kbt-check tier D, analysis/races.py)
+#
+# The static analyzer infers, per class, which lock attribute dominates each
+# shared attribute ("lock domains").  This runtime leg cross-validates the
+# map the same way tier B's jaxpr audit corroborates tier A: hot shared
+# structures are instrumented with a data descriptor that asserts, at access
+# time, that the statically inferred domain lock is actually held by the
+# accessing thread.  Static says "every access site holds _lock"; runtime
+# says "and every access the suite actually executed did".
+#
+# Enforcement semantics:
+# - An instance is CONFINED until a second distinct thread touches it —
+#   single-thread instances (most unit-test fixtures) never enforce, so the
+#   check only fires where a race is physically possible.
+# - Ownership must be attributable: TrackedLock (held-set lookup) and
+#   RLock/Condition (_is_owned) qualify; a plain untracked Lock records no
+#   owner, so access under one is skipped rather than misreported.
+# - `utils.blocking.allow_unguarded("reason")` regions are exempt — the
+#   runtime analog of `# kbt: allow[KBT301]`.
+# - Violations dedupe per (class, attr) and land in LockdepState.violations,
+#   so the pytest plugin fails the run exactly like an order inversion.
+# ---------------------------------------------------------------------------
+
+_REAL_GET_IDENT = threading.get_ident
+
+
+def _owned_by_current(lock) -> Optional[bool]:
+    """Does the calling thread own `lock`?  None = ownership cannot be
+    attributed (plain Lock, or a foreign object) — callers skip, never
+    report, on None."""
+    if lock is None:
+        return None
+    if isinstance(lock, TrackedLock):
+        return any(e[1] == id(lock) for e in lock._state._held())
+    owned = getattr(lock, "_is_owned", None)
+    if owned is not None:
+        try:
+            return bool(owned())
+        except Exception:  # noqa: BLE001 — a foreign _is_owned never reports
+            return None
+    return None
+
+
+class _GuardedAttr:
+    """Class-level data descriptor standing in for one instrumented plain
+    instance attribute.  Values keep living in the instance `__dict__`
+    under the same name (a data descriptor shadows the instance dict), so
+    uninstalling the descriptor restores direct attribute access with the
+    last value intact."""
+
+    def __init__(self, install: "GuardedAccessInstallation", cls: type,
+                 attr: str, lock_attr: str, sample: int = 1):
+        self._install = install
+        self._cls = cls
+        self.attr = attr
+        self.lock_attr = lock_attr
+        self.sample = max(1, int(sample))
+        self._count = 0  # benign data race: sampling only needs "roughly Nth"
+
+    def __get__(self, inst, objtype=None):
+        if inst is None:
+            return self
+        self._check(inst, "read")
+        try:
+            return inst.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(
+                f"{type(inst).__name__!r} object has no attribute "
+                f"{self.attr!r}"
+            ) from None
+
+    def __set__(self, inst, value) -> None:
+        self._check(inst, "write")
+        inst.__dict__[self.attr] = value
+
+    def __delete__(self, inst) -> None:
+        self._check(inst, "delete")
+        inst.__dict__.pop(self.attr, None)
+
+    def _check(self, inst, op: str) -> None:
+        d = inst.__dict__
+        idents = d.get("_kbt_guard_idents")
+        if idents is None:
+            idents = d.setdefault("_kbt_guard_idents", set())
+        idents.add(_REAL_GET_IDENT())  # own-ident add: GIL-atomic
+        if len(idents) < 2:
+            return  # thread-confined so far — no race is possible yet
+        self._count += 1
+        if self.sample > 1 and self._count % self.sample:
+            return
+        if _blocking.unguarded_allowed():
+            return
+        # read the lock straight from the instance dict: the lock attr is
+        # never itself instrumented, and __init__ ordering (value set
+        # before the lock exists) degrades to a skip, not a crash
+        if _owned_by_current(d.get(self.lock_attr)) is False:
+            self._install._report(self, inst, op)
+
+
+class GuardedAccessInstallation:
+    """One batch of instrumented (class, attr, domain-lock) triples."""
+
+    def __init__(self, state: LockdepState):
+        self.state = state
+        self._patched: List[Tuple[type, str]] = []
+        self._reported: set = set()
+        self._mu = _REAL_LOCK()
+
+    def _report(self, desc: _GuardedAttr, inst, op: str) -> None:
+        key = (desc._cls.__name__, desc.attr)
+        if key in self._reported:
+            return
+        stack = _stack(skip=4)
+        with self._mu:
+            if key in self._reported:
+                return
+            self._reported.add(key)
+        with self.state._mu:
+            self.state.violations.append(Violation(
+                "unguarded-access",
+                f"{op} of {desc._cls.__name__}.{desc.attr} without holding "
+                f"its inferred domain lock self.{desc.lock_attr} (tier D "
+                "lock-domain map, analysis/races.py) on an instance already "
+                "shared across threads — hold the lock or wrap the region "
+                "in utils.blocking.allow_unguarded(\"<reason>\")",
+                stack,
+            ))
+
+    def uninstall(self) -> None:
+        for cls, attr in self._patched:
+            if isinstance(cls.__dict__.get(attr), _GuardedAttr):
+                delattr(cls, attr)
+        self._patched = []
+
+
+def install_guarded_access(specs, state: Optional[LockdepState] = None,
+                           sample: int = 1) -> GuardedAccessInstallation:
+    """Instrument `(module, class_name, attr, lock_attr)` tuples (the shape
+    `races.runtime_domain_specs` returns, so the table is always the
+    STATICALLY inferred one).  `state` defaults to the active lockdep
+    state; violations appended there fail the plugin run."""
+    import importlib
+
+    if state is None:
+        state = current_state()
+    if state is None:
+        state = LockdepState()
+    inst = GuardedAccessInstallation(state)
+    for module, cls_name, attr, lock_attr in specs:
+        cls = getattr(importlib.import_module(module), cls_name)
+        if isinstance(cls.__dict__.get(attr), _GuardedAttr):
+            continue  # already instrumented (idempotent re-install)
+        setattr(cls, attr, _GuardedAttr(inst, cls, attr, lock_attr, sample))
+        inst._patched.append((cls, attr))
+    return inst

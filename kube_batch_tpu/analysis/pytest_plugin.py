@@ -8,6 +8,13 @@ Opt out with ``KBT_LOCKDEP=0`` (e.g. when bisecting an unrelated failure).
 Tests that deliberately provoke violations (tests/test_lockdep.py) run
 against their own private ``LockdepState`` and never touch the
 session-global one.
+
+The tier-D guarded-access corroborator (analysis/races.py lock domains)
+rides along: the hot shared structures below are instrumented so every
+access the suite executes asserts the statically inferred domain lock is
+held.  ``KBT_GUARDED_ACCESS=0`` opts out independently of lockdep;
+``KBT_GUARDED_SAMPLE=N`` checks only every Nth access on a shared
+instance (default 1 = every access — the suite is small enough).
 """
 
 from __future__ import annotations
@@ -16,17 +23,48 @@ import os
 
 from kube_batch_tpu.analysis import lockdep
 
+#: the instrumented hot shared structures: (module, class, attr).  The
+#: domain lock is NOT written here — it is resolved from the static tier-D
+#: inference at session start (races.runtime_domain_specs), so this table
+#: can never silently disagree with the map it corroborates.  The resync
+#: queue and the warm-table state are deliberately absent: neither owns a
+#: lock (the cache's big lock serializes the former; the latter is
+#: cycle-confined), so they have no domain to corroborate.
+HOT_STRUCTURES = (
+    ("kube_batch_tpu.cache.cache", "SchedulerCache", "_ingest_staged"),
+    ("kube_batch_tpu.serve.lease", "LeaseBroker", "_lease"),
+    ("kube_batch_tpu.replicate.publisher", "ReplicationPublisher", "_ring"),
+    ("kube_batch_tpu.replicate.publisher", "ReplicationPublisher", "_mirror"),
+)
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).lower() not in ("0", "false", "no")
+
 
 def _enabled() -> bool:
-    return os.environ.get("KBT_LOCKDEP", "1").lower() not in ("0", "false", "no")
+    return _env_on("KBT_LOCKDEP")
 
 
 def pytest_configure(config):
-    if _enabled():
-        config._kbt_lockdep_state = lockdep.install()
+    if not _enabled():
+        return
+    config._kbt_lockdep_state = lockdep.install()
+    if _env_on("KBT_GUARDED_ACCESS"):
+        from kube_batch_tpu.analysis import races
+
+        specs = races.runtime_domain_specs(HOT_STRUCTURES)
+        config._kbt_guarded = lockdep.install_guarded_access(
+            specs,
+            state=config._kbt_lockdep_state,
+            sample=int(os.environ.get("KBT_GUARDED_SAMPLE", "1")),
+        )
 
 
 def pytest_unconfigure(config):
+    if getattr(config, "_kbt_guarded", None) is not None:
+        config._kbt_guarded.uninstall()
+        config._kbt_guarded = None
     if getattr(config, "_kbt_lockdep_state", None) is not None:
         lockdep.uninstall()
         config._kbt_lockdep_state = None
@@ -40,8 +78,14 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         terminalreporter.section("kbt lockdep violations")
         terminalreporter.write_line(state.report())
     else:
+        guarded = getattr(config, "_kbt_guarded", None)
+        extra = (
+            f", {len(guarded._patched)} guarded structures corroborated"
+            if guarded is not None else ""
+        )
         terminalreporter.write_line(
-            f"kbt lockdep: clean ({len(state.edges)} lock-order edges observed)"
+            f"kbt lockdep: clean ({len(state.edges)} lock-order edges "
+            f"observed{extra})"
         )
 
 

@@ -495,58 +495,11 @@ class RawTransportRule(Rule):
 
 
 # --------------------------------------------------------------------------
-# KBT012 — pipeline writeback stage reading live scheduling state
+# KBT012 — MOVED: the pipeline writeback-stage handoff contract is now a
+# KBT302 instance (analysis/races.py PublishHandoffRule — the generalized
+# publish-then-mutate rule owns the one hardcoded case it grew from).
+# `--select KBT012` still works via RULE_ALIASES in races.py.
 # --------------------------------------------------------------------------
-
-
-class PipelineStageRule(Rule):
-    """Guard for the event-driven pipelined cycle (perf PR 9): the
-    writeback stage — ``SchedulerCache.run_status_flush`` and the
-    Scheduler's ``_writeback`` worker body — runs OVERLAPPED with the next
-    cycle's ingest drain, session open, and solve.  Everything it touches
-    must come through the double-buffer handoff (the value-snapshotted
-    ``StatusFlush``: PodGroup clones, pre-rendered event/condition ops,
-    decided queue writes, the degraded verdict taken at stage time).  A
-    writeback body that dereferences the LIVE scheduling state — the
-    job/node/queue stores, the column store, the open cache, the dirty
-    tracker — races cycle N+1's mutations of exactly that state; the bug
-    class this prevents is a torn status write built from half-advanced
-    state.  Stage-time code (``stage_status_flush``) legitimately reads all
-    of it, under the lock, before the cycle ends."""
-
-    id = "KBT012"
-    title = "pipeline writeback stage reads live scheduling state"
-    scope = ("cache/cache.py", "scheduler.py")
-
-    #: function names that ARE the overlapped writeback stage
-    STAGE_FNS = {"run_status_flush", "_writeback"}
-    #: live cycle state the next session open/solve mutates concurrently
-    FORBIDDEN = {
-        "jobs", "nodes", "pods", "queues", "pod_groups", "columns",
-        "open_cache", "dirty", "fit_state_jobs",
-    }
-    #: roots whose forbidden attributes count (self.jobs, self.cache.jobs,
-    #: a session parameter's ssn.jobs)
-    ROOTS = {"self", "cache", "ssn", "session"}
-
-    def check(self, tree: ast.Module, relpath: str):
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if node.name not in self.STAGE_FNS:
-                continue
-            for sub in _walk_skipping_defs(node.body):
-                if not isinstance(sub, ast.Attribute):
-                    continue
-                if sub.attr not in self.FORBIDDEN:
-                    continue
-                if _leftmost_name(sub) not in self.ROOTS:
-                    continue
-                yield (sub.lineno, sub.col_offset,
-                       f"writeback stage `{node.name}` reads live "
-                       f"`.{sub.attr}` — the overlapped stage may only "
-                       "touch the value-snapshotted StatusFlush handoff "
-                       "(stage the read in stage_status_flush instead)")
 
 
 # --------------------------------------------------------------------------
@@ -723,7 +676,6 @@ ALL_RULES = (
     FailOpenTranslateRule(),
     HostSyncRule(),
     RawTransportRule(),
-    PipelineStageRule(),
     SentinelConsumeRule(),
     SpanDisciplineRule(),
 ) + FLOW_RULES

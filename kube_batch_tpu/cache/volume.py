@@ -406,6 +406,21 @@ class K8sPVLedger(StandalonePVBinder):
         if writer is not None:
             writer.submit(lambda: None).result()
 
+    def close(self) -> None:
+        """Retire the pv-writes worker with a bounded drain (the tier-D
+        worker-shutdown discipline: every pool this codebase spawns has a
+        join on its owner's stop path — SchedulerCache.stop() calls this).
+        Queued retries are NOT replayed first: shutdown must not block on
+        an unreachable apiserver; they stay in _pending_writes and a later
+        bind on a revived ledger re-submits them."""
+        with self._lock:
+            timer, self._retry_timer = self._retry_timer, None
+            writer, self._writer = self._writer, None
+        if timer is not None:
+            timer.cancel()
+        if writer is not None:
+            writer.shutdown(wait=True)
+
     # -- throttled, retried, OFF-CYCLE cluster writes ---------------------
     def _submit_writes(self, writes) -> None:
         from kube_batch_tpu.utils.blocking import allow_blocking

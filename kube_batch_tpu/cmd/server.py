@@ -452,6 +452,12 @@ class AdminServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        # bounded join: serve_forever returns once shutdown() lands, so the
+        # acceptor thread exits promptly — but don't hang stop() on a
+        # wedged in-flight handler (the thread is daemon either way)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class TokenBucket:

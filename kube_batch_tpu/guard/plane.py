@@ -253,8 +253,9 @@ class GuardPlane:
             try:
                 path = dump()
                 if path:
-                    record["bundle"] = path
-                    self.bundles.append(path)
+                    with self._lock:
+                        record["bundle"] = path
+                        self.bundles.append(path)
             except Exception:  # noqa: BLE001 — diagnostics only
                 logger.exception("guard bundle dump failed")
 

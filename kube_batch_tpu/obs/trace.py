@@ -290,7 +290,8 @@ class Tracer:
         if self.clock is not None:
             rec.vt1 = self.clock.monotonic()
         rec.closed = True
-        self.cycles_total += 1
+        with self._mu:
+            self.cycles_total += 1
         recorder = self.recorder
         if recorder is not None and self.enabled:
             recorder.record_cycle(rec)

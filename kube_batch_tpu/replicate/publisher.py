@@ -161,7 +161,8 @@ class ReplicationPublisher:
         except Exception:
             # a half-updated mirror must never feed another delta — drop
             # everything so the next record is a clean full snapshot
-            self.encode_errors += 1
+            with self._lock:
+                self.encode_errors += 1
             logger.exception("replication encode failed; next record full")
             self.invalidate()
 

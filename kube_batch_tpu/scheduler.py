@@ -508,3 +508,13 @@ class Scheduler:
         self._stop = True
         # a stopping pipelined loop may be idling at the slow floor — wake it
         self.trigger.notify()
+
+    def close(self) -> None:
+        """Retire the pipelined writeback pool with a bounded drain.
+        run_forever's finally-block does this for the looped path; direct
+        ``run_once_pipelined`` callers (tests, the sim harness) must call
+        close() or leak the pool's non-daemon worker thread."""
+        self.drain_pipeline()
+        if self._wb_pool is not None:
+            self._wb_pool.shutdown(wait=True)
+            self._wb_pool = None

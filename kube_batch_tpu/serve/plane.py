@@ -197,6 +197,13 @@ class QueryPlane:
 
     def close(self) -> None:
         self.batcher.stop()
+        # bounded join on the prewarm workers: they are daemon threads, but
+        # a closed plane must be quiescent (no compile racing teardown) —
+        # each warm is one probe, so a short timeout covers the honest case
+        # and a wedged compile can't hang close()
+        for t in self._warm_threads:
+            t.join(timeout=5.0)
+        self._warm_threads = []
         cols = getattr(self.cache, "columns", None)
         if cols is not None and cols.resident_swap_guard is self._swap_guard:
             cols.resident_swap_guard = None

@@ -1,8 +1,11 @@
-"""`allow_blocking` / `allow_nesting` — the runtime analogs of
-`# kbt: allow[...]` for the lockdep checks (kube_batch_tpu/analysis/
-lockdep.py): the former fences a sound blocking region, the latter declares
-a deliberate same-site lock nesting (two instances of one lock class held
-at once — per-object locks acquired in a stable aggregate order).
+"""`allow_blocking` / `allow_nesting` / `allow_unguarded` — the runtime
+analogs of `# kbt: allow[...]` for the lockdep checks (kube_batch_tpu/
+analysis/lockdep.py): the first fences a sound blocking region, the second
+declares a deliberate same-site lock nesting (two instances of one lock
+class held at once — per-object locks acquired in a stable aggregate
+order), and the third declares a deliberate lock-free access to an
+attribute whose tier-D domain lock (analysis/races.py) would otherwise be
+enforced by the guarded-access corroborator.
 
 Lives in utils/ (stdlib-only, no analysis-package imports) because the
 RUNTIME core annotates with it — cache/volume.py fences its pv-writes
@@ -22,6 +25,7 @@ import threading
 # block, and vice versa
 _blocking_ok = threading.local()
 _nesting_ok = threading.local()
+_unguarded_ok = threading.local()
 
 
 @contextlib.contextmanager
@@ -62,3 +66,17 @@ def allow_nesting(reason: str):
 
 def nesting_allowed() -> bool:
     return getattr(_nesting_ok, "depth", 0) > 0
+
+
+def allow_unguarded(reason: str):
+    """Declare that lock-free access to domain-guarded attributes inside
+    this region is deliberate — the runtime counterpart of a static
+    `# kbt: allow[KBT301]` annotation, consumed by the guarded-access
+    corroborator (analysis/lockdep.install_guarded_access).  The reason
+    should say why the unlocked access cannot tear (GIL-atomic single op,
+    documented stale-tolerant hint, cycle-confined structure...)."""
+    return _declared_region(_unguarded_ok, "allow_unguarded", reason)
+
+
+def unguarded_allowed() -> bool:
+    return getattr(_unguarded_ok, "depth", 0) > 0

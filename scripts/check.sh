@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
-# kbt-check, all three tiers: the static AST/flow rules over the package
-# tree, the jaxpr-level audit of the registered jitted entry points, AND
-# the tier-C liveness/HBM-budget audit (every entry point traced at the
+# kbt-check, all four tiers: the static AST/flow rules over the package
+# tree, the jaxpr-level audit of the registered jitted entry points, the
+# tier-C liveness/HBM-budget audit (every entry point traced at the
 # abstract shape ladder up to 1M×100k — CPU-pinned, traces only, no device
-# memory is ever allocated) — then the seeded chaos smoke (bind-storm +
-# leader-failover sim presets), so fault-hardening invariants run on every
-# PR alongside the lint tiers.
+# memory is ever allocated), AND the tier-D thread/lock-domain race rules
+# (KBT301-304 over the inferred per-class lock domains) — then the seeded
+# chaos smoke (bind-storm + leader-failover sim presets), so
+# fault-hardening invariants run on every PR alongside the lint tiers.
 # Exit 0 = clean, 1 = findings / violated chaos invariants, 2 = usage error.
 #
 # CI usage:  scripts/check.sh [--jsonl]
@@ -22,7 +23,7 @@ cd "$(dirname "$0")/.."
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="--xla_force_host_platform_device_count=4${XLA_FLAGS:+ $XLA_FLAGS}"
 fi
-env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr --hbm "$@"
+env JAX_PLATFORMS=cpu python -m kube_batch_tpu.analysis --jaxpr --hbm --races "$@"
 
 # chaos smoke: each preset's CLI exits nonzero on a violated recovery
 # invariant (lost/duplicate binds, accounting drift, failed fault

@@ -50,6 +50,77 @@ def pytest_configure(config):
     )
 
 
+@pytest.fixture(autouse=True)
+def _no_thread_leaks(monkeypatch):
+    """Worker-shutdown discipline (kbt tier D's runtime sibling): no NEW
+    non-daemon thread may survive a test.  Every worker this codebase
+    starts — writeback pool, status/dispatch pools, batcher, publisher
+    encode, follower pull, prewarm, admin-http — has a bounded join on its
+    shutdown path; the assert below verifies those joins actually reap
+    everything.  Caches and schedulers the test constructed but never
+    stopped are reaped here first (their stop()/close() are idempotent, so
+    tests that do shut down pay nothing) — the discipline this fixture
+    enforces is "every worker's owner has a working bounded join", not
+    "every test calls stop()".  Daemon threads are exempt (they cannot
+    block interpreter exit), and a short grace window absorbs workers that
+    are mid-exit when the test body returns."""
+    import threading
+    import weakref
+    import time as _time
+
+    from kube_batch_tpu.cache.cache import SchedulerCache
+    from kube_batch_tpu.scheduler import Scheduler
+
+    caches, scheds = [], []
+    orig_cache_init = SchedulerCache.__init__
+    orig_sched_init = Scheduler.__init__
+
+    def _cache_init(self, *a, **kw):
+        orig_cache_init(self, *a, **kw)
+        caches.append(weakref.ref(self))
+
+    def _sched_init(self, *a, **kw):
+        orig_sched_init(self, *a, **kw)
+        scheds.append(weakref.ref(self))
+
+    monkeypatch.setattr(SchedulerCache, "__init__", _cache_init)
+    monkeypatch.setattr(Scheduler, "__init__", _sched_init)
+
+    before = set(threading.enumerate())
+    yield
+    # reap schedulers before caches: a draining writeback may still
+    # dispatch binds through the cache's pools
+    for ref in scheds:
+        s = ref()
+        if s is not None:
+            try:
+                s.close()
+            except Exception:
+                pass  # the leak assert below still catches unreaped threads
+    for ref in caches:
+        c = ref()
+        if c is not None:
+            try:
+                c.stop()
+            except Exception:
+                pass
+    deadline = _time.monotonic() + 2.0
+    leaked = []
+    while True:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon and t not in before
+        ]
+        if not leaked or _time.monotonic() > deadline:
+            break
+        _time.sleep(0.05)
+    assert not leaked, (
+        "non-daemon thread(s) leaked by this test: "
+        f"{sorted(t.name for t in leaked)} — every worker must be joined "
+        "(bounded) on the owning object's stop()/close()"
+    )
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     import jax

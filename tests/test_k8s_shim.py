@@ -565,6 +565,7 @@ class TestVolumeK8sMode:
                if "persistentvolumeclaims/scratch" in r[1]]
         assert ann and ann[0][2]["metadata"]["annotations"][
             SELECTED_NODE_ANNOTATION] == "node-a"
+        led.close()  # bounded pv-writes join (tier-D shutdown discipline)
 
 
 class TestVolumeIngestSeam:
@@ -828,6 +829,7 @@ class TestPvLedgerRetryQueue:
         t3 = self._task("c", ["c1"])
         led.allocate_volumes(t3, "node-a")
         assert led.reservations[t3.uid]["ml/c1"] == dropped_pv
+        led.close()
 
     def test_idle_timer_flushes_queued_retries(self):
         tr = self._Transport(fail_next=1)
@@ -845,6 +847,7 @@ class TestPvLedgerRetryQueue:
         led.drain_writes()
         assert not led._pending_writes
         assert any("persistentvolumes/" in r[1] for r in tr.requests)
+        led.close()
 
 
 class TestPvTopologyAffinity:

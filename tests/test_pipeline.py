@@ -461,7 +461,8 @@ class TestRunForeverPipelined:
             sched.stop()
             t.join(timeout=10.0)
         assert not t.is_alive()
-        assert cache._ingest_staged == [], "shutdown must drain staging"
+        with cache._ingest_lock:  # guarded-access corroborator: hold the domain lock
+            assert cache._ingest_staged == [], "shutdown must drain staging"
         assert sched._wb_future is None, "shutdown must join the writeback"
         assert cache.binder.binds.get("ns/burst-0") is not None
 
